@@ -1,0 +1,34 @@
+"""TPU-native consensus-NMF framework for single-cell RNA-seq.
+
+A from-scratch JAX/XLA implementation with the capabilities of the reference
+cNMF_torch pipeline (see SURVEY.md): prepare -> factorize -> combine ->
+consensus -> k_selection, with factorization replicates running as batched,
+sharded XLA programs instead of independent worker processes.
+"""
+
+from .utils.io import load_df_from_npz, save_df_to_npz
+from .version import __version__
+
+__all__ = ["cNMF", "Preprocess", "main", "save_df_to_npz", "load_df_from_npz", "__version__"]
+
+
+def __getattr__(name):
+    # Lazy imports keep `import cnmf_torch_tpu` light (no matplotlib etc.).
+    # ImportError is translated to AttributeError so hasattr()/dir()-driven
+    # tooling sees a missing attribute, not a crash.
+    _lazy = {
+        "cNMF": ("cnmf_torch_tpu.models.cnmf", "cNMF"),
+        "Preprocess": ("cnmf_torch_tpu.models.preprocess", "Preprocess"),
+        "main": ("cnmf_torch_tpu.cli", "main"),
+    }
+    if name in _lazy:
+        import importlib
+
+        module_name, attr = _lazy[name]
+        try:
+            return getattr(importlib.import_module(module_name), attr)
+        except ImportError as exc:
+            raise AttributeError(
+                f"module {__name__!r} has no attribute {name!r} ({exc})"
+            ) from exc
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

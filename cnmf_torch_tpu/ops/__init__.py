@@ -1,0 +1,36 @@
+from .hvg import highvar_genes
+from .kmeans import kmeans
+from .metrics import local_density, pairwise_euclidean, silhouette_score
+from .nmf import (
+    beta_divergence,
+    beta_loss_to_float,
+    fit_h,
+    init_factors,
+    nmf_fit_batch,
+    nmf_fit_online,
+    nndsvd_init,
+    run_nmf,
+)
+from .ols import ols_all_cols
+from .stats import column_mean_var, normalize_total, row_sums, scale_columns
+
+__all__ = [
+    "highvar_genes",
+    "kmeans",
+    "local_density",
+    "pairwise_euclidean",
+    "silhouette_score",
+    "beta_divergence",
+    "beta_loss_to_float",
+    "fit_h",
+    "init_factors",
+    "nmf_fit_batch",
+    "nmf_fit_online",
+    "nndsvd_init",
+    "run_nmf",
+    "ols_all_cols",
+    "column_mean_var",
+    "normalize_total",
+    "row_sums",
+    "scale_columns",
+]

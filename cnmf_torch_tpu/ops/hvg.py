@@ -1,0 +1,111 @@
+"""Over-dispersed (high-variance) gene selection by Fano factor.
+
+JAX reimplementation of ``get_highvar_genes_sparse`` / ``get_highvar_genes``
+(``/root/reference/src/cnmf/cnmf.py:133-238``): genes are scored by the ratio
+of their Fano factor (var/mean) to an expected-Fano line ``A^2 * mean + B^2``,
+where ``A`` comes from the top-20-mean genes' coefficient of variation and
+``B`` from the winsorized (10-90th percentile box) median Fano. Selection is
+either top-``numgenes`` by ``fano_ratio`` or thresholded at
+``T = 1 + std(fano in box)`` with a ``minimal_mean`` floor.
+
+The moment pass is the only O(cells x genes) work and runs on device via
+:func:`cnmf_torch_tpu.ops.stats.column_mean_var`; the scoring itself is
+O(genes) and computed in one fused jit.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import scipy.sparse as sp
+
+from .stats import column_mean_var
+
+__all__ = ["highvar_genes"]
+
+
+@functools.partial(jax.jit, static_argnames=("numgenes", "has_threshold"))
+def _fano_scores(mean, var, numgenes, has_threshold, expected_fano_threshold,
+                 minimal_mean):
+    fano = var / mean
+
+    # A: min CV among the 20 highest-mean genes (cnmf.py:144-145)
+    top20 = jax.lax.top_k(mean, min(20, mean.shape[0]))[1]
+    A = jnp.min(jnp.sqrt(var[top20]) / mean[top20])
+
+    # winsor box: 10th-90th pctile in both mean and fano (cnmf.py:147-152).
+    # NaN fano (zero-mean genes) never enters the box: comparisons are False.
+    w_mean_low, w_mean_high = jnp.nanquantile(mean, jnp.array([0.10, 0.90]))
+    w_fano_low, w_fano_high = jnp.nanquantile(fano, jnp.array([0.10, 0.90]))
+    box = ((fano > w_fano_low) & (fano < w_fano_high)
+           & (mean > w_mean_low) & (mean < w_mean_high))
+    boxed_fano = jnp.where(box, fano, jnp.nan)
+    fano_median = jnp.nanmedian(boxed_fano)
+    B = jnp.sqrt(fano_median)
+
+    expected_fano = (A ** 2) * mean + (B ** 2)
+    fano_ratio = fano / expected_fano
+
+    if numgenes is not None:
+        # top-N selection; NaN ratios (zero-mean genes) sort last
+        score = jnp.where(jnp.isnan(fano_ratio), -jnp.inf, fano_ratio)
+        idx = jax.lax.top_k(score, numgenes)[1]
+        high_var = jnp.zeros(mean.shape, dtype=bool).at[idx].set(True)
+        T = jnp.nan
+    else:
+        if has_threshold:
+            T = expected_fano_threshold
+        else:
+            # pandas .std() on the boxed fano = sample std, ddof=1 (cnmf.py:167)
+            nbox = jnp.sum(box)
+            mu = jnp.nanmean(boxed_fano)
+            ssq = jnp.nansum((boxed_fano - mu) ** 2)
+            T = 1.0 + jnp.sqrt(ssq / jnp.maximum(nbox - 1, 1))
+        high_var = (fano_ratio > T) & (mean > minimal_mean)
+
+    return fano, expected_fano, fano_ratio, high_var, A, B, T
+
+
+def highvar_genes(X, expected_fano_threshold=None, minimal_mean: float = 0.5,
+                  numgenes: int | None = None):
+    """Score genes for over-dispersion; X is cells x genes (sparse or dense).
+
+    Returns ``(gene_stats, params)`` with the same schema as the reference:
+    ``gene_stats`` has columns [mean, var, fano, expected_fano, high_var,
+    fano_ratio]; ``params`` is ``{'A','B','T','minimal_mean'}``.
+
+    The reference's sparse path uses population variance (ddof=0 via
+    StandardScaler, cnmf.py:138) and its dense path likewise (ddof=0,
+    cnmf.py:192); both map to one kernel here.
+    """
+    mean, var = column_mean_var(X, ddof=0)
+    mean = jnp.asarray(mean, dtype=jnp.float32)
+    var = jnp.asarray(var, dtype=jnp.float32)
+    # mirrors the reference's truthiness test `if not expected_fano_threshold`
+    # (cnmf.py:166): None or 0.0 both fall back to the computed T
+    has_threshold = bool(expected_fano_threshold)
+    fano, expected_fano, fano_ratio, high_var, A, B, T = _fano_scores(
+        mean, var,
+        None if numgenes is None else min(int(numgenes), X.shape[1]),
+        has_threshold,
+        jnp.float32(expected_fano_threshold if has_threshold else 0.0),
+        jnp.float32(minimal_mean),
+    )
+    gene_stats = pd.DataFrame({
+        "mean": np.asarray(mean, dtype=np.float64),
+        "var": np.asarray(var, dtype=np.float64),
+        "fano": np.asarray(fano, dtype=np.float64),
+        "expected_fano": np.asarray(expected_fano, dtype=np.float64),
+        "high_var": np.asarray(high_var),
+        "fano_ratio": np.asarray(fano_ratio, dtype=np.float64),
+    })
+    params = {
+        "A": float(A), "B": float(B),
+        "T": None if numgenes is not None else float(T),
+        "minimal_mean": minimal_mean,
+    }
+    return gene_stats, params

@@ -1,0 +1,102 @@
+"""Multi-init Lloyd k-means in JAX.
+
+Replaces sklearn's ``KMeans(n_clusters=k, n_init=10, random_state=1)`` used
+for consensus clustering of replicate spectra
+(``/root/reference/src/cnmf/cnmf.py:18, 1082-1084``). Bitwise parity with
+sklearn is implementation-defined and impossible to pin (SURVEY.md §7); the
+parity contract for consensus is identical cluster *medians up to label
+permutation*, which multi-init Lloyd from a fixed key satisfies.
+
+Design: kmeans++ seeding via a ``lax.scan`` over centers, Lloyd iterations
+via ``lax.while_loop`` on sklearn's center-shift criterion (``tol`` scaled
+by the data variance), and the ``n_init`` restarts batched with ``vmap`` —
+one compiled program, no host round-trips.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["kmeans"]
+
+
+def _sq_dists(X, C):
+    """(n, k) squared euclidean distances."""
+    x2 = jnp.sum(X * X, axis=1)[:, None]
+    c2 = jnp.sum(C * C, axis=1)[None, :]
+    return jnp.maximum(x2 + c2 - 2.0 * (X @ C.T), 0.0)
+
+
+def _kmeanspp(key, X, k: int):
+    n = X.shape[0]
+    key, sub = jax.random.split(key)
+    first = jax.random.randint(sub, (), 0, n)
+    c0 = X[first]
+    min_d2 = jnp.sum((X - c0[None, :]) ** 2, axis=1)
+
+    def pick(carry, sub):
+        min_d2 = carry
+        p = min_d2 / jnp.maximum(min_d2.sum(), 1e-30)
+        idx = jax.random.choice(sub, n, p=p)
+        c = X[idx]
+        d2 = jnp.sum((X - c[None, :]) ** 2, axis=1)
+        return jnp.minimum(min_d2, d2), c
+
+    subs = jax.random.split(key, k - 1)
+    _, rest = jax.lax.scan(pick, min_d2, subs)
+    return jnp.concatenate([c0[None, :], rest], axis=0)
+
+
+def _lloyd(X, C0, max_iter: int, shift_tol):
+    def assign(C):
+        return jnp.argmin(_sq_dists(X, C), axis=1)
+
+    def body(carry):
+        C, _, it = carry
+        labels = assign(C)
+        onehot = jax.nn.one_hot(labels, C.shape[0], dtype=X.dtype)
+        counts = onehot.sum(axis=0)
+        sums = onehot.T @ X
+        newC = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], C)
+        shift = jnp.sum((newC - C) ** 2)
+        return (newC, shift, it + 1)
+
+    def cond(carry):
+        _, shift, it = carry
+        return (it < max_iter) & (shift > shift_tol)
+
+    C, _, _ = jax.lax.while_loop(cond, body, (C0, jnp.asarray(jnp.inf, X.dtype), jnp.int32(0)))
+    labels = assign(C)
+    inertia = jnp.sum(jnp.min(_sq_dists(X, C), axis=1))
+    return labels, C, inertia
+
+
+@functools.partial(jax.jit, static_argnames=("k", "n_init", "max_iter"))
+def _kmeans_jit(X, k: int, n_init: int, max_iter: int, tol, key):
+    # sklearn scales tol by the mean per-feature variance of X
+    shift_tol = tol * jnp.mean(jnp.var(X, axis=0))
+
+    def one(key):
+        C0 = _kmeanspp(key, X, k)
+        return _lloyd(X, C0, max_iter, shift_tol)
+
+    labels, Cs, inertias = jax.vmap(one)(jax.random.split(key, n_init))
+    best = jnp.argmin(inertias)
+    return labels[best], Cs[best], inertias[best]
+
+
+def kmeans(X, k: int, n_init: int = 10, max_iter: int = 300,
+           tol: float = 1e-4, seed: int = 1):
+    """Cluster rows of X; returns ``(labels, centers, inertia)`` as numpy.
+
+    ``seed=1`` mirrors the reference's fixed ``random_state=1``
+    (cnmf.py:1082) so repeated consensus runs are deterministic.
+    """
+    X = jnp.asarray(np.asarray(X), dtype=jnp.float32)
+    labels, C, inertia = _kmeans_jit(X, int(k), int(n_init), int(max_iter),
+                                     jnp.float32(tol), jax.random.key(seed))
+    return np.asarray(labels), np.asarray(C), float(inertia)

@@ -1,0 +1,89 @@
+"""Pairwise distances, KNN local density, and silhouette — JAX kernels.
+
+Replaces the consensus stage's native-dependency metric surface:
+``sklearn.metrics.euclidean_distances`` full R x R distance matrix
+(``/root/reference/src/cnmf/cnmf.py:20, 1065``), the ``np.argpartition``
+K-nearest-neighbor mean distance used for the local-density outlier filter
+(``cnmf.py:1067-1070``), and ``sklearn.metrics.silhouette_score``
+(``cnmf.py:19, 1097``). All are fused jit expressions over the on-device
+distance matrix; the KNN selection maps to ``lax.top_k``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["pairwise_euclidean", "local_density", "silhouette_score"]
+
+
+@jax.jit
+def _pairwise_euclidean(A):
+    sq = jnp.sum(A * A, axis=1)
+    d2 = jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * (A @ A.T), 0.0)
+    # exact-zero self distances (the quadratic form leaves fp32 residue on
+    # the diagonal; sklearn zeroes it too) — local density relies on it
+    d2 = d2 * (1.0 - jnp.eye(A.shape[0], dtype=A.dtype))
+    return jnp.sqrt(d2)
+
+
+def pairwise_euclidean(A) -> np.ndarray:
+    """Full pairwise euclidean distance matrix (R x R)."""
+    return np.asarray(_pairwise_euclidean(jnp.asarray(np.asarray(A), jnp.float32)))
+
+
+@functools.partial(jax.jit, static_argnames=("n_neighbors",))
+def _local_density(D, n_neighbors: int):
+    # mean distance to the n nearest neighbors, excluding self: the n+1
+    # smallest distances include self at distance 0, so summing n+1 and
+    # dividing by n reproduces cnmf.py:1067-1070 exactly.
+    neg_top, _ = jax.lax.top_k(-D, n_neighbors + 1)
+    return -neg_top.sum(axis=1) / n_neighbors
+
+
+def local_density(l2_spectra, n_neighbors: int, D=None):
+    """Per-row mean KNN distance over L2-normalized spectra.
+
+    Returns ``(density (R,), D (R,R))`` so the caller can reuse the distance
+    matrix for the clustergram (cnmf.py:1160-1166).
+    """
+    A = jnp.asarray(np.asarray(l2_spectra), jnp.float32)
+    Dj = _pairwise_euclidean(A) if D is None else jnp.asarray(np.asarray(D), jnp.float32)
+    dens = _local_density(Dj, int(n_neighbors))
+    return np.asarray(dens), np.asarray(Dj)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _silhouette_from_dists(D, labels, k: int):
+    n = D.shape[0]
+    onehot = jax.nn.one_hot(labels, k, dtype=D.dtype)       # (n, k)
+    counts = onehot.sum(axis=0)                              # (k,)
+    sums = D @ onehot                                        # (n, k) sum dist to each cluster
+
+    own_count = counts[labels]
+    own_sum = jnp.take_along_axis(sums, labels[:, None], axis=1)[:, 0]
+    a = own_sum / jnp.maximum(own_count - 1.0, 1.0)
+
+    mean_other = sums / jnp.maximum(counts[None, :], 1.0)
+    # exclude own cluster and empty clusters from the b_i minimum
+    mask = (jax.nn.one_hot(labels, k, dtype=bool)) | (counts[None, :] == 0)
+    b = jnp.min(jnp.where(mask, jnp.inf, mean_other), axis=1)
+
+    s = (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30)
+    s = jnp.where(own_count <= 1.0, 0.0, s)                  # sklearn: singletons score 0
+    return jnp.mean(s)
+
+
+def silhouette_score(X, labels, k: int | None = None, D=None) -> float:
+    """Mean silhouette coefficient, euclidean metric (cnmf.py:1097)."""
+    labels = jnp.asarray(np.asarray(labels), jnp.int32)
+    if k is None:
+        k = int(np.max(np.asarray(labels))) + 1
+    if D is None:
+        D = _pairwise_euclidean(jnp.asarray(np.asarray(X), jnp.float32))
+    else:
+        D = jnp.asarray(np.asarray(D), jnp.float32)
+    return float(_silhouette_from_dists(D, labels, int(k)))

@@ -1,0 +1,185 @@
+"""Column moments, TPM normalization, and variance scaling — JAX kernels.
+
+These replace the reference's native-dependency statistics surface:
+``StandardScaler(with_mean=False).fit`` column moments
+(``/root/reference/src/cnmf/cnmf.py:128-131``), ``sc.pp.normalize_total``
+TPM scaling (``cnmf.py:241-247``), and ``sc.pp.scale(zero_center=False)`` /
+dense ``X /= X.std(ddof=1)`` unit-variance gene scaling (``cnmf.py:674-679``).
+
+Sparse matrices are never densified for moment computation: CSR ``data`` /
+column-``indices`` buffers are streamed to the device in row blocks and
+reduced with ``segment_sum`` — an O(nnz) pass that maps onto the TPU's
+vector unit, with accumulation across blocks so memory stays bounded for
+atlas-scale (1M-cell) inputs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.sparse as sp
+
+__all__ = ["column_mean_var", "normalize_total", "scale_columns", "row_sums"]
+
+# Row-block size for streaming sparse buffers host->device. Large enough to
+# amortize transfer, small enough to bound device memory at atlas scale.
+_BLOCK_ROWS = 262_144
+
+
+@functools.partial(jax.jit, static_argnames=("n_cols",))
+def _sparse_block_sums(data, col_idx, n_cols):
+    s1 = jax.ops.segment_sum(data, col_idx, num_segments=n_cols)
+    cnt = jax.ops.segment_sum(jnp.ones_like(data), col_idx, num_segments=n_cols)
+    return s1, cnt
+
+
+@functools.partial(jax.jit, static_argnames=("n_cols",))
+def _sparse_block_centered_sq(data, col_idx, mean, n_cols):
+    d = data - mean[col_idx]
+    return jax.ops.segment_sum(d * d, col_idx, num_segments=n_cols)
+
+
+@jax.jit
+def _dense_block_sum(block):
+    return block.sum(axis=0)
+
+
+@jax.jit
+def _dense_block_centered_sq(block, mean):
+    d = block - mean[None, :]
+    return (d * d).sum(axis=0)
+
+
+def _iter_row_blocks(X, block_rows):
+    for start in range(0, X.shape[0], block_rows):
+        yield X[start : min(start + block_rows, X.shape[0])]
+
+
+def column_mean_var(X, ddof: int = 0, block_rows: int = _BLOCK_ROWS):
+    """Per-column mean and variance of a (cells x genes) matrix.
+
+    Matches ``get_mean_var`` (``cnmf.py:128-131``): population moments
+    (``ddof=0``) as produced by ``StandardScaler(with_mean=False)``.
+    ``ddof=1`` gives the sample variance used by gene scaling.
+
+    Two-pass (mean, then centered squares): the naive E[x^2] - E[x]^2 form
+    cancels catastrophically in fp32 at TPM scale (column means of 1e4 turn
+    a true variance of 100 into 0-112). Cross-block accumulation is float64
+    on host; per-block reductions stay fp32 on device.
+    """
+    n, g = X.shape
+    s1 = np.zeros((g,), dtype=np.float64)
+    if sp.issparse(X):
+        X = X.tocsr()
+        nnz_per_col = np.zeros((g,), dtype=np.float64)
+        for block in _iter_row_blocks(X, block_rows):
+            if block.nnz == 0:
+                continue
+            b1, bc = _sparse_block_sums(
+                jnp.asarray(block.data, dtype=jnp.float32),
+                jnp.asarray(block.indices), g)
+            s1 += np.asarray(b1, dtype=np.float64)
+            nnz_per_col += np.asarray(bc, dtype=np.float64)
+        mean = s1 / n
+        mean_d = jnp.asarray(mean, dtype=jnp.float32)
+        ssq = np.zeros((g,), dtype=np.float64)
+        for block in _iter_row_blocks(X, block_rows):
+            if block.nnz == 0:
+                continue
+            bs = _sparse_block_centered_sq(
+                jnp.asarray(block.data, dtype=jnp.float32),
+                jnp.asarray(block.indices), mean_d, g)
+            ssq += np.asarray(bs, dtype=np.float64)
+        # implicit zeros each contribute mean^2 to the centered sum
+        ssq += (n - nnz_per_col) * mean ** 2
+    else:
+        Xd = np.asarray(X)
+        for block in _iter_row_blocks(Xd, block_rows):
+            s1 += np.asarray(_dense_block_sum(jnp.asarray(block, dtype=jnp.float32)),
+                             dtype=np.float64)
+        mean = s1 / n
+        mean_d = jnp.asarray(mean, dtype=jnp.float32)
+        ssq = np.zeros((g,), dtype=np.float64)
+        for block in _iter_row_blocks(Xd, block_rows):
+            ssq += np.asarray(
+                _dense_block_centered_sq(jnp.asarray(block, dtype=jnp.float32), mean_d),
+                dtype=np.float64)
+    var = np.maximum(ssq / n, 0.0)
+    if ddof:
+        var = var * (n / (n - ddof))
+    return mean, var
+
+
+def row_sums(X, block_rows: int = _BLOCK_ROWS) -> np.ndarray:
+    """Per-row totals (counts per cell)."""
+    n = X.shape[0]
+    out = np.empty((n,), dtype=np.float64)
+    if sp.issparse(X):
+        X = X.tocsr()
+        # reduceat over indptr is a cheap O(nnz) host pass; row totals are a
+        # bookkeeping quantity, not a compute hot spot.
+        out[:] = np.add.reduceat(
+            np.append(X.data.astype(np.float64), 0.0), X.indptr[:-1]
+        ) * (np.diff(X.indptr) > 0)
+    else:
+        for i, block in enumerate(_iter_row_blocks(np.asarray(X), block_rows)):
+            start = i * block_rows
+            out[start : start + block.shape[0]] = np.asarray(
+                jnp.asarray(block, dtype=jnp.float32).sum(axis=1), dtype=np.float64
+            )
+    return out
+
+
+def normalize_total(adata, target_sum: float = 1e6, inplace: bool = False):
+    """Scale each cell to ``target_sum`` total counts.
+
+    Equivalent of ``compute_tpm``'s ``sc.pp.normalize_total(tpm, 1e6)``
+    (``cnmf.py:241-247``). Cells with zero total are left at zero.
+    Returns a new ``AnnDataLite`` unless ``inplace``.
+    """
+    from ..utils.anndata_lite import AnnDataLite
+
+    totals = row_sums(adata.X)
+    scale = np.where(totals > 0, target_sum / np.where(totals > 0, totals, 1.0), 1.0)
+    if sp.issparse(adata.X):
+        Xcsr = adata.X.tocsr()
+        per_nnz = np.repeat(scale, np.diff(Xcsr.indptr))
+        X = sp.csr_matrix(
+            (Xcsr.data.astype(np.float32) * per_nnz.astype(np.float32),
+             Xcsr.indices, Xcsr.indptr),
+            shape=Xcsr.shape,
+        )
+    else:
+        X = np.asarray(adata.X, dtype=np.float32) * scale[:, None].astype(np.float32)
+    if inplace:
+        adata.X = X
+        return adata
+    return AnnDataLite(X, adata.obs.copy(), adata.var.copy())
+
+
+def scale_columns(X, ddof: int = 1, zero_std_to_one: bool = True):
+    """Scale columns to unit variance WITHOUT centering.
+
+    ``zero_std_to_one=True`` mirrors ``sc.pp.scale(zero_center=False)``
+    (sparse path, ``cnmf.py:675``) which maps zero-variance genes to an
+    unchanged column; ``False`` mirrors the reference's dense path
+    (``cnmf.py:679``) where division by a zero std produces NaN (the
+    reference only warns). Returns (scaled matrix, std vector).
+    """
+    _, var = column_mean_var(X, ddof=ddof)
+    std = np.sqrt(var)
+    div = std.copy()
+    if zero_std_to_one:
+        div[div == 0] = 1.0
+    if sp.issparse(X):
+        Xcsr = X.tocsr()
+        with np.errstate(divide="ignore", invalid="ignore"):
+            data = Xcsr.data / div[Xcsr.indices]
+        out = sp.csr_matrix((data, Xcsr.indices.copy(), Xcsr.indptr.copy()), shape=Xcsr.shape)
+    else:
+        with np.errstate(divide="ignore", invalid="ignore"):
+            out = np.asarray(X) / div[None, :]
+    return out, std

@@ -1,0 +1,23 @@
+from .anndata_lite import AnnDataLite, read_h5ad, write_h5ad
+from .io import (
+    check_dir_exists,
+    load_counts,
+    load_df_from_npz,
+    read_10x_mtx,
+    save_df_to_npz,
+    save_df_to_text,
+)
+from .paths import build_paths
+
+__all__ = [
+    "AnnDataLite",
+    "read_h5ad",
+    "write_h5ad",
+    "check_dir_exists",
+    "load_counts",
+    "load_df_from_npz",
+    "read_10x_mtx",
+    "save_df_to_npz",
+    "save_df_to_text",
+    "build_paths",
+]

@@ -1,0 +1,240 @@
+"""Minimal AnnData-compatible container with h5ad (HDF5) persistence.
+
+The reference pipeline stores every matrix-shaped intermediate as an ``.h5ad``
+AnnData file written by scanpy (``/root/reference/src/cnmf/cnmf.py:545, 698``).
+The ``anndata``/``scanpy`` packages are not dependencies of this framework, so
+this module provides a small, spec-conformant subset of the AnnData on-disk
+format (v0.1.0 "anndata" encoding): enough for real anndata to read our files
+and for us to read files written by anndata/scanpy (dense or CSR/CSC ``X``,
+``obs``/``var`` dataframes with string / numeric / categorical columns).
+
+Only the features the cNMF pipeline needs are implemented: ``X``, ``obs``,
+``var``, name-based and boolean column/row subsetting, and copy semantics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+import scipy.sparse as sp
+
+__all__ = ["AnnDataLite", "read_h5ad", "write_h5ad"]
+
+
+class AnnDataLite:
+    """cells x genes matrix with obs (cell) and var (gene) annotations.
+
+    Mirrors the subset of :class:`anndata.AnnData` used by the reference
+    pipeline (construction from ``X``/``obs``/``var``, ``adata[:, genes]``
+    subsetting at ``cnmf.py:670``, ``adata.X`` mutation, ``.copy()``).
+    """
+
+    def __init__(self, X, obs: pd.DataFrame | None = None, var: pd.DataFrame | None = None):
+        if sp.issparse(X):
+            X = X.tocsr()
+        else:
+            X = np.asarray(X)
+        self.X = X
+        n, g = X.shape
+        if obs is None:
+            obs = pd.DataFrame(index=pd.Index([str(i) for i in range(n)]))
+        if var is None:
+            var = pd.DataFrame(index=pd.Index([str(i) for i in range(g)]))
+        if len(obs.index) != n:
+            raise ValueError(f"obs has {len(obs.index)} rows but X has {n}")
+        if len(var.index) != g:
+            raise ValueError(f"var has {len(var.index)} rows but X has {g}")
+        self.obs = obs
+        self.var = var
+
+    # -- basic protocol ----------------------------------------------------
+    @property
+    def shape(self):
+        return self.X.shape
+
+    @property
+    def n_obs(self):
+        return self.X.shape[0]
+
+    @property
+    def n_vars(self):
+        return self.X.shape[1]
+
+    @property
+    def obs_names(self) -> pd.Index:
+        return self.obs.index
+
+    @property
+    def var_names(self) -> pd.Index:
+        return self.var.index
+
+    def copy(self) -> "AnnDataLite":
+        return AnnDataLite(self.X.copy(), self.obs.copy(), self.var.copy())
+
+    def _resolve_idx(self, key, index: pd.Index, axis_len: int):
+        """Convert a row/column selector into a positional indexer."""
+        if isinstance(key, slice):
+            return key
+        key = np.asarray(key) if not np.isscalar(key) else np.asarray([key])
+        if key.dtype == bool:
+            if key.shape[0] != axis_len:
+                raise IndexError("boolean mask length mismatch")
+            return np.where(key)[0]
+        if key.dtype.kind in "iu":
+            return key
+        # name-based lookup (list of obs/var names)
+        locs = index.get_indexer(pd.Index(key))
+        if (locs < 0).any():
+            missing = list(pd.Index(key)[locs < 0][:5])
+            raise KeyError(f"names not found in axis: {missing}")
+        return locs
+
+    def __getitem__(self, key) -> "AnnDataLite":
+        if not isinstance(key, tuple):
+            key = (key, slice(None))
+        rows = self._resolve_idx(key[0], self.obs.index, self.n_obs)
+        cols = self._resolve_idx(key[1], self.var.index, self.n_vars)
+        X = self.X[rows, :][:, cols]
+        return AnnDataLite(X, self.obs.iloc[rows], self.var.iloc[cols])
+
+    def __repr__(self):
+        kind = "sparse" if sp.issparse(self.X) else "dense"
+        return f"AnnDataLite(n_obs={self.n_obs}, n_vars={self.n_vars}, X={kind})"
+
+    def write(self, filename: str):
+        write_h5ad(filename, self)
+
+
+# -- h5ad persistence ------------------------------------------------------
+
+def _str_dtype():
+    import h5py
+
+    return h5py.string_dtype(encoding="utf-8")
+
+
+def _write_string_array(group, name, values):
+    ds = group.create_dataset(name, data=np.asarray(values, dtype=object), dtype=_str_dtype())
+    ds.attrs["encoding-type"] = "string-array"
+    ds.attrs["encoding-version"] = "0.2.0"
+    return ds
+
+
+def _write_dataframe(parent, name: str, df: pd.DataFrame):
+    g = parent.create_group(name)
+    g.attrs["encoding-type"] = "dataframe"
+    g.attrs["encoding-version"] = "0.2.0"
+    index_name = df.index.name or "_index"
+    g.attrs["_index"] = index_name
+    g.attrs["column-order"] = np.asarray(list(df.columns), dtype=object) if len(df.columns) else np.asarray([], dtype=_str_dtype())
+    _write_string_array(g, index_name, df.index.astype(str).values)
+    for col in df.columns:
+        vals = df[col].values
+        if vals.dtype.kind in "OUS":
+            _write_string_array(g, str(col), pd.array(vals).astype(str))
+        else:
+            ds = g.create_dataset(str(col), data=np.asarray(vals))
+            ds.attrs["encoding-type"] = "array"
+            ds.attrs["encoding-version"] = "0.2.0"
+
+
+def _write_X(parent, X):
+    if sp.issparse(X):
+        X = X.tocsr()
+        g = parent.create_group("X")
+        g.attrs["encoding-type"] = "csr_matrix"
+        g.attrs["encoding-version"] = "0.1.0"
+        g.attrs["shape"] = np.asarray(X.shape, dtype=np.int64)
+        g.create_dataset("data", data=X.data, compression="gzip", compression_opts=1)
+        g.create_dataset("indices", data=X.indices, compression="gzip", compression_opts=1)
+        g.create_dataset("indptr", data=X.indptr, compression="gzip", compression_opts=1)
+    else:
+        ds = parent.create_dataset("X", data=np.asarray(X), compression="gzip", compression_opts=1)
+        ds.attrs["encoding-type"] = "array"
+        ds.attrs["encoding-version"] = "0.2.0"
+
+
+def write_h5ad(filename: str, adata: AnnDataLite):
+    import h5py
+
+    with h5py.File(filename, "w") as f:
+        f.attrs["encoding-type"] = "anndata"
+        f.attrs["encoding-version"] = "0.1.0"
+        _write_X(f, adata.X)
+        _write_dataframe(f, "obs", adata.obs)
+        _write_dataframe(f, "var", adata.var)
+        for aux in ("uns", "obsm", "varm", "obsp", "varp", "layers"):
+            g = f.create_group(aux)
+            g.attrs["encoding-type"] = "dict"
+            g.attrs["encoding-version"] = "0.1.0"
+
+
+def _decode(v):
+    if isinstance(v, bytes):
+        return v.decode("utf-8")
+    return v
+
+
+def _read_array_like(node):
+    """Read a dataset or encoded group (categorical / nullable) as a 1-D array."""
+    import h5py
+
+    if isinstance(node, h5py.Dataset):
+        vals = node[()]
+        if vals.dtype.kind in "OS":
+            vals = np.asarray([_decode(v) for v in vals], dtype=object)
+        return vals
+    enc = _decode(node.attrs.get("encoding-type", ""))
+    if enc == "categorical":
+        codes = node["codes"][()]
+        cats = _read_array_like(node["categories"])
+        out = pd.Categorical.from_codes(codes, categories=pd.Index(cats))
+        return out
+    if enc in ("nullable-integer", "nullable-boolean"):
+        values = node["values"][()]
+        mask = node["mask"][()]
+        arr = values.astype(object)
+        arr[mask.astype(bool)] = None
+        return arr
+    raise ValueError(f"unsupported h5ad column encoding: {enc!r}")
+
+
+def _read_dataframe(g) -> pd.DataFrame:
+    index_name = _decode(g.attrs.get("_index", "_index"))
+    idx = pd.Index(_read_array_like(g[index_name]))
+    col_order = [_decode(c) for c in g.attrs.get("column-order", [])]
+    cols = {}
+    for col in col_order:
+        if col in g:
+            cols[col] = _read_array_like(g[col])
+    df = pd.DataFrame(cols, index=idx)
+    if index_name != "_index":
+        df.index.name = index_name
+    return df
+
+
+def _read_X(node):
+    import h5py
+
+    if isinstance(node, h5py.Dataset):
+        return node[()]
+    enc = _decode(node.attrs.get("encoding-type", ""))
+    shape = tuple(node.attrs["shape"])
+    data = node["data"][()]
+    indices = node["indices"][()]
+    indptr = node["indptr"][()]
+    if enc == "csr_matrix":
+        return sp.csr_matrix((data, indices, indptr), shape=shape)
+    if enc == "csc_matrix":
+        return sp.csc_matrix((data, indices, indptr), shape=shape).tocsr()
+    raise ValueError(f"unsupported X encoding: {enc!r}")
+
+
+def read_h5ad(filename: str) -> AnnDataLite:
+    import h5py
+
+    with h5py.File(filename, "r") as f:
+        X = _read_X(f["X"])
+        obs = _read_dataframe(f["obs"]) if "obs" in f else None
+        var = _read_dataframe(f["var"]) if "var" in f else None
+    return AnnDataLite(X, obs, var)

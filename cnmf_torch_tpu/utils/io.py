@@ -1,0 +1,132 @@
+"""Artifact serializers and count-matrix loaders.
+
+The DataFrame-as-npz container is the reference pipeline's universal
+intermediate format (``/root/reference/src/cnmf/cnmf.py:32-41``): a compressed
+``.npz`` holding ``data``, ``index``, and ``columns`` arrays. We keep the
+byte-level format identical so artifacts are interchangeable between the two
+implementations (and the reference's golden-file test style applies directly).
+
+Count loading mirrors ``cNMF.prepare``'s dispatch on file extension
+(``cnmf.py:518-537``): ``.h5ad``, 10x ``.mtx``/``.mtx.gz`` directories,
+``.df.npz`` DataFrames, and tab-delimited text.
+"""
+
+from __future__ import annotations
+
+import errno
+import gzip
+import os
+
+import numpy as np
+import pandas as pd
+import scipy.io
+import scipy.sparse as sp
+
+from .anndata_lite import AnnDataLite, read_h5ad, write_h5ad
+
+__all__ = [
+    "save_df_to_npz",
+    "save_df_to_text",
+    "load_df_from_npz",
+    "check_dir_exists",
+    "read_10x_mtx",
+    "load_counts",
+    "read_h5ad",
+    "write_h5ad",
+    "AnnDataLite",
+]
+
+
+def save_df_to_npz(obj: pd.DataFrame, filename: str):
+    """Byte-compatible with the reference serializer (``cnmf.py:32-33``)."""
+    np.savez_compressed(
+        filename,
+        data=obj.values,
+        index=obj.index.values,
+        columns=obj.columns.values,
+    )
+
+
+def save_df_to_text(obj: pd.DataFrame, filename: str):
+    obj.to_csv(filename, sep="\t")
+
+
+def load_df_from_npz(filename: str) -> pd.DataFrame:
+    with np.load(filename, allow_pickle=True) as f:
+        obj = pd.DataFrame(**f)
+    return obj
+
+
+def check_dir_exists(path: str):
+    """mkdir -p semantics (``cnmf.py:43-51``)."""
+    try:
+        os.makedirs(path)
+    except OSError as exc:
+        if exc.errno != errno.EEXIST:
+            raise
+
+
+def _open_maybe_gz(path: str, mode="rt"):
+    if path.endswith(".gz"):
+        return gzip.open(path, mode)
+    return open(path, mode)
+
+
+def _find_10x_sidecar(counts_dir: str, stems) -> str | None:
+    for stem in stems:
+        for suffix in ("", ".gz"):
+            p = os.path.join(counts_dir, stem + suffix)
+            if os.path.exists(p):
+                return p
+    return None
+
+
+def read_10x_mtx(path: str) -> AnnDataLite:
+    """Load a 10x-Genomics-format mtx directory (``sc.read_10x_mtx`` contract,
+    used at ``cnmf.py:520-522``): ``matrix.mtx[.gz]`` plus
+    ``features.tsv[.gz]``/``genes.tsv[.gz]`` and ``barcodes.tsv[.gz]``.
+
+    The matrix on disk is genes x cells; returns cells x genes CSR.
+    """
+    mtx_fn = _find_10x_sidecar(path, ["matrix.mtx"])
+    if mtx_fn is None:
+        raise FileNotFoundError(f"no matrix.mtx[.gz] in {path}")
+    X = scipy.io.mmread(mtx_fn).T.tocsr()
+
+    genes_fn = _find_10x_sidecar(path, ["features.tsv", "genes.tsv"])
+    barcodes_fn = _find_10x_sidecar(path, ["barcodes.tsv"])
+    if genes_fn is None or barcodes_fn is None:
+        raise FileNotFoundError(f"missing features/genes or barcodes tsv in {path}")
+
+    genes = pd.read_csv(genes_fn, sep="\t", header=None)
+    barcodes = pd.read_csv(barcodes_fn, sep="\t", header=None)
+    # 10x feature files carry [id, symbol, (type)]; index by symbol when
+    # available, matching scanpy's default var_names='gene_symbols' fallback
+    # to unique ids. We use symbols if present else ids.
+    sym_col = 1 if genes.shape[1] > 1 else 0
+    var = pd.DataFrame({"gene_ids": genes.iloc[:, 0].values} if genes.shape[1] > 1 else {},
+                       index=pd.Index(genes.iloc[:, sym_col].astype(str).values))
+    obs = pd.DataFrame(index=pd.Index(barcodes.iloc[:, 0].astype(str).values))
+    return AnnDataLite(X, obs=obs, var=var)
+
+
+def load_counts(counts_fn: str, densify: bool = False) -> AnnDataLite:
+    """Extension-dispatched counts loader (``cnmf.py:518-541``)."""
+    if counts_fn.endswith(".h5ad"):
+        adata = read_h5ad(counts_fn)
+    elif counts_fn.endswith(".mtx") or counts_fn.endswith(".mtx.gz"):
+        adata = read_10x_mtx(os.path.dirname(counts_fn))
+    else:
+        if counts_fn.endswith(".npz"):
+            df = load_df_from_npz(counts_fn)
+        else:
+            df = pd.read_csv(counts_fn, sep="\t", index_col=0)
+        X = df.values if densify else sp.csr_matrix(df.values)
+        adata = AnnDataLite(
+            X,
+            obs=pd.DataFrame(index=df.index),
+            var=pd.DataFrame(index=df.columns),
+        )
+    if sp.issparse(adata.X) and densify:
+        adata.X = np.asarray(adata.X.todense())
+    return adata

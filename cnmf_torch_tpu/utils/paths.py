@@ -1,0 +1,61 @@
+"""Artifact path registry.
+
+Reproduces the reference's 25-key templated path dict
+(``/root/reference/src/cnmf/cnmf.py:416-455``) byte-for-byte in the
+filenames so outputs are drop-in interchangeable: intermediates live in
+``output_dir/name/cnmf_tmp/`` and final artifacts in ``output_dir/name/``.
+The filesystem remains the durable checkpoint/output layer of the pipeline
+(every stage's outputs are its checkpoint); on-device collectives replace it
+only as the *communication* layer between replicates.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .io import check_dir_exists
+
+__all__ = ["build_paths"]
+
+
+def build_paths(output_dir: str, name: str, create: bool = True) -> dict:
+    if create:
+        check_dir_exists(output_dir)
+        check_dir_exists(os.path.join(output_dir, name))
+        check_dir_exists(os.path.join(output_dir, name, "cnmf_tmp"))
+
+    tmp = os.path.join(output_dir, name, "cnmf_tmp")
+    top = os.path.join(output_dir, name)
+    return {
+        "normalized_counts": os.path.join(tmp, name + ".norm_counts.h5ad"),
+        "nmf_replicate_parameters": os.path.join(tmp, name + ".nmf_params.df.npz"),
+        "nmf_run_parameters": os.path.join(tmp, name + ".nmf_idvrun_params.yaml"),
+        "nmf_genes_list": os.path.join(top, name + ".overdispersed_genes.txt"),
+
+        "tpm": os.path.join(tmp, name + ".tpm.h5ad"),
+        "tpm_stats": os.path.join(tmp, name + ".tpm_stats.df.npz"),
+
+        "iter_spectra": os.path.join(tmp, name + ".spectra.k_%d.iter_%d.df.npz"),
+        "iter_usages": os.path.join(tmp, name + ".usages.k_%d.iter_%d.df.npz"),
+        "merged_spectra": os.path.join(tmp, name + ".spectra.k_%d.merged.df.npz"),
+
+        "local_density_cache": os.path.join(tmp, name + ".local_density_cache.k_%d.merged.df.npz"),
+        "consensus_spectra": os.path.join(tmp, name + ".spectra.k_%d.dt_%s.consensus.df.npz"),
+        "consensus_spectra__txt": os.path.join(top, name + ".spectra.k_%d.dt_%s.consensus.txt"),
+        "consensus_usages": os.path.join(tmp, name + ".usages.k_%d.dt_%s.consensus.df.npz"),
+        "consensus_usages__txt": os.path.join(top, name + ".usages.k_%d.dt_%s.consensus.txt"),
+
+        "consensus_stats": os.path.join(tmp, name + ".stats.k_%d.dt_%s.df.npz"),
+
+        "clustering_plot": os.path.join(top, name + ".clustering.k_%d.dt_%s.png"),
+        "gene_spectra_score": os.path.join(tmp, name + ".gene_spectra_score.k_%d.dt_%s.df.npz"),
+        "gene_spectra_score__txt": os.path.join(top, name + ".gene_spectra_score.k_%d.dt_%s.txt"),
+        "gene_spectra_tpm": os.path.join(tmp, name + ".gene_spectra_tpm.k_%d.dt_%s.df.npz"),
+        "gene_spectra_tpm__txt": os.path.join(top, name + ".gene_spectra_tpm.k_%d.dt_%s.txt"),
+
+        "starcat_spectra": os.path.join(tmp, name + ".starcat_spectra.k_%d.dt_%s.df.npz"),
+        "starcat_spectra__txt": os.path.join(top, name + ".starcat_spectra.k_%d.dt_%s.txt"),
+
+        "k_selection_plot": os.path.join(top, name + ".k_selection.png"),
+        "k_selection_stats": os.path.join(top, name + ".k_selection_stats.df.npz"),
+    }

@@ -1,0 +1,35 @@
+import os
+
+# Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths are
+# exercised without TPU hardware (SURVEY.md §4 "what the reference lacks").
+# NOTE: this environment pre-imports jax at interpreter startup (axon
+# sitecustomize) with jax_platforms='axon,cpu', so env vars are too late —
+# the config must be updated through jax.config before any backend is
+# initialized. Override with CNMF_TEST_PLATFORM=tpu to run on hardware.
+import jax  # noqa: E402
+
+if os.environ.get("CNMF_TEST_PLATFORM", "cpu") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+import scipy.sparse as sp  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture()
+def counts_100x500():
+    """The reference's synthetic smoke fixture: binomial counts with seed 42
+    (test_prepare.py:10-14)."""
+    np.random.seed(42)
+    return np.random.binomial(100, 0.01, size=(100, 500)).astype(np.float64)
+
+
+@pytest.fixture()
+def sparse_counts_100x500(counts_100x500):
+    return sp.csr_matrix(counts_100x500)

@@ -1,0 +1,127 @@
+import numpy as np
+import pandas as pd
+import pytest
+import scipy.sparse as sp
+
+from cnmf_torch_tpu.utils import (
+    AnnDataLite,
+    build_paths,
+    load_counts,
+    load_df_from_npz,
+    read_h5ad,
+    save_df_to_npz,
+    write_h5ad,
+)
+
+
+def _df(rng):
+    return pd.DataFrame(
+        rng.random((5, 3)),
+        index=[f"cell{i}" for i in range(5)],
+        columns=[f"g{j}" for j in range(3)],
+    )
+
+
+def test_df_npz_roundtrip(tmp_path, rng):
+    df = _df(rng)
+    fn = str(tmp_path / "x.df.npz")
+    save_df_to_npz(df, fn)
+    back = load_df_from_npz(fn)
+    pd.testing.assert_frame_equal(df, back)
+
+
+def test_df_npz_reference_layout(tmp_path, rng):
+    # the on-disk container must keep the reference's three-array layout
+    # (cnmf.py:32-33) so artifacts interchange between implementations
+    df = _df(rng)
+    fn = str(tmp_path / "x.df.npz")
+    save_df_to_npz(df, fn)
+    with np.load(fn, allow_pickle=True) as f:
+        assert set(f.files) == {"data", "index", "columns"}
+        np.testing.assert_array_equal(f["index"], df.index.values)
+
+
+@pytest.mark.parametrize("sparse", [True, False])
+def test_h5ad_roundtrip(tmp_path, rng, sparse):
+    X = rng.random((10, 4)).astype(np.float32)
+    if sparse:
+        X = sp.csr_matrix(np.where(X > 0.5, X, 0))
+    obs = pd.DataFrame({"batch": ["a", "b"] * 5}, index=[f"c{i}" for i in range(10)])
+    var = pd.DataFrame(index=[f"g{i}" for i in range(4)])
+    adata = AnnDataLite(X, obs, var)
+    fn = str(tmp_path / "x.h5ad")
+    write_h5ad(fn, adata)
+    back = read_h5ad(fn)
+    assert back.shape == (10, 4)
+    assert list(back.obs_names) == list(obs.index)
+    assert list(back.var_names) == list(var.index)
+    assert list(back.obs["batch"]) == list(obs["batch"])
+    A = back.X.toarray() if sp.issparse(back.X) else back.X
+    B = X.toarray() if sp.issparse(X) else X
+    np.testing.assert_allclose(A, B, rtol=1e-6)
+
+
+def test_h5ad_interop_with_anndata_spec(tmp_path, rng):
+    # files we write should carry the anndata encoding attrs
+    import h5py
+
+    X = sp.csr_matrix(rng.random((6, 5)))
+    fn = str(tmp_path / "spec.h5ad")
+    write_h5ad(fn, AnnDataLite(X))
+    with h5py.File(fn) as f:
+        assert f["X"].attrs["encoding-type"] == "csr_matrix"
+        assert f["obs"].attrs["encoding-type"] == "dataframe"
+        assert tuple(f["X"].attrs["shape"]) == (6, 5)
+
+
+def test_subsetting_by_names_and_mask(rng):
+    X = rng.random((6, 4))
+    adata = AnnDataLite(X, var=pd.DataFrame(index=["a", "b", "c", "d"]))
+    sub = adata[:, ["c", "a"]]
+    assert list(sub.var_names) == ["c", "a"]
+    np.testing.assert_allclose(sub.X, X[:, [2, 0]])
+    mask = np.array([True, False, True, False, False, True])
+    sub2 = adata[mask, :]
+    assert sub2.shape == (3, 4)
+
+
+def test_load_counts_tsv_and_npz(tmp_path, rng):
+    df = _df(rng)
+    tsv = str(tmp_path / "c.tsv")
+    df.to_csv(tsv, sep="\t")
+    adata = load_counts(tsv)
+    assert sp.issparse(adata.X)
+    np.testing.assert_allclose(np.asarray(adata.X.todense()), df.values)
+
+    npz = str(tmp_path / "c.df.npz")
+    save_df_to_npz(df, npz)
+    adata2 = load_counts(npz, densify=True)
+    assert not sp.issparse(adata2.X)
+    np.testing.assert_allclose(adata2.X, df.values)
+
+
+def test_load_counts_10x_mtx(tmp_path, rng):
+    import scipy.io
+
+    X = sp.random(7, 5, density=0.5, random_state=0, format="coo")
+    d = tmp_path / "tenx"
+    d.mkdir()
+    scipy.io.mmwrite(str(d / "matrix.mtx"), X.T)  # genes x cells on disk
+    pd.DataFrame({0: [f"ENSG{i}" for i in range(5)], 1: [f"G{i}" for i in range(5)],
+                  2: ["Gene Expression"] * 5}).to_csv(d / "features.tsv", sep="\t",
+                                                      header=False, index=False)
+    pd.DataFrame({0: [f"BC{i}" for i in range(7)]}).to_csv(d / "barcodes.tsv", sep="\t",
+                                                           header=False, index=False)
+    adata = load_counts(str(d / "matrix.mtx"))
+    assert adata.shape == (7, 5)
+    assert list(adata.var_names) == [f"G{i}" for i in range(5)]
+    np.testing.assert_allclose(np.asarray(adata.X.todense()), X.toarray(), rtol=1e-6)
+
+
+def test_paths_registry(tmp_path):
+    paths = build_paths(str(tmp_path), "run1")
+    assert len(paths) == 24  # every key of the reference registry (cnmf.py:423-455)
+    assert paths["iter_spectra"] % (7, 3) == str(
+        tmp_path / "run1" / "cnmf_tmp" / "run1.spectra.k_7.iter_3.df.npz"
+    )
+    assert (tmp_path / "run1" / "cnmf_tmp").is_dir()

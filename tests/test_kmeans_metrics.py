@@ -1,0 +1,84 @@
+import numpy as np
+import pytest
+
+from cnmf_torch_tpu.ops import (
+    kmeans,
+    local_density,
+    pairwise_euclidean,
+    silhouette_score,
+)
+
+
+def _blobs(n_per=40, k=4, d=12, seed=0, spread=0.05):
+    rng = np.random.default_rng(seed)
+    centers = rng.random((k, d)) * 3
+    X = np.concatenate([
+        centers[i] + spread * rng.standard_normal((n_per, d)) for i in range(k)
+    ]).astype(np.float32)
+    labels = np.repeat(np.arange(k), n_per)
+    return X, labels
+
+
+def test_pairwise_euclidean_matches_sklearn():
+    from sklearn.metrics import euclidean_distances
+
+    X, _ = _blobs()
+    D = pairwise_euclidean(X)
+    np.testing.assert_allclose(D, euclidean_distances(X), rtol=1e-3, atol=2e-3)
+    assert (np.diag(D) == 0).all()
+
+
+def test_local_density_matches_reference_math():
+    # the reference's argpartition construction (cnmf.py:1065-1070)
+    X, _ = _blobs(n_per=30, k=3)
+    n_neighbors = 9
+    dens, D = local_density(X, n_neighbors)
+
+    from sklearn.metrics import euclidean_distances
+
+    topics_dist = euclidean_distances(X)
+    order = np.argpartition(topics_dist, n_neighbors + 1)[:, : n_neighbors + 1]
+    dist_to_nn = topics_dist[np.arange(topics_dist.shape[0])[:, None], order]
+    expected = dist_to_nn.sum(1) / n_neighbors
+    np.testing.assert_allclose(dens, expected, rtol=1e-3, atol=1e-4)
+
+
+def test_kmeans_recovers_blobs():
+    X, true = _blobs()
+    labels, centers, inertia = kmeans(X, 4, n_init=10, seed=1)
+    # perfect cluster recovery up to label permutation
+    for c in range(4):
+        members = labels[true == c]
+        assert len(set(members.tolist())) == 1
+    # determinism with the same seed
+    labels2, _, inertia2 = kmeans(X, 4, n_init=10, seed=1)
+    np.testing.assert_array_equal(labels, labels2)
+    assert inertia == inertia2
+
+
+def test_kmeans_inertia_close_to_sklearn():
+    from sklearn.cluster import KMeans
+
+    X, _ = _blobs(n_per=50, k=5, spread=0.4)
+    _, _, inertia = kmeans(X, 5, n_init=10, seed=1)
+    sk = KMeans(n_clusters=5, n_init=10, random_state=1).fit(X)
+    assert inertia <= sk.inertia_ * 1.02
+
+
+def test_silhouette_matches_sklearn():
+    from sklearn.metrics import silhouette_score as sk_sil
+
+    X, labels = _blobs(n_per=25, k=4, spread=0.5)
+    ours = silhouette_score(X, labels, k=4)
+    theirs = sk_sil(X, labels, metric="euclidean")
+    np.testing.assert_allclose(ours, theirs, rtol=1e-3, atol=1e-4)
+
+
+def test_silhouette_with_kmeans_labels():
+    from sklearn.metrics import silhouette_score as sk_sil
+
+    X, _ = _blobs(n_per=30, k=3, spread=0.8)
+    labels, _, _ = kmeans(X, 3, seed=1)
+    ours = silhouette_score(X, labels, k=3)
+    theirs = sk_sil(X, np.asarray(labels), metric="euclidean")
+    np.testing.assert_allclose(ours, theirs, rtol=1e-3, atol=1e-4)

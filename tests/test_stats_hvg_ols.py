@@ -1,0 +1,162 @@
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from cnmf_torch_tpu.ops import (
+    column_mean_var,
+    highvar_genes,
+    normalize_total,
+    ols_all_cols,
+    row_sums,
+    scale_columns,
+)
+from cnmf_torch_tpu.utils import AnnDataLite
+
+
+@pytest.mark.parametrize("sparse", [True, False])
+@pytest.mark.parametrize("ddof", [0, 1])
+def test_column_mean_var_matches_numpy(counts_100x500, sparse, ddof):
+    X = sp.csr_matrix(counts_100x500) if sparse else counts_100x500
+    mean, var = column_mean_var(X, ddof=ddof)
+    np.testing.assert_allclose(mean, counts_100x500.mean(axis=0), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(var, counts_100x500.var(axis=0, ddof=ddof), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("sparse", [True, False])
+def test_column_mean_var_large_mean_stability(sparse):
+    # TPM-scale columns (mean ~1e4, std ~10): the naive E[x^2]-E[x]^2 form
+    # in fp32 returns 0-112 for a true variance of 100
+    rng = np.random.default_rng(3)
+    X = rng.normal(1e4, 10.0, size=(2000, 8))
+    Xin = sp.csr_matrix(X) if sparse else X
+    mean, var = column_mean_var(Xin, ddof=0)
+    np.testing.assert_allclose(mean, X.mean(axis=0), rtol=1e-5)
+    np.testing.assert_allclose(var, X.var(axis=0, ddof=0), rtol=1e-2)
+
+
+def test_column_mean_var_blocked(counts_100x500):
+    # block streaming must give the same answer as one shot
+    X = sp.csr_matrix(counts_100x500)
+    m1, v1 = column_mean_var(X)
+    m2, v2 = column_mean_var(X, block_rows=17)
+    np.testing.assert_allclose(m1, m2, rtol=1e-5)
+    np.testing.assert_allclose(v1, v2, rtol=1e-4, atol=1e-5)
+
+
+def test_column_mean_var_matches_sklearn_standard_scaler(sparse_counts_100x500):
+    # the reference's get_mean_var (cnmf.py:128-131) is StandardScaler-based
+    from sklearn.preprocessing import StandardScaler
+
+    scaler = StandardScaler(with_mean=False).fit(sparse_counts_100x500)
+    mean, var = column_mean_var(sparse_counts_100x500, ddof=0)
+    np.testing.assert_allclose(mean, scaler.mean_, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(var, scaler.var_, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("sparse", [True, False])
+def test_row_sums(counts_100x500, sparse):
+    X = sp.csr_matrix(counts_100x500) if sparse else counts_100x500
+    np.testing.assert_allclose(row_sums(X), counts_100x500.sum(axis=1), rtol=1e-5)
+
+
+def test_row_sums_with_empty_rows():
+    X = sp.csr_matrix(np.array([[0, 0], [1, 2], [0, 0], [3, 0], [0, 0]], dtype=float))
+    np.testing.assert_allclose(row_sums(X), [0, 3, 0, 3, 0])
+
+
+@pytest.mark.parametrize("sparse", [True, False])
+def test_normalize_total(counts_100x500, sparse):
+    X = sp.csr_matrix(counts_100x500) if sparse else counts_100x500
+    adata = AnnDataLite(X)
+    tpm = normalize_total(adata, target_sum=1e6)
+    got = np.asarray(tpm.X.todense()) if sp.issparse(tpm.X) else tpm.X
+    sums = got.sum(axis=1)
+    nonzero = counts_100x500.sum(axis=1) > 0
+    np.testing.assert_allclose(sums[nonzero], 1e6, rtol=1e-4)
+
+
+@pytest.mark.parametrize("sparse", [True, False])
+def test_scale_columns_unit_variance(counts_100x500, sparse):
+    X = sp.csr_matrix(counts_100x500) if sparse else counts_100x500
+    scaled, std = scale_columns(X, ddof=1)
+    got = np.asarray(scaled.todense()) if sp.issparse(scaled) else scaled
+    expected_std = counts_100x500.std(axis=0, ddof=1)
+    nz = expected_std > 0
+    np.testing.assert_allclose(got[:, nz].std(axis=0, ddof=1), 1.0, rtol=1e-4)
+    np.testing.assert_allclose(std, expected_std, rtol=1e-4, atol=1e-6)
+    # zero-variance columns pass through unchanged (scanpy semantics)
+    if (~nz).any():
+        np.testing.assert_allclose(got[:, ~nz], counts_100x500[:, ~nz])
+
+
+def _reference_hvg_math(X):
+    """The reference's dense HVG math (cnmf.py:188-238), in pandas/numpy."""
+    import pandas as pd
+
+    mean = pd.Series(X.mean(axis=0).astype(float))
+    var = pd.Series(X.var(ddof=0, axis=0).astype(float))
+    fano = var / mean
+    top_genes = mean.sort_values(ascending=False)[:20].index
+    A = (np.sqrt(var) / mean)[top_genes].min()
+    w_mean_low, w_mean_high = mean.quantile([0.10, 0.90])
+    w_fano_low, w_fano_high = fano.quantile([0.10, 0.90])
+    box = (fano > w_fano_low) & (fano < w_fano_high) & (mean > w_mean_low) & (mean < w_mean_high)
+    B = np.sqrt(fano[box].median())
+    expected_fano = (A ** 2) * mean + (B ** 2)
+    fano_ratio = fano / expected_fano
+    T = 1.0 + fano[box].std()
+    return mean, var, fano, expected_fano, fano_ratio, A, B, T
+
+
+@pytest.mark.parametrize("sparse", [True, False])
+def test_highvar_genes_matches_reference_math(counts_100x500, sparse):
+    X = sp.csr_matrix(counts_100x500) if sparse else counts_100x500
+    stats, params = highvar_genes(X, numgenes=100)
+    mean, var, fano, expected_fano, fano_ratio, A, B, T = _reference_hvg_math(counts_100x500)
+
+    np.testing.assert_allclose(stats["mean"], mean, rtol=1e-4)
+    np.testing.assert_allclose(stats["fano"].dropna(), fano.dropna(), rtol=1e-3)
+    np.testing.assert_allclose(params["A"], A, rtol=1e-3)
+    np.testing.assert_allclose(params["B"], B, rtol=1e-3)
+    assert stats["high_var"].sum() == 100
+    # the top-100 selection must match the reference ranking
+    ref_top = set(fano_ratio.sort_values(ascending=False).index[:100])
+    got_top = set(np.where(stats["high_var"].values)[0])
+    overlap = len(ref_top & got_top)
+    assert overlap >= 98  # fp32 vs fp64 may swap genes at the exact cutoff
+
+
+def test_highvar_genes_threshold_mode(counts_100x500):
+    stats, params = highvar_genes(counts_100x500)
+    _, _, _, _, fano_ratio, _, _, T = _reference_hvg_math(counts_100x500)
+    np.testing.assert_allclose(params["T"], T, rtol=1e-3)
+    mean = counts_100x500.mean(axis=0)
+    expected = (fano_ratio.values > params["T"]) & (mean > 0.5)
+    got = stats["high_var"].values
+    assert (expected == got).mean() > 0.99
+
+
+@pytest.mark.parametrize("sparse", [True, False])
+@pytest.mark.parametrize("normalize_y", [True, False])
+@pytest.mark.parametrize("precision", ["float64", "float32"])
+def test_ols_matches_reference(counts_100x500, sparse, normalize_y, precision):
+    rng = np.random.default_rng(0)
+    X = rng.random((100, 7))
+    Y = sp.csr_matrix(counts_100x500) if sparse else counts_100x500
+
+    beta = ols_all_cols(X, Y, batch_size=33, normalize_y=normalize_y,
+                        precision=precision)
+
+    Yd = counts_100x500.copy()
+    if normalize_y:
+        m = Yd.mean(axis=0)
+        v = np.maximum(Yd.var(axis=0, ddof=0), 1e-12)
+        Yd = (Yd - m) / np.sqrt(v)
+    expected, *_ = np.linalg.lstsq(X.T @ X, X.T @ Yd, rcond=None)
+    if precision == "float64":
+        # must clear the reference's golden-file RMS bar (1e-4)
+        rms = np.sqrt(np.mean((beta - expected) ** 2))
+        assert rms < 1e-6
+    else:
+        # fp32 path: conditioning amplifies rounding; still close
+        np.testing.assert_allclose(beta, expected, rtol=0.05, atol=0.01)
