@@ -1,0 +1,3 @@
+from .cnmf import cNMF, compute_tpm
+
+__all__ = ["cNMF", "compute_tpm"]

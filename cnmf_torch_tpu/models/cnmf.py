@@ -1,0 +1,695 @@
+"""The consensus-NMF pipeline: prepare -> factorize -> combine -> consensus.
+
+API- and artifact-compatible reimplementation of the reference ``cNMF`` class
+(``/root/reference/src/cnmf/cnmf.py:390-1384``) on the JAX/XLA compute stack:
+
+  * the five pipeline stages, the 25-key path registry, the replicate seed
+    ledger, and every on-disk artifact keep the reference's exact contract
+    (filenames, DataFrame-npz layout, seed derivation) so outputs are
+    drop-in interchangeable and golden-file testable;
+  * execution is TPU-first: ``factorize`` runs each K's replicates as ONE
+    batched, mesh-sharded XLA program (``cnmf_torch_tpu.parallel``) instead
+    of the reference's one-process-per-replicate model, and every consensus
+    kernel (distances, KNN density, k-means, silhouette, MU refits, batched
+    OLS) is a jit-compiled op from ``cnmf_torch_tpu.ops``.
+
+The filesystem remains the durable checkpoint layer (every stage's outputs
+are its checkpoint, SURVEY.md §1.1/§5.4); collectives replace it only as the
+live communication path between replicates.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import uuid
+import warnings
+
+import numpy as np
+import pandas as pd
+import scipy.sparse as sp
+import yaml
+
+from ..ops import (
+    highvar_genes,
+    kmeans,
+    local_density as knn_local_density,
+    normalize_total,
+    ols_all_cols,
+    scale_columns,
+    silhouette_score,
+)
+from ..ops.nmf import beta_loss_to_float, fit_h, run_nmf
+from ..parallel import replicate_sweep, worker_filter
+from ..utils.anndata_lite import AnnDataLite, read_h5ad, write_h5ad
+from ..utils.io import (
+    load_counts,
+    load_df_from_npz,
+    save_df_to_npz,
+    save_df_to_text,
+)
+from ..utils.paths import build_paths
+
+__all__ = ["cNMF"]
+
+
+def compute_tpm(input_counts: AnnDataLite) -> AnnDataLite:
+    """Per-cell scaling to 1e6 total counts (``cnmf.py:241-247``)."""
+    return normalize_total(input_counts, target_sum=1e6)
+
+
+class cNMF:
+    """Consensus NMF pipeline over an output-directory artifact store.
+
+    Same constructor contract as the reference (``cnmf.py:393-414``):
+    unnamed runs get ``YYYY_MM_DD_<6-hex>`` names; all artifacts live under
+    ``output_dir/name/`` with intermediates in ``cnmf_tmp/``.
+    """
+
+    def __init__(self, output_dir: str = ".", name: str | None = None):
+        self.output_dir = output_dir
+        if name is None:
+            now = datetime.datetime.now()
+            name = "%s_%s" % (now.strftime("%Y_%m_%d"), uuid.uuid4().hex[:6])
+        self.name = name
+        self.paths = build_paths(output_dir, name)
+
+    # ------------------------------------------------------------------
+    # prepare
+    # ------------------------------------------------------------------
+
+    def prepare(self, counts_fn, components, n_iter=100, densify=False,
+                tpm_fn=None, seed=None, beta_loss="frobenius",
+                num_highvar_genes=2000, genes_file=None, alpha_usage=0.0,
+                alpha_spectra=0.0, init="random", total_workers=-1,
+                use_gpu=False, batch_size=5000, max_NMF_iter=1000):
+        """Load counts, select HVGs, variance-normalize, and write the
+        replicate ledger + solver config (``cnmf.py:458-596``).
+
+        ``use_gpu`` is accepted for contract compatibility; device placement
+        is JAX's job (the flag is persisted to the YAML so artifacts stay
+        comparable with reference runs).
+        """
+        input_counts = load_counts(counts_fn, densify=densify)
+
+        if tpm_fn is None:
+            tpm = compute_tpm(input_counts)
+            write_h5ad(self.paths["tpm"], tpm)
+        elif tpm_fn.endswith(".h5ad") or tpm_fn.endswith(".mtx") or tpm_fn.endswith(".mtx.gz"):
+            tpm = load_counts(tpm_fn, densify=False)
+            write_h5ad(self.paths["tpm"], tpm)
+        else:
+            tpm = load_counts(tpm_fn, densify=densify)
+            write_h5ad(self.paths["tpm"], tpm)
+
+        # per-gene TPM mean/std, population moments (ddof=0) on both the
+        # sparse and dense paths (cnmf.py:570-580)
+        from ..ops.stats import column_mean_var
+
+        gene_tpm_mean, gene_tpm_var = column_mean_var(tpm.X, ddof=0)
+        input_tpm_stats = pd.DataFrame(
+            [gene_tpm_mean, np.sqrt(gene_tpm_var)],
+            index=["__mean", "__std"], columns=tpm.var.index,
+        ).T
+        save_df_to_npz(input_tpm_stats, self.paths["tpm_stats"])
+
+        if genes_file is not None:
+            highvargenes = open(genes_file).read().rstrip().split("\n")
+        else:
+            highvargenes = None
+
+        norm_counts = self.get_norm_counts(
+            input_counts, tpm, num_highvar_genes=num_highvar_genes,
+            high_variance_genes_filter=highvargenes)
+        self.save_norm_counts(norm_counts)
+
+        replicate_params, run_params = self.get_nmf_iter_params(
+            ks=components, n_iter=n_iter, random_state_seed=seed,
+            beta_loss=beta_loss, alpha_usage=alpha_usage,
+            alpha_spectra=alpha_spectra, init=init,
+            total_workers=total_workers, use_gpu=use_gpu,
+            batch_size=batch_size, max_iter=max_NMF_iter)
+        self.save_nmf_iter_params(replicate_params, run_params)
+
+    def get_norm_counts(self, counts, tpm, high_variance_genes_filter=None,
+                        num_highvar_genes=None):
+        """HVG subset + unit-variance gene scaling WITHOUT centering
+        (``cnmf.py:624-698``); raises on cells with zero HVG counts."""
+        if high_variance_genes_filter is None:
+            gene_stats, _ = highvar_genes(tpm.X, numgenes=num_highvar_genes)
+            high_variance_genes_filter = list(
+                tpm.var.index[gene_stats.high_var.values])
+
+        norm_counts = counts[:, high_variance_genes_filter].copy()
+        norm_counts.X = norm_counts.X.astype(np.float64)
+
+        if sp.issparse(tpm.X):
+            # sparse path: zero-variance genes pass through unchanged
+            # (sc.pp.scale semantics, cnmf.py:675)
+            norm_counts.X, _ = scale_columns(norm_counts.X, ddof=1,
+                                             zero_std_to_one=True)
+            if np.isnan(norm_counts.X.data).sum() > 0:
+                print("Warning NaNs in normalized counts matrix")
+        else:
+            # dense path: division by a zero std produces NaN; the reference
+            # only warns (cnmf.py:679)
+            norm_counts.X, _ = scale_columns(norm_counts.X, ddof=1,
+                                             zero_std_to_one=False)
+            if np.isnan(norm_counts.X).sum().sum() > 0:
+                print("Warning NaNs in normalized counts matrix")
+
+        with open(self.paths["nmf_genes_list"], "w") as f:
+            f.write("\n".join(high_variance_genes_filter))
+
+        zerocells = np.asarray(norm_counts.X.sum(axis=1) == 0).reshape(-1)
+        if zerocells.sum() > 0:
+            examples = norm_counts.obs.index[np.ravel(zerocells)]
+            raise Exception(
+                "Error: %d cells have zero counts of overdispersed genes. "
+                "E.g. %s. Filter those cells and re-run or adjust the number "
+                "of overdispersed genes. Quitting!"
+                % (zerocells.sum(), ", ".join(examples[:4])))
+        return norm_counts
+
+    def save_norm_counts(self, norm_counts):
+        write_h5ad(self.paths["normalized_counts"], norm_counts)
+
+    # ------------------------------------------------------------------
+    # replicate ledger + solver config
+    # ------------------------------------------------------------------
+
+    def get_nmf_iter_params(self, ks, n_iter=100, random_state_seed=None,
+                            beta_loss="kullback-leibler", alpha_usage=0.0,
+                            alpha_spectra=0.0, init="random",
+                            total_workers=-1, use_gpu=False, batch_size=5000,
+                            max_iter=1000):
+        """Cartesian (K x iter) task ledger with derived per-run seeds and
+        the persisted solver kwargs (``cnmf.py:701-777``).
+
+        Seed derivation is pinned to the reference exactly (the golden tests
+        compare [n_components, iter, nmf_seed] element-wise,
+        ``tests/test_reproducibility.py:160-165``): a master-seeded
+        ``np.random.randint(1, 2**31-1)`` draw of ``len(ks) * n_iter`` values
+        consumed in ``product(sorted(set(ks)), range(n_iter))`` order. The
+        draw length uses the *unsorted, undeduped* ks — reproducing the
+        reference's over-draw so seeds match even for duplicate-K input.
+        """
+        if isinstance(ks, int):
+            ks = [ks]
+        k_list = sorted(set(list(ks)))
+
+        n_runs = len(ks) * n_iter
+        np.random.seed(seed=random_state_seed)
+        nmf_seeds = np.random.randint(low=1, high=(2 ** 31) - 1, size=n_runs)
+
+        import itertools
+
+        replicate_params = []
+        for i, (k, r) in enumerate(itertools.product(k_list, range(n_iter))):
+            completed = os.path.exists(self.paths["iter_spectra"] % (k, r))
+            replicate_params.append([k, r, nmf_seeds[i], completed])
+        replicate_params = pd.DataFrame(
+            replicate_params,
+            columns=["n_components", "iter", "nmf_seed", "completed"])
+
+        n_completed = replicate_params["completed"].sum()
+        if n_completed > 0:
+            warnings.warn(
+                "{n} runs already appear completed. If this is unexpected, "
+                "consider re-initializing the cnmf object with a different "
+                "run name or output directory".format(n=n_completed),
+                UserWarning)
+
+        # the persisted solver-kwargs schema is golden-tested by the
+        # reference (recursive dict equality); key set and values match
+        # cnmf.py:757-771 — alpha_W/alpha_H are switched w.r.t. sklearn
+        _nmf_kwargs = dict(
+            alpha_W=alpha_spectra,
+            alpha_H=alpha_usage,
+            l1_ratio_H=0.0,
+            l1_ratio_W=0.0,
+            beta_loss=beta_loss,
+            algo="mu",
+            tol=1e-4,
+            mode="online",
+            online_chunk_max_iter=max_iter,
+            online_chunk_size=batch_size,
+            init=init,
+            n_jobs=total_workers,
+            use_gpu=use_gpu,
+        )
+        return replicate_params, _nmf_kwargs
+
+    def update_nmf_iter_params(self):
+        """Re-probe iter_spectra files to refresh the completed column
+        (``cnmf.py:780-795``). Must not run while factorize workers are
+        active (undocumented reference invariant, SURVEY.md §5.2)."""
+        _nmf_kwargs = yaml.load(open(self.paths["nmf_run_parameters"]),
+                                Loader=yaml.FullLoader)
+        replicate_params = load_df_from_npz(
+            self.paths["nmf_replicate_parameters"])
+        for i in replicate_params.index:
+            replicate_params.at[i, "completed"] = os.path.exists(
+                self.paths["iter_spectra"]
+                % (replicate_params.at[i, "n_components"],
+                   replicate_params.at[i, "iter"]))
+        remaining = (replicate_params["completed"] == False).sum()  # noqa: E712
+        print("{n} NMF runs are currently incomplete".format(n=remaining))
+        self.save_nmf_iter_params(replicate_params, _nmf_kwargs)
+
+    def save_nmf_iter_params(self, replicate_params, run_params):
+        save_df_to_npz(replicate_params,
+                       self.paths["nmf_replicate_parameters"])
+        with open(self.paths["nmf_run_parameters"], "w") as f:
+            yaml.dump(run_params, f)
+
+    # ------------------------------------------------------------------
+    # factorize
+    # ------------------------------------------------------------------
+
+    def _nmf(self, X, nmf_kwargs):
+        """Single-replicate solve; returns ``(spectra, usages)``
+        (``cnmf.py:805-821``)."""
+        kwargs = {k: v for k, v in nmf_kwargs.items() if k != "n_jobs"}
+        usages, spectra, _err = run_nmf(X, **kwargs)
+        return spectra, usages
+
+    def factorize(self, worker_i=0, total_workers=1,
+                  skip_completed_runs=False, batched=True, mesh=None,
+                  replicates_per_batch=None):
+        """Run this worker's share of the replicate ledger.
+
+        Contract-compatible with the reference (``cnmf.py:839-892``):
+        round-robin ``worker_filter`` sharding, per-(k, iter) spectra files.
+
+        TPU-first execution (``batched=True``, the default): tasks are
+        grouped per K and each group runs as ONE vmapped XLA call, sharded
+        over ``mesh`` when given (defaults to all local devices) — the
+        reference's outer Python process loop becomes a batched device
+        program. ``batched=False`` preserves the sequential per-task path.
+        """
+        run_params = load_df_from_npz(self.paths["nmf_replicate_parameters"])
+        norm_counts = read_h5ad(self.paths["normalized_counts"])
+        _nmf_kwargs = yaml.load(open(self.paths["nmf_run_parameters"]),
+                                Loader=yaml.FullLoader)
+
+        if not skip_completed_runs:
+            jobs = worker_filter(range(len(run_params)), worker_i,
+                                 total_workers)
+        else:
+            jobs = worker_filter(
+                run_params.index[run_params["completed"] == False],  # noqa: E712
+                worker_i, total_workers)
+        jobs = list(jobs)
+
+        if not batched:
+            for idx in jobs:
+                p = run_params.iloc[idx, :]
+                print("[Worker %d]. Starting task %d." % (worker_i, idx))
+                kwargs = dict(_nmf_kwargs)
+                kwargs["random_state"] = p["nmf_seed"]
+                kwargs["n_components"] = p["n_components"]
+                spectra, _usages = self._nmf(norm_counts.X, kwargs)
+                spectra = pd.DataFrame(
+                    spectra,
+                    index=np.arange(1, kwargs["n_components"] + 1),
+                    columns=norm_counts.var.index)
+                save_df_to_npz(
+                    spectra,
+                    self.paths["iter_spectra"] % (p["n_components"], p["iter"]))
+            return
+
+        if mesh is None:
+            from ..parallel import default_mesh
+
+            mesh = default_mesh()
+
+        X = norm_counts.X
+        if sp.issparse(X):
+            X = X.toarray()
+        X = np.asarray(X, dtype=np.float32)
+
+        by_k: dict[int, list] = {}
+        for idx in jobs:
+            p = run_params.iloc[idx, :]
+            by_k.setdefault(int(p["n_components"]), []).append(
+                (int(p["iter"]), int(p["nmf_seed"])))
+
+        for k, tasks in sorted(by_k.items()):
+            iters = [t[0] for t in tasks]
+            seeds = [t[1] for t in tasks]
+            print("[Worker %d]. Running %d replicates for k=%d as one "
+                  "batched program." % (worker_i, len(tasks), k))
+            spectra, _usages, _errs = replicate_sweep(
+                X, seeds, k,
+                beta_loss=_nmf_kwargs["beta_loss"],
+                init=_nmf_kwargs["init"],
+                mode=_nmf_kwargs.get("mode", "online"),
+                tol=_nmf_kwargs.get("tol", 1e-4),
+                online_chunk_size=_nmf_kwargs.get("online_chunk_size", 5000),
+                online_chunk_max_iter=_nmf_kwargs.get(
+                    "online_chunk_max_iter", 1000),
+                alpha_W=_nmf_kwargs.get("alpha_W", 0.0),
+                l1_ratio_W=_nmf_kwargs.get("l1_ratio_W", 0.0),
+                alpha_H=_nmf_kwargs.get("alpha_H", 0.0),
+                l1_ratio_H=_nmf_kwargs.get("l1_ratio_H", 0.0),
+                mesh=mesh, replicates_per_batch=replicates_per_batch)
+            for r, it in enumerate(iters):
+                df = pd.DataFrame(spectra[r],
+                                  index=np.arange(1, k + 1),
+                                  columns=norm_counts.var.index)
+                save_df_to_npz(df, self.paths["iter_spectra"] % (k, it))
+
+    # ------------------------------------------------------------------
+    # combine
+    # ------------------------------------------------------------------
+
+    def combine(self, components=None, skip_missing_files=False):
+        if isinstance(components, int):
+            ks = [components]
+        elif components is None:
+            run_params = load_df_from_npz(
+                self.paths["nmf_replicate_parameters"])
+            ks = sorted(set(run_params.n_components))
+        else:
+            ks = components
+        for k in ks:
+            self.combine_nmf(k, skip_missing_files=skip_missing_files)
+
+    def combine_nmf(self, k, skip_missing_files=False):
+        """Stack per-iter spectra into the merged (n_iter*k x genes) matrix
+        with ``iter%d_topic%d`` row labels (``cnmf.py:895-920``); tolerates
+        dead-worker gaps when ``skip_missing_files``."""
+        import errno
+
+        run_params = load_df_from_npz(self.paths["nmf_replicate_parameters"])
+        print("Combining factorizations for k=%d." % k)
+        subset = run_params[run_params.n_components == k].sort_values("iter")
+        combined = []
+        for _, p in subset.iterrows():
+            fn = self.paths["iter_spectra"] % (p["n_components"], p["iter"])
+            if not os.path.exists(fn):
+                if not skip_missing_files:
+                    print("Missing file: %s, run with skip_missing=True to "
+                          "override" % fn)
+                    raise FileNotFoundError(errno.ENOENT,
+                                            os.strerror(errno.ENOENT), fn)
+                print("Missing file: %s. Skipping." % fn)
+                continue
+            spectra = load_df_from_npz(fn)
+            spectra.index = ["iter%d_topic%d" % (p["iter"], t + 1)
+                             for t in range(k)]
+            combined.append(spectra)
+        if combined:
+            combined = pd.concat(combined, axis=0)
+            save_df_to_npz(combined, self.paths["merged_spectra"] % k)
+            return combined
+        print("No spectra found for k=%d" % k)
+        return combined
+
+    # ------------------------------------------------------------------
+    # refits
+    # ------------------------------------------------------------------
+
+    def refit_usage(self, X, spectra, usage=None):
+        """Fixed-spectra usage refit via the jitted MU H-solver
+        (``cnmf.py:923-976`` -> :func:`cnmf_torch_tpu.ops.nmf.fit_h`).
+        The H-subproblem is convex, so the fixed-key random init gives a
+        deterministic result where the reference's unseeded torch init did
+        not."""
+        kwargs = yaml.load(open(self.paths["nmf_run_parameters"]),
+                           Loader=yaml.FullLoader)
+        beta = beta_loss_to_float(kwargs["beta_loss"])
+        if isinstance(X, pd.DataFrame):
+            X = X.values
+        if isinstance(spectra, pd.DataFrame):
+            spectra = spectra.values
+        return fit_h(
+            X, np.asarray(spectra),
+            H_init=None if usage is None else np.asarray(usage),
+            chunk_size=int(kwargs["online_chunk_size"]),
+            chunk_max_iter=int(kwargs["online_chunk_max_iter"]),
+            h_tol=0.05,
+            l1_reg_H=float(kwargs["l1_ratio_H"]),
+            l2_reg_H=0.0,
+            beta=beta)
+
+    def refit_spectra(self, X, usage):
+        """Transpose trick (``cnmf.py:979-994``)."""
+        return self.refit_usage(X.T, np.asarray(usage).T).T
+
+    # ------------------------------------------------------------------
+    # consensus
+    # ------------------------------------------------------------------
+
+    def consensus(self, k, density_threshold=0.5,
+                  local_neighborhood_size=0.30, show_clustering=True,
+                  build_ref=True, skip_density_and_return_after_stats=False,
+                  close_clustergram_fig=False, refit_usage=True,
+                  normalize_tpm_spectra=False, norm_counts=None):
+        """Consensus spectra/usages from the merged replicate matrix
+        (``cnmf.py:997-1256``): L2-normalize, KNN local-density outlier
+        filter (cached), k-means(k, 10 inits, fixed key), cluster medians,
+        usage refits, TPM- and z-score-unit spectra, artifacts + clustergram.
+        """
+        merged_spectra = load_df_from_npz(self.paths["merged_spectra"] % k)
+        if norm_counts is None:
+            norm_counts = read_h5ad(self.paths["normalized_counts"])
+
+        density_threshold_str = str(density_threshold)
+        if skip_density_and_return_after_stats:
+            density_threshold_str = "2"
+        density_threshold_repl = density_threshold_str.replace(".", "_")
+        n_neighbors = int(local_neighborhood_size
+                          * merged_spectra.shape[0] / k)
+
+        # L2-normalize rows (cnmf.py:1056)
+        l2_spectra = (merged_spectra.T
+                      / np.sqrt((merged_spectra ** 2).sum(axis=1))).T
+
+        topics_dist = None
+        density_filter = None
+        local_density = None
+        if not skip_density_and_return_after_stats:
+            if os.path.isfile(self.paths["local_density_cache"] % k):
+                local_density = load_df_from_npz(
+                    self.paths["local_density_cache"] % k)
+            else:
+                dens, topics_dist = knn_local_density(l2_spectra.values,
+                                                      n_neighbors)
+                local_density = pd.DataFrame(
+                    dens, columns=["local_density"], index=l2_spectra.index)
+                save_df_to_npz(local_density,
+                               self.paths["local_density_cache"] % k)
+
+            density_filter = local_density.iloc[:, 0] < density_threshold
+            l2_spectra = l2_spectra.loc[density_filter, :]
+            if l2_spectra.shape[0] == 0:
+                raise RuntimeError(
+                    "Zero components remain after density filtering. "
+                    "Consider increasing density threshold")
+
+        labels0, _centers, _inertia = kmeans(l2_spectra.values, k,
+                                             n_init=10, seed=1)
+        kmeans_cluster_labels = pd.Series(labels0 + 1,
+                                          index=l2_spectra.index)
+
+        # cluster medians, renormalized to probability distributions
+        # (cnmf.py:1087-1090)
+        median_spectra = l2_spectra.groupby(kmeans_cluster_labels).median()
+        median_spectra = (median_spectra.T / median_spectra.sum(axis=1)).T
+
+        rf_usages = self.refit_usage(norm_counts.X, median_spectra)
+        rf_usages = pd.DataFrame(rf_usages, index=norm_counts.obs.index,
+                                 columns=median_spectra.index)
+
+        if skip_density_and_return_after_stats:
+            silhouette = silhouette_score(l2_spectra.values, labels0, k)
+            prediction_error = _frobenius_prediction_error(
+                norm_counts.X, rf_usages.values, median_spectra.values)
+            consensus_stats = pd.DataFrame(
+                [k, density_threshold, silhouette, prediction_error],
+                index=["k", "local_density_threshold", "silhouette",
+                       "prediction_error"],
+                columns=["stats"])
+            return consensus_stats
+
+        # re-order GEPs by total contribution (cnmf.py:1113-1120)
+        norm_usages = rf_usages.div(rf_usages.sum(axis=1), axis=0)
+        reorder = norm_usages.sum(axis=0).sort_values(ascending=False)
+        rf_usages = rf_usages.loc[:, reorder.index]
+        norm_usages = norm_usages.loc[:, reorder.index]
+        median_spectra = median_spectra.loc[reorder.index, :]
+        rf_usages.columns = np.arange(1, rf_usages.shape[1] + 1)
+        norm_usages.columns = rf_usages.columns
+        median_spectra.index = rf_usages.columns
+
+        # TPM-unit spectra via the transposed refit (cnmf.py:1124-1129)
+        tpm = read_h5ad(self.paths["tpm"])
+        tpm_stats = load_df_from_npz(self.paths["tpm_stats"])
+        spectra_tpm = self.refit_spectra(
+            tpm.X, norm_usages.values.astype(np.float32))
+        spectra_tpm = pd.DataFrame(spectra_tpm, index=rf_usages.columns,
+                                   columns=tpm.var.index)
+        if normalize_tpm_spectra:
+            spectra_tpm = spectra_tpm.div(spectra_tpm.sum(axis=1),
+                                          axis=0) * 1e6
+
+        # z-score spectra: OLS of z-scored TPM against usages (cnmf.py:1132)
+        usage_coef = ols_all_cols(rf_usages.values, tpm.X, normalize_y=True)
+        usage_coef = pd.DataFrame(usage_coef, index=rf_usages.columns,
+                                  columns=tpm.var.index)
+
+        if refit_usage:
+            # final usage refit on std-scaled HVG TPM (cnmf.py:1135-1149)
+            hvgs = open(self.paths["nmf_genes_list"]).read().split("\n")
+            norm_tpm = tpm[:, hvgs].copy()
+            if sp.issparse(norm_tpm.X):
+                norm_tpm.X, _ = scale_columns(norm_tpm.X, ddof=1,
+                                              zero_std_to_one=True)
+            else:
+                norm_tpm.X, _ = scale_columns(norm_tpm.X, ddof=1,
+                                              zero_std_to_one=False)
+            spectra_tpm_rf = spectra_tpm.loc[:, hvgs]
+            spectra_tpm_rf = spectra_tpm_rf.div(
+                tpm_stats.loc[hvgs, "__std"], axis=1)
+            rf_usages = self.refit_usage(
+                norm_tpm.X, spectra_tpm_rf.values.astype(np.float32))
+            rf_usages = pd.DataFrame(rf_usages, index=norm_counts.obs.index,
+                                     columns=spectra_tpm_rf.index)
+
+        save_df_to_npz(median_spectra, self.paths["consensus_spectra"]
+                       % (k, density_threshold_repl))
+        save_df_to_npz(rf_usages, self.paths["consensus_usages"]
+                       % (k, density_threshold_repl))
+        save_df_to_text(median_spectra, self.paths["consensus_spectra__txt"]
+                        % (k, density_threshold_repl))
+        save_df_to_text(rf_usages, self.paths["consensus_usages__txt"]
+                        % (k, density_threshold_repl))
+        save_df_to_npz(spectra_tpm, self.paths["gene_spectra_tpm"]
+                       % (k, density_threshold_repl))
+        save_df_to_text(spectra_tpm, self.paths["gene_spectra_tpm__txt"]
+                        % (k, density_threshold_repl))
+        save_df_to_npz(usage_coef, self.paths["gene_spectra_score"]
+                       % (k, density_threshold_repl))
+        save_df_to_text(usage_coef, self.paths["gene_spectra_score__txt"]
+                        % (k, density_threshold_repl))
+
+        if show_clustering:
+            from .plots import clustergram
+
+            if topics_dist is None:
+                from ..ops import pairwise_euclidean
+
+                topics_dist = pairwise_euclidean(l2_spectra.values)
+            else:
+                topics_dist = topics_dist[density_filter.values, :][
+                    :, density_filter.values]
+            clustergram(
+                topics_dist, kmeans_cluster_labels, local_density,
+                density_filter, density_threshold,
+                self.paths["clustering_plot"] % (k, density_threshold_repl),
+                close_fig=close_clustergram_fig)
+
+        if build_ref:
+            self.build_reference(k, density_threshold)
+        return None
+
+    # ------------------------------------------------------------------
+    # downstream artifacts
+    # ------------------------------------------------------------------
+
+    def build_reference(self, k, density_threshold=0.5, target_sum=1e6):
+        """starCAT-compatible reference spectra (``cnmf.py:1259-1290``):
+        TPM spectra renormalized to ``target_sum`` per program, divided by
+        per-gene TPM std, subset to HVGs, rows labeled ``GEP%d``."""
+        dt_repl = str(density_threshold).replace(".", "_")
+        spectra_tpm = pd.read_csv(
+            self.paths["gene_spectra_tpm__txt"] % (k, dt_repl),
+            index_col=0, sep="\t")
+        hvgs = open(self.paths["nmf_genes_list"]).read().split("\n")
+        tpm_stats = load_df_from_npz(self.paths["tpm_stats"])
+        tpm_stats.index = spectra_tpm.columns
+
+        renorm = spectra_tpm.div(spectra_tpm.sum(axis=1), axis=0) * target_sum
+        varnorm = renorm.div(tpm_stats["__std"])
+        ref_spectra = varnorm[hvgs].copy()
+        ref_spectra.index = "GEP" + ref_spectra.index.astype("str")
+
+        save_df_to_npz(ref_spectra,
+                       self.paths["starcat_spectra"] % (k, dt_repl))
+        save_df_to_text(ref_spectra,
+                        self.paths["starcat_spectra__txt"] % (k, dt_repl))
+
+    def k_selection_plot(self, close_fig=False):
+        """Stability (silhouette) / error curve over the K sweep
+        (``cnmf.py:1293-1332``; method credit Alexandrov et al. 2013)."""
+        run_params = load_df_from_npz(self.paths["nmf_replicate_parameters"])
+        norm_counts = read_h5ad(self.paths["normalized_counts"])
+        stats = []
+        for k in sorted(set(run_params.n_components)):
+            stats.append(self.consensus(
+                int(k), skip_density_and_return_after_stats=True,
+                show_clustering=False, close_clustergram_fig=True,
+                norm_counts=norm_counts).stats)
+        stats = pd.DataFrame(stats)
+        stats.reset_index(drop=True, inplace=True)
+        save_df_to_npz(stats, self.paths["k_selection_stats"])
+
+        from .plots import k_selection_figure
+
+        k_selection_figure(stats, self.paths["k_selection_plot"],
+                           close_fig=close_fig)
+        return stats
+
+    def load_results(self, K, density_threshold, n_top_genes=100,
+                     norm_usage=True):
+        """Read final txt artifacts; returns
+        ``(usage, spectra_scores, spectra_tpm, top_genes)``
+        (``cnmf.py:1335-1384``)."""
+        dt_repl = str(density_threshold).replace(".", "_")
+        spectra_scores = pd.read_csv(
+            self.paths["gene_spectra_score__txt"] % (K, dt_repl),
+            sep="\t", index_col=0).T
+        spectra_tpm = pd.read_csv(
+            self.paths["gene_spectra_tpm__txt"] % (K, dt_repl),
+            sep="\t", index_col=0).T
+        usage = pd.read_csv(
+            self.paths["consensus_usages__txt"] % (K, dt_repl),
+            sep="\t", index_col=0)
+        if norm_usage:
+            usage = usage.div(usage.sum(axis=1), axis=0)
+        try:
+            usage.columns = [int(x) for x in usage.columns]
+        except ValueError:
+            print("Usage matrix columns include non integer values")
+
+        top_genes = []
+        for gep in spectra_scores.columns:
+            top_genes.append(list(
+                spectra_scores.sort_values(by=gep, ascending=False)
+                .index[:n_top_genes]))
+        top_genes = pd.DataFrame(top_genes,
+                                 index=spectra_scores.columns).T
+        return usage, spectra_scores, spectra_tpm, top_genes
+
+
+def _frobenius_prediction_error(X, H, W) -> float:
+    """||X - HW||_F^2 without materializing a dense cells x genes buffer for
+    sparse X: the trace identity needs only H^T X (k x g via sparse matmul),
+    H^T H, and ||X||^2 — the reference's ``todense()`` at cnmf.py:1100-1104
+    is its single most memory-hungry line (SURVEY.md §3.4). Float64
+    accumulation keeps the cancellation harmless."""
+    H = np.asarray(H, dtype=np.float64)
+    W = np.asarray(W, dtype=np.float64)
+    if sp.issparse(X):
+        x_sq = float((X.multiply(X)).sum())
+        HtX = np.asarray((X.T @ H).T)  # k x g
+    else:
+        Xd = np.asarray(X, dtype=np.float64)
+        x_sq = float((Xd * Xd).sum())
+        HtX = H.T @ Xd
+    cross = float(np.sum(HtX * W))
+    HtH = H.T @ H
+    hw_sq = float(np.sum((HtH @ W) * W))
+    return max(x_sq - 2.0 * cross + hw_sq, 0.0)

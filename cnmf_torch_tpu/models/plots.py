@@ -1,0 +1,133 @@
+"""Host-side diagnostic figures (matplotlib; plot-only, never a TPU kernel).
+
+Equivalents of the reference's consensus clustergram
+(``/root/reference/src/cnmf/cnmf.py:1160-1253``) and the twin-axis
+stability/error k-selection plot (``cnmf.py:1311-1331``). The within-cluster
+hierarchical leaf ordering uses scipy on host — it is O(n_iter^2) display
+work (SURVEY.md §2.3 flags it as acceptably host-side).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["clustergram", "k_selection_figure", "cluster_ordering"]
+
+
+def cluster_ordering(topics_dist: np.ndarray, cluster_labels) -> list[int]:
+    """Row order for the clustergram: clusters in label order, rows within a
+    cluster ordered by average-linkage hierarchical leaves
+    (``cnmf.py:1168-1184``)."""
+    from scipy.cluster.hierarchy import leaves_list, linkage
+    from scipy.spatial.distance import squareform
+
+    labels = np.asarray(cluster_labels)
+    order: list[int] = []
+    for cl in sorted(set(labels)):
+        members = np.where(labels == cl)[0]
+        if len(members) > 1:
+            cl_dist = squareform(topics_dist[np.ix_(members, members)],
+                                 checks=False)
+            cl_dist[cl_dist < 0] = 0.0
+            leaves = leaves_list(linkage(cl_dist, "average"))
+            order += list(members[leaves])
+        else:
+            order += list(members)
+    return order
+
+
+def clustergram(topics_dist, cluster_labels, local_density, density_filter,
+                density_threshold, out_png: str, close_fig: bool = False):
+    """Distance-matrix clustergram with cluster color strips and the local
+    density histogram + filter threshold annotation."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    from matplotlib import gridspec
+
+    labels = np.asarray(cluster_labels)
+    order = cluster_ordering(np.asarray(topics_dist), labels)
+    D = np.asarray(topics_dist)[np.ix_(order, order)]
+
+    width_ratios = [0.5, 9, 0.5, 4, 1]
+    height_ratios = [0.5, 9]
+    fig = plt.figure(figsize=(sum(width_ratios), sum(height_ratios)))
+    gs = gridspec.GridSpec(len(height_ratios), len(width_ratios), fig,
+                           0.01, 0.01, 0.98, 0.98,
+                           height_ratios=height_ratios,
+                           width_ratios=width_ratios, wspace=0, hspace=0)
+
+    dist_ax = fig.add_subplot(gs[1, 1], xticks=[], yticks=[], frameon=True)
+    dist_im = dist_ax.imshow(D, interpolation="none", cmap="viridis",
+                             aspect="auto", rasterized=True)
+
+    left_ax = fig.add_subplot(gs[1, 0], xticks=[], yticks=[], frameon=True)
+    left_ax.imshow(labels[order].reshape(-1, 1), interpolation="none",
+                   cmap="Spectral", aspect="auto", rasterized=True)
+    top_ax = fig.add_subplot(gs[0, 1], xticks=[], yticks=[], frameon=True)
+    top_ax.imshow(labels[order].reshape(1, -1), interpolation="none",
+                  cmap="Spectral", aspect="auto", rasterized=True)
+
+    hist_gs = gridspec.GridSpecFromSubplotSpec(3, 1, subplot_spec=gs[1, 3],
+                                               wspace=0, hspace=0)
+    hist_ax = fig.add_subplot(hist_gs[0, 0], frameon=True,
+                              title="Local density histogram")
+    if local_density is not None:
+        hist_ax.hist(np.asarray(local_density).ravel(),
+                     bins=np.linspace(0, 1, 50))
+        hist_ax.yaxis.tick_right()
+        xlim = hist_ax.get_xlim()
+        ylim = hist_ax.get_ylim()
+        if density_threshold < xlim[1]:
+            hist_ax.axvline(density_threshold, linestyle="--", color="k")
+            hist_ax.text(density_threshold + 0.02, ylim[1] * 0.95,
+                         "filtering\nthreshold\n\n", va="top")
+        hist_ax.set_xlim(xlim)
+        if density_filter is not None:
+            df = np.asarray(density_filter)
+            hist_ax.set_xlabel(
+                "Mean distance to k nearest neighbors\n\n"
+                "%d/%d (%.0f%%) spectra above threshold\nwere removed prior "
+                "to clustering" % ((~df).sum(), len(df), 100 * (~df).mean()))
+
+    cbar_gs = gridspec.GridSpecFromSubplotSpec(8, 1,
+                                               subplot_spec=hist_gs[1, 0],
+                                               wspace=0, hspace=0)
+    cbar_ax = fig.add_subplot(cbar_gs[4, 0], frameon=True,
+                              title="Euclidean Distance")
+    fig.colorbar(dist_im, cax=cbar_ax,
+                 ticks=np.linspace(D.min(), D.max(), 3),
+                 orientation="horizontal")
+
+    fig.savefig(out_png, dpi=250)
+    if close_fig:
+        plt.close(fig)
+    return fig
+
+
+def k_selection_figure(stats, out_png: str, close_fig: bool = False):
+    """Twin-axis stability (silhouette, left) / error (right) vs K."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig = plt.figure(figsize=(6, 4))
+    ax1 = fig.add_subplot(111)
+    ax2 = ax1.twinx()
+    ax1.plot(stats.k, stats.silhouette, "o-", color="b")
+    ax1.set_ylabel("Stability", color="b", fontsize=15)
+    for tl in ax1.get_yticklabels():
+        tl.set_color("b")
+    ax2.plot(stats.k, stats.prediction_error, "o-", color="r")
+    ax2.set_ylabel("Error", color="r", fontsize=15)
+    for tl in ax2.get_yticklabels():
+        tl.set_color("r")
+    ax1.set_xlabel("Number of Components", fontsize=15)
+    ax1.grid("on")
+    plt.tight_layout()
+    fig.savefig(out_png, dpi=250)
+    if close_fig:
+        plt.close(fig)
+    return fig

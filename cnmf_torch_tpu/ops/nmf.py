@@ -237,6 +237,26 @@ def nmf_fit_batch(X, H0, W0, beta: float = 2.0, tol: float = 1e-4,
 # online (streamed row-chunk) solver
 # ---------------------------------------------------------------------------
 
+def _solve_w_from_stats(W, A, B, l1_W, l2_W, max_iter, tol):
+    """Solve the (convex) W-subproblem by MU from the sufficient statistics
+    A = H^T X, B = H^T H alone — k x k / k x g work, no data pass. Shared by
+    the online solver's per-pass W update and the row-sharded solver (where
+    A and B arrive psum'd over shards)."""
+    def w_body(carry):
+        W, _, it = carry
+        W_new = _apply_rate(W, A, B @ W, l1_W, l2_W)
+        rel = jnp.linalg.norm(W_new - W) / (jnp.linalg.norm(W) + EPS)
+        return (W_new, rel, it + 1)
+
+    def w_cond(carry):
+        _, rel, it = carry
+        return (it < max_iter) & (rel >= tol)
+
+    rel0 = jnp.inf + 0.0 * jnp.sum(W)
+    W, _, _ = jax.lax.while_loop(w_cond, w_body, (W, rel0, jnp.int32(0)))
+    return W
+
+
 def _chunk_h_solve(x, h, W, WWT, beta, l1, l2, max_iter, h_tol):
     """Inner MU loop on one chunk's usage block with W fixed.
 
@@ -268,7 +288,11 @@ def _chunk_h_solve(x, h, W, WWT, beta, l1, l2, max_iter, h_tol):
         _, rel, it = carry
         return (it < max_iter) & (rel >= h_tol)
 
-    h, _, _ = jax.lax.while_loop(cond, body, (h, jnp.float32(jnp.inf), jnp.int32(0)))
+    # the initial `rel` is derived from h (not a literal) so its
+    # varying-manual-axes type matches the loop body's under shard_map,
+    # where h is device-varying; XLA folds the dead dependence otherwise
+    rel0 = jnp.inf + 0.0 * jnp.sum(h)
+    h, _, _ = jax.lax.while_loop(cond, body, (h, rel0, jnp.int32(0)))
     return h
 
 
@@ -320,19 +344,7 @@ def nmf_fit_online(Xc, Hc0, W0, beta: float = 2.0, tol: float = 1e-4,
             acc0 = (jnp.zeros((k, g), Xc.dtype), jnp.zeros((k, k), Xc.dtype),
                     jnp.float32(0.0))
             (A, B, err), Hc = jax.lax.scan(scan_chunk, acc0, (Xc, Hc))
-
-            def w_body(carry):
-                W, _, it = carry
-                W_new = _apply_rate(W, A, B @ W, l1_W, l2_W)
-                rel = jnp.linalg.norm(W_new - W) / (jnp.linalg.norm(W) + EPS)
-                return (W_new, rel, it + 1)
-
-            def w_cond(carry):
-                _, rel, it = carry
-                return (it < chunk_max_iter) & (rel >= h_tol)
-
-            W, _, _ = jax.lax.while_loop(
-                w_cond, w_body, (W, jnp.float32(jnp.inf), jnp.int32(0)))
+            W = _solve_w_from_stats(W, A, B, l1_W, l2_W, chunk_max_iter, h_tol)
         else:
             # true online flavor for the non-quadratic losses: each chunk's
             # usage block is solved with W frozen, then W takes one
@@ -373,8 +385,10 @@ def nmf_fit_online(Xc, Hc0, W0, beta: float = 2.0, tol: float = 1e-4,
         return (Hc, W, err, err_new, it + 1)
 
     def pass_cond(carry):
+        # it counts completed passes (the err0 pass is #1), so `it < n_passes`
+        # allows exactly n_passes total
         _, _, err_prev, err, it = carry
-        return (it < n_passes - 1) & ((err_prev - err) / jnp.maximum(err0, EPS) >= tol)
+        return (it < n_passes) & ((err_prev - err) / jnp.maximum(err0, EPS) >= tol)
 
     Hc, W, _, err, _ = jax.lax.while_loop(
         pass_cond, pass_body,

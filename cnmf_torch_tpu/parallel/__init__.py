@@ -1,0 +1,11 @@
+from .replicates import default_mesh, replicate_sweep, worker_filter
+from .rowshard import fit_h_rowsharded, nmf_fit_rowsharded, pad_rows_to_mesh
+
+__all__ = [
+    "default_mesh",
+    "replicate_sweep",
+    "worker_filter",
+    "fit_h_rowsharded",
+    "nmf_fit_rowsharded",
+    "pad_rows_to_mesh",
+]

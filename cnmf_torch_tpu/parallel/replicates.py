@@ -1,0 +1,173 @@
+"""Replicate-sweep execution — the reference's worker processes as one XLA program.
+
+The reference runs ``n_iter x |K|`` independent NMF replicates as separate OS
+processes, statically sharded by ``worker_filter`` and communicating through
+files (``/root/reference/src/cnmf/cnmf.py:53-54, 744-749, 839-892``). Here the
+replicate axis becomes a ``vmap`` dimension of one jit-compiled solver call,
+and device parallelism is a ``jax.sharding`` annotation over a 1-D mesh: XLA
+partitions the batched program across chips, with the data matrix replicated
+(it is shared, read-only input for every replicate) and the factor states
+sharded along the replicate axis. "combine" becomes an all-gather the runtime
+inserts when the host fetches the sharded spectra — no per-iteration files.
+
+K changes array shapes, so the sweep compiles once per K (SURVEY.md §7:
+per-K jit is the safe first cut); seeds only change data, never shapes.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.sparse as sp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.nmf import (
+    _chunk_rows,
+    beta_loss_to_float,
+    init_factors,
+    nmf_fit_batch,
+    nmf_fit_online,
+    random_init,
+)
+
+__all__ = ["replicate_sweep", "worker_filter", "default_mesh"]
+
+
+def worker_filter(iterable, worker_index: int, total_workers: int):
+    """Round-robin task partition, contract-identical to the reference
+    (``cnmf.py:53-54``): worker i takes every task whose position is
+    congruent to i modulo total_workers."""
+    return (p for i, p in enumerate(iterable)
+            if (i - worker_index) % total_workers == 0)
+
+
+def default_mesh(axis_name: str = "replicates") -> Mesh | None:
+    """1-D mesh over all local devices; None when a single device makes
+    sharding annotations pure overhead."""
+    devices = jax.devices()
+    if len(devices) <= 1:
+        return None
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def _stacked_inits(X, k: int, seeds, init: str):
+    """Per-replicate (H0, W0) stacks from the ledger's seed list.
+
+    ``init='random'`` vmaps the seeded init over replicate keys. The nndsvd
+    family is deterministic given X (as in the reference's solver, where
+    ``random_state`` does not perturb nndsvd), so it is computed once and
+    broadcast — replicate diversity then comes only from MU tie-breaking,
+    mirroring the reference's behavior for that init.
+    """
+    n, g = X.shape
+    if init == "random":
+        x_mean = jnp.mean(X)
+        keys = jnp.stack([jax.random.key(int(s) & 0x7FFFFFFF) for s in seeds])
+        return jax.vmap(lambda key: random_init(key, n, g, k, x_mean))(keys)
+    H0, W0 = init_factors(X, k, init, jax.random.key(int(seeds[0]) & 0x7FFFFFFF))
+    R = len(seeds)
+    return (jnp.broadcast_to(H0, (R, n, k)), jnp.broadcast_to(W0, (R, k, g)))
+
+
+def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random",
+                    mode: str = "online", tol: float = 1e-4,
+                    online_chunk_size: int = 5000,
+                    online_chunk_max_iter: int = 1000,
+                    batch_max_iter: int = 500, n_passes: int = 20,
+                    alpha_W: float = 0.0, l1_ratio_W: float = 0.0,
+                    alpha_H: float = 0.0, l1_ratio_H: float = 0.0,
+                    mesh: Mesh | None = None, return_usages: bool = False,
+                    replicates_per_batch: int | None = None):
+    """Run ``len(seeds)`` NMF replicates at one K as a batched XLA program.
+
+    Returns ``(spectra (R, k, g), usages (R, n, k) | None, errs (R,))`` as
+    numpy arrays, in ledger seed order — the in-memory equivalent of the
+    reference's per-(k, iter) spectra files (``cnmf.py:888-892``).
+
+    ``mesh``: optional 1-D device mesh; the replicate axis is sharded across
+    it (R is padded to a mesh multiple; pad replicates are computed and
+    dropped). ``replicates_per_batch`` bounds device memory by running the
+    sweep in host-level slices (each slice is still one XLA call).
+    """
+    if sp.issparse(X):
+        X = X.toarray()
+    X = jnp.asarray(np.asarray(X), dtype=jnp.float32)
+    n, g = X.shape
+    k = int(k)
+    beta = beta_loss_to_float(beta_loss)
+    seeds = list(seeds)
+    R = len(seeds)
+    if R == 0:
+        return (np.zeros((0, k, g), np.float32),
+                np.zeros((0, n, k), np.float32) if return_usages else None,
+                np.zeros((0,), np.float32))
+
+    l1_W = float(alpha_W) * float(l1_ratio_W)
+    l2_W = float(alpha_W) * (1.0 - float(l1_ratio_W))
+    l1_H = float(alpha_H) * float(l1_ratio_H)
+    l2_H = float(alpha_H) * (1.0 - float(l1_ratio_H))
+
+    if mode == "batch":
+        def solve(H0, W0):
+            return nmf_fit_batch(
+                X, H0, W0, beta=beta, tol=float(tol),
+                max_iter=int(batch_max_iter),
+                l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W)
+    elif mode == "online":
+        chunk = int(min(online_chunk_size, n))
+
+        def solve(H0, W0):
+            Xc, Hc, _ = _chunk_rows(X, H0, chunk)
+            Hc, W, err = nmf_fit_online(
+                Xc, Hc, W0, beta=beta, tol=float(tol),
+                chunk_max_iter=int(online_chunk_max_iter),
+                n_passes=int(n_passes),
+                l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W)
+            return Hc.reshape(-1, k)[:n], W, err
+    else:
+        raise ValueError(f"unknown mode {mode!r}")
+
+    sweep = jax.vmap(solve)
+
+    n_dev = 1 if mesh is None else math.prod(mesh.devices.shape)
+    if replicates_per_batch is None:
+        # bound per-slice device footprint: each replicate holds an n x k
+        # usage state plus solver temporaries of the same order; keep the
+        # whole slice (inputs + X + outputs) well under a single-chip HBM
+        budget_elems = 1 << 28  # ~1 GiB of fp32 state per slice
+        per_rep = 3 * (n * k + k * g) + n * k
+        replicates_per_batch = max(n_dev, int(budget_elems // max(per_rep, 1)))
+    # slices must stay mesh-multiples so every shard stays busy
+    replicates_per_batch = max(n_dev, (replicates_per_batch // n_dev) * n_dev)
+
+    spectra_out = np.empty((R, k, g), dtype=np.float32)
+    usages_out = np.empty((R, n, k), dtype=np.float32) if return_usages else None
+    errs_out = np.empty((R,), dtype=np.float32)
+
+    for start in range(0, R, replicates_per_batch):
+        sl = seeds[start:start + replicates_per_batch]
+        H0, W0 = _stacked_inits(X, k, sl, init)
+        r = len(sl)
+        pad = (-r) % n_dev
+        if pad:
+            # tile modulo r: works even when the slice is smaller than the
+            # mesh (pad replicates recompute existing seeds and are dropped)
+            idx = jnp.arange(r + pad) % r
+            H0 = H0[idx]
+            W0 = W0[idx]
+        if mesh is not None:
+            ax = mesh.axis_names[0]
+            rep_sharding = NamedSharding(mesh, P(ax))
+            H0 = jax.device_put(H0, NamedSharding(mesh, P(ax, None, None)))
+            W0 = jax.device_put(W0, NamedSharding(mesh, P(ax, None, None)))
+            del rep_sharding
+        H, W, err = sweep(H0, W0)
+        spectra_out[start:start + r] = np.asarray(W)[:r]
+        if return_usages:
+            usages_out[start:start + r] = np.asarray(H)[:r]
+        errs_out[start:start + r] = np.asarray(err)[:r]
+
+    return spectra_out, usages_out, errs_out

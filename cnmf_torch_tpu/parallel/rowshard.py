@@ -1,0 +1,204 @@
+"""Row-sharded (data-parallel) NMF over a device mesh — the atlas-scale path.
+
+The reference scales cells only by streaming 5,000-row chunks through one
+process (``/root/reference/src/cnmf/cnmf.py:765-767, 350-381``). The TPU
+analog (SURVEY.md §5.7, BASELINE.json config 5) shards the cells axis of the
+normalized matrix across the mesh and keeps the small factors replicated:
+
+  * H rows live with their X rows — the H-subproblem is embarrassingly
+    parallel (W is replicated, no communication).
+  * The W-subproblem needs only the k x g / k x k sufficient statistics
+    A = H^T X and B = H^T H, which are summed across shards with ``psum``
+    over ICI — bytes moved per pass are O(k·(g+k)), independent of cells.
+
+Implemented with ``shard_map`` so the collectives are explicit and the
+per-device program is exactly the single-chip kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.sparse as sp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..ops.nmf import (
+    EPS,
+    _apply_rate,
+    _beta_div_dense,
+    _chunk_h_solve,
+    _solve_w_from_stats,
+    beta_loss_to_float,
+    random_init,
+)
+
+__all__ = ["nmf_fit_rowsharded", "fit_h_rowsharded", "pad_rows_to_mesh"]
+
+
+def pad_rows_to_mesh(X, n_dev: int):
+    """Zero-pad the cells axis to a mesh multiple. Padded rows are benign:
+    their usage rows collapse to zero in one MU step and contribute nothing
+    to the psum'd statistics."""
+    n = X.shape[0]
+    pad = (-n) % n_dev
+    if pad:
+        if sp.issparse(X):
+            X = sp.vstack([X.tocsr(), sp.csr_matrix((pad, X.shape[1]), dtype=X.dtype)])
+        else:
+            X = np.pad(np.asarray(X), ((0, pad), (0, 0)))
+    return X, pad
+
+
+def _rowsharded_pass(X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
+                     l1_H, l2_H, l1_W, l2_W):
+    """One block-coordinate pass on this shard's rows + the global W update.
+
+    Runs identically on every device; `psum` makes the W statistics global,
+    so the replicated W stays bit-identical across shards.
+    """
+    WWT = W @ W.T if beta == 2.0 else None
+    H_local = _chunk_h_solve(X_local, H_local, W, WWT, beta, l1_H, l2_H,
+                             chunk_max_iter, h_tol)
+    if beta == 2.0:
+        A = jax.lax.psum(H_local.T @ X_local, axis)
+        B = jax.lax.psum(H_local.T @ H_local, axis)
+        W = _solve_w_from_stats(W, A, B, l1_W, l2_W, chunk_max_iter, h_tol)
+    else:
+        WH = jnp.maximum(H_local @ W, EPS)
+        if beta == 1.0:
+            numer = jax.lax.psum(H_local.T @ (X_local / WH), axis)
+            denom = jnp.broadcast_to(
+                jax.lax.psum(H_local.sum(axis=0), axis)[:, None], W.shape)
+        else:  # beta == 0.0 (itakura-saito)
+            numer = jax.lax.psum(H_local.T @ (X_local / (WH * WH)), axis)
+            denom = jax.lax.psum(H_local.T @ (1.0 / WH), axis)
+        W = _apply_rate(W, numer, denom, l1_W, l2_W)
+    # objective of the updated (H, W): the cancellation-safe per-element
+    # forms from _beta_div_dense (the naive KL/IS sums lose the O(u^2)
+    # near-convergence terms to fp32 cancellation, breaking the pass-loop
+    # convergence test)
+    err = jax.lax.psum(_beta_div_dense(X_local, H_local @ W, beta), axis)
+    return H_local, W, err
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "axis", "beta", "n_passes", "chunk_max_iter",
+                     "l1_H", "l2_H", "l1_W", "l2_W"),
+)
+def _fit_rowsharded_jit(X, H0, W0, mesh, axis, beta, tol, h_tol, n_passes,
+                        chunk_max_iter, l1_H, l2_H, l1_W, l2_W):
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), P()),
+        out_specs=(P(axis, None), P(), P()),
+    )
+    def run(X_local, H_local, W):
+        def body(carry):
+            H_local, W, err_prev, err, it = carry
+            H_local, W, err_new = _rowsharded_pass(
+                X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
+                l1_H, l2_H, l1_W, l2_W)
+            return (H_local, W, err, err_new, it + 1)
+
+        def cond(carry):
+            _, _, err_prev, err, it = carry
+            rel = (err_prev - err) / jnp.maximum(err_prev, EPS)
+            return (it < n_passes) & ((it < 2) | (rel >= tol))
+
+        H_local, W, err0 = _rowsharded_pass(
+            X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
+            l1_H, l2_H, l1_W, l2_W)
+        H_local, W, _, err, _ = jax.lax.while_loop(
+            cond, body,
+            (H_local, W, err0 * (1.0 + 2.0 * tol) + 1.0, err0, jnp.int32(1)))
+        return H_local, W, err[None]
+
+    H, W, err = run(X, H0, W0)
+    return H, W, err[0]
+
+
+def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
+                       seed: int = 0, tol: float = 1e-4, h_tol: float = 0.05,
+                       n_passes: int = 20, chunk_max_iter: int = 200,
+                       alpha_W: float = 0.0, l1_ratio_W: float = 0.0,
+                       alpha_H: float = 0.0, l1_ratio_H: float = 0.0):
+    """Factorize a cells-sharded X over ``mesh`` (1-D). Returns
+    ``(H (n,k), W (k,g), err)`` as numpy arrays.
+
+    The semantic contract matches the single-chip online solver (block
+    coordinate descent with tightly solved usage blocks and an exact
+    statistics-based W subproblem per pass); the shard boundary replaces the
+    chunk boundary as the streaming unit.
+    """
+    beta = beta_loss_to_float(beta_loss)
+    n_dev = math.prod(mesh.devices.shape)
+    axis = mesh.axis_names[0]
+    n_orig = X.shape[0]
+    if sp.issparse(X):
+        X = X.toarray()
+    X, _ = pad_rows_to_mesh(np.asarray(X), n_dev)
+    n, g = X.shape
+
+    key = jax.random.key(int(seed) & 0x7FFFFFFF)
+    H0, W0 = random_init(key, n, g, int(k), jnp.float32(np.mean(X)))
+
+    row_sh = NamedSharding(mesh, P(axis, None))
+    rep_sh = NamedSharding(mesh, P())
+    Xd = jax.device_put(jnp.asarray(X, jnp.float32), row_sh)
+    H0 = jax.device_put(H0, row_sh)
+    W0 = jax.device_put(W0, rep_sh)
+
+    l1_W = float(alpha_W) * float(l1_ratio_W)
+    l2_W = float(alpha_W) * (1.0 - float(l1_ratio_W))
+    l1_H = float(alpha_H) * float(l1_ratio_H)
+    l2_H = float(alpha_H) * (1.0 - float(l1_ratio_H))
+
+    H, W, err = _fit_rowsharded_jit(
+        Xd, H0, W0, mesh, axis, beta, jnp.float32(tol), jnp.float32(h_tol),
+        int(n_passes), int(chunk_max_iter), l1_H, l2_H, l1_W, l2_W)
+    return (np.asarray(H)[:n_orig], np.asarray(W), float(err))
+
+
+def fit_h_rowsharded(X, W, mesh: Mesh, h_tol: float = 0.05,
+                     chunk_max_iter: int = 200, l1_reg_H: float = 0.0,
+                     l2_reg_H: float = 0.0, beta=2.0, seed: int = 0):
+    """Row-sharded fixed-W usage refit: zero communication (W replicated,
+    every H row depends only on its own X row) — the distributed form of
+    ``fit_h`` / the reference's ``fit_H_online`` (cnmf.py:260-388)."""
+    beta = beta_loss_to_float(beta)
+    n_dev = math.prod(mesh.devices.shape)
+    axis = mesh.axis_names[0]
+    n_orig = X.shape[0]
+    if sp.issparse(X):
+        X = X.toarray()
+    X, _ = pad_rows_to_mesh(np.asarray(X), n_dev)
+    W = jnp.asarray(np.asarray(W), jnp.float32)
+    k = W.shape[0]
+
+    key = jax.random.key(int(seed) & 0x7FFFFFFF)
+    H0 = jax.random.uniform(key, (X.shape[0], k), dtype=jnp.float32)
+
+    row_sh = NamedSharding(mesh, P(axis, None))
+    Xd = jax.device_put(jnp.asarray(X, jnp.float32), row_sh)
+    H0 = jax.device_put(H0, row_sh)
+    Wd = jax.device_put(W, NamedSharding(mesh, P()))
+
+    @functools.partial(jax.jit, static_argnames=())
+    def run(Xs, Hs, Ws):
+        fn = shard_map(
+            lambda x, h, w: _chunk_h_solve(
+                x, h, w, w @ w.T if beta == 2.0 else None, beta,
+                float(l1_reg_H), float(l2_reg_H), int(chunk_max_iter),
+                jnp.float32(h_tol)),
+            mesh=mesh, in_specs=(P(axis, None), P(axis, None), P()),
+            out_specs=P(axis, None))
+        return fn(Xs, Hs, Ws)
+
+    H = run(Xd, H0, Wd)
+    return np.asarray(H)[:n_orig]
