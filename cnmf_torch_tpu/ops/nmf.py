@@ -136,6 +136,13 @@ def beta_divergence(X, H, W, beta: float = 2.0):
 # MU update steps
 # ---------------------------------------------------------------------------
 
+def split_regularization(alpha: float, l1_ratio: float) -> tuple[float, float]:
+    """sklearn-convention (alpha, l1_ratio) -> (l1, l2) penalty split, as the
+    reference's ledger kwargs encode it (cnmf.py:757-771)."""
+    return (float(alpha) * float(l1_ratio),
+            float(alpha) * (1.0 - float(l1_ratio)))
+
+
 def _apply_rate(M, numer, denom, l1, l2, eps=EPS):
     """nmf-torch-convention MU rate (observed at cnmf.py:357-371):
     numerator L1-shifted and clamped, L2 added to denominator, rate zeroed
@@ -579,10 +586,8 @@ def run_nmf(X, n_components: int, init: str = "random",
     n, g = X.shape
     k = int(n_components)
 
-    l1_W = float(alpha_W) * float(l1_ratio_W)
-    l2_W = float(alpha_W) * (1.0 - float(l1_ratio_W))
-    l1_H = float(alpha_H) * float(l1_ratio_H)
-    l2_H = float(alpha_H) * (1.0 - float(l1_ratio_H))
+    l1_W, l2_W = split_regularization(alpha_W, l1_ratio_W)
+    l1_H, l2_H = split_regularization(alpha_H, l1_ratio_H)
 
     key = jax.random.key(int(random_state) & 0x7FFFFFFF)
     H0, W0 = init_factors(X, k, init, key)

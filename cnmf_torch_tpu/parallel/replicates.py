@@ -31,6 +31,7 @@ from ..ops.nmf import (
     nmf_fit_batch,
     nmf_fit_online,
     random_init,
+    split_regularization,
 )
 
 __all__ = ["replicate_sweep", "worker_filter", "default_mesh"]
@@ -105,10 +106,8 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
                 np.zeros((0, n, k), np.float32) if return_usages else None,
                 np.zeros((0,), np.float32))
 
-    l1_W = float(alpha_W) * float(l1_ratio_W)
-    l2_W = float(alpha_W) * (1.0 - float(l1_ratio_W))
-    l1_H = float(alpha_H) * float(l1_ratio_H)
-    l2_H = float(alpha_H) * (1.0 - float(l1_ratio_H))
+    l1_W, l2_W = split_regularization(alpha_W, l1_ratio_W)
+    l1_H, l2_H = split_regularization(alpha_H, l1_ratio_H)
 
     if mode == "batch":
         def solve(H0, W0):
@@ -160,10 +159,8 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
             W0 = W0[idx]
         if mesh is not None:
             ax = mesh.axis_names[0]
-            rep_sharding = NamedSharding(mesh, P(ax))
             H0 = jax.device_put(H0, NamedSharding(mesh, P(ax, None, None)))
             W0 = jax.device_put(W0, NamedSharding(mesh, P(ax, None, None)))
-            del rep_sharding
         H, W, err = sweep(H0, W0)
         spectra_out[start:start + r] = np.asarray(W)[:r]
         if return_usages:

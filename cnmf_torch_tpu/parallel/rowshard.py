@@ -35,6 +35,7 @@ from ..ops.nmf import (
     _solve_w_from_stats,
     beta_loss_to_float,
     random_init,
+    split_regularization,
 )
 
 __all__ = ["nmf_fit_rowsharded", "fit_h_rowsharded", "pad_rows_to_mesh"]
@@ -137,6 +138,13 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
     chunk boundary as the streaming unit.
     """
     beta = beta_loss_to_float(beta_loss)
+    if beta not in (2.0, 1.0, 0.0):
+        # the generic-beta update exists only on the single-chip path
+        # (ops.nmf._update_W); the sharded pass implements the three named
+        # losses — silently running IS updates for beta=1.5 would optimize
+        # a different objective than the convergence test evaluates
+        raise ValueError(
+            f"nmf_fit_rowsharded supports beta in {{2, 1, 0}}, got {beta}")
     n_dev = math.prod(mesh.devices.shape)
     axis = mesh.axis_names[0]
     n_orig = X.shape[0]
@@ -154,15 +162,28 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
     H0 = jax.device_put(H0, row_sh)
     W0 = jax.device_put(W0, rep_sh)
 
-    l1_W = float(alpha_W) * float(l1_ratio_W)
-    l2_W = float(alpha_W) * (1.0 - float(l1_ratio_W))
-    l1_H = float(alpha_H) * float(l1_ratio_H)
-    l2_H = float(alpha_H) * (1.0 - float(l1_ratio_H))
+    l1_W, l2_W = split_regularization(alpha_W, l1_ratio_W)
+    l1_H, l2_H = split_regularization(alpha_H, l1_ratio_H)
 
     H, W, err = _fit_rowsharded_jit(
         Xd, H0, W0, mesh, axis, beta, jnp.float32(tol), jnp.float32(h_tol),
         int(n_passes), int(chunk_max_iter), l1_H, l2_H, l1_W, l2_W)
     return (np.asarray(H)[:n_orig], np.asarray(W), float(err))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "axis", "beta", "chunk_max_iter", "l1_H", "l2_H"),
+)
+def _fit_h_rowsharded_jit(X, H0, W, mesh, axis, beta, chunk_max_iter, h_tol,
+                          l1_H, l2_H):
+    fn = shard_map(
+        lambda x, h, w: _chunk_h_solve(
+            x, h, w, w @ w.T if beta == 2.0 else None, beta,
+            l1_H, l2_H, chunk_max_iter, h_tol),
+        mesh=mesh, in_specs=(P(axis, None), P(axis, None), P()),
+        out_specs=P(axis, None))
+    return fn(X, H0, W)
 
 
 def fit_h_rowsharded(X, W, mesh: Mesh, h_tol: float = 0.05,
@@ -189,16 +210,7 @@ def fit_h_rowsharded(X, W, mesh: Mesh, h_tol: float = 0.05,
     H0 = jax.device_put(H0, row_sh)
     Wd = jax.device_put(W, NamedSharding(mesh, P()))
 
-    @functools.partial(jax.jit, static_argnames=())
-    def run(Xs, Hs, Ws):
-        fn = shard_map(
-            lambda x, h, w: _chunk_h_solve(
-                x, h, w, w @ w.T if beta == 2.0 else None, beta,
-                float(l1_reg_H), float(l2_reg_H), int(chunk_max_iter),
-                jnp.float32(h_tol)),
-            mesh=mesh, in_specs=(P(axis, None), P(axis, None), P()),
-            out_specs=P(axis, None))
-        return fn(Xs, Hs, Ws)
-
-    H = run(Xd, H0, Wd)
+    H = _fit_h_rowsharded_jit(Xd, H0, Wd, mesh, axis, beta,
+                              int(chunk_max_iter), jnp.float32(h_tol),
+                              float(l1_reg_H), float(l2_reg_H))
     return np.asarray(H)[:n_orig]
