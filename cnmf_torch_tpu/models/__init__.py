@@ -1,3 +1,4 @@
 from .cnmf import cNMF, compute_tpm
+from .preprocess import Preprocess
 
-__all__ = ["cNMF", "compute_tpm"]
+__all__ = ["cNMF", "compute_tpm", "Preprocess"]

@@ -1,0 +1,337 @@
+"""Preprocessing sidecar: QC filtering, normalization, batch correction.
+
+API-compatible reimplementation of the reference ``Preprocess`` class
+(``/root/reference/src/cnmf/preprocess.py:41-439``) without the
+scanpy/harmonypy dependency stack: QC filters and library-size scaling are
+numpy/JAX ops, seurat_v3 HVG selection and PCA are the device kernels in
+``cnmf_torch_tpu.ops``, and Harmony (with the gene-space MOE ridge
+correction that distinguishes this pipeline from stock Harmony) is the JAX
+port in :mod:`cnmf_torch_tpu.ops.harmony`. CITE-seq data is handled the
+same way: ADT features are split off before RNA normalization and hstacked
+back into the TPM output so ADT contributions to GEPs can be read out
+(``preprocess.py:202-238``).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Collection
+
+import numpy as np
+import pandas as pd
+import scipy.sparse as sp
+
+from ..ops.harmony import moe_correct_ridge, run_harmony
+from ..ops.pca import pca
+from ..ops.seurat_v3 import seurat_v3_hvg
+from ..ops.stats import normalize_total, row_sums, scale_columns
+from ..utils.anndata_lite import AnnDataLite, write_h5ad
+
+__all__ = ["Preprocess", "stdscale_quantile_celing"]
+
+
+def stdscale_quantile_celing(_adata, max_value=None, quantile_thresh=None):
+    """Unit-variance scale (no centering) then clip values above a quantile
+    of the full matrix (``preprocess.py:21-29``; the reference keeps the
+    typo'd name, kept here for API parity)."""
+    X, _ = scale_columns(_adata.X, ddof=1, zero_std_to_one=True)
+    if max_value is not None:
+        if sp.issparse(X):
+            X.data[X.data > max_value] = max_value
+        else:
+            X[X > max_value] = max_value
+    if quantile_thresh is not None:
+        if sp.issparse(X):
+            # quantile over the dense value distribution (incl. zeros), as
+            # the reference computes it via todense (preprocess.py:25); done
+            # here without densifying: zeros shift the quantile position
+            nnz_vals = np.sort(X.tocsr().data)
+            n_total = X.shape[0] * X.shape[1]
+            pos = quantile_thresh * (n_total - 1)
+            n_zeros = n_total - len(nnz_vals)
+            # linear interpolation within the sorted implicit dense vector
+            def dense_val(i):
+                return 0.0 if i < n_zeros else nnz_vals[int(i - n_zeros)]
+            lo, hi = int(np.floor(pos)), int(np.ceil(pos))
+            frac = pos - lo
+            threshval = dense_val(lo) * (1 - frac) + dense_val(hi) * frac
+            X.data[X.data > threshval] = threshval
+        else:
+            threshval = np.quantile(np.asarray(X).reshape(-1),
+                                    quantile_thresh)
+            X[X > threshval] = threshval
+    _adata.X = X
+    return _adata
+
+
+class Preprocess:
+    """Optional upstream pipeline producing the three files ``prepare()``
+    consumes (counts_fn / tpm_fn / genes_file, README.md:88-92)."""
+
+    def __init__(self, random_seed=None):
+        self.random_seed = 0 if random_seed is None else int(random_seed)
+        np.random.seed(random_seed)
+
+    # ------------------------------------------------------------------
+
+    def filter_adata(self, _adata, filter_mito_thresh=None,
+                     min_cells_per_gene=10, min_counts_per_cell=500,
+                     filter_mito_genes=False, filter_dot_genes=True,
+                     makeplots=False):
+        """QC filter (``preprocess.py:60-132``): genes by min cells, cells
+        by min counts, optional mitochondrial-fraction cell filter (genes
+        prefixed ``MT-``), optional removal of mito and dot-containing
+        genes."""
+        X = _adata.X
+        if min_cells_per_gene is not None:
+            if sp.issparse(X):
+                cells_per_gene = np.asarray((X > 0).sum(axis=0)).ravel()
+            else:
+                cells_per_gene = (np.asarray(X) > 0).sum(axis=0)
+            _adata = _adata[:, cells_per_gene >= min_cells_per_gene]
+
+        _adata.obs = _adata.obs.copy()
+        _adata.obs["n_counts"] = row_sums(_adata.X)
+
+        if makeplots:
+            self._hist(np.log10(np.maximum(_adata.obs["n_counts"], 1)),
+                       "log10 n_counts")
+
+        if min_counts_per_cell is not None:
+            _adata = _adata[
+                (_adata.obs["n_counts"] >= min_counts_per_cell).values, :]
+
+        mt_genes = [x for x in _adata.var.index if "MT-" in x]
+        if filter_mito_thresh is not None:
+            num_mito = row_sums(_adata[:, mt_genes].X) if mt_genes else (
+                np.zeros(_adata.n_obs))
+            pct_mito = num_mito / _adata.obs["n_counts"].values
+            _adata.obs = _adata.obs.copy()
+            _adata.obs["pct_mito"] = pct_mito
+            if makeplots:
+                self._hist(pct_mito, "pct_mito")
+            _adata = _adata[pct_mito < filter_mito_thresh, :]
+
+        tofilter = []
+        if filter_dot_genes:
+            tofilter = [x for x in _adata.var.index if "." in x]
+        if filter_mito_genes:
+            tofilter += mt_genes
+        _adata = _adata[:, ~_adata.var.index.isin(tofilter)]
+        return _adata
+
+    # ------------------------------------------------------------------
+
+    def preprocess_for_cnmf(self, _adata, feature_type_col=None,
+                            adt_feature_name="Antibody Capture",
+                            harmony_vars=None, n_top_rna_genes=2000,
+                            librarysize_targetsum=1e4,
+                            max_scaled_thresh=None, quantile_thresh=0.9999,
+                            makeplots=False, theta=1,
+                            save_output_base=None, max_iter_harmony=20):
+        """HVG-filtered, variance-normalized, optionally Harmony-corrected
+        RNA plus a library-size-normalized (RNA [+ADT]) TPM companion
+        (``preprocess.py:135-247``). Returns ``(adata_RNA, tp10k, hvgs)``."""
+        if (not isinstance(_adata, Collection)) and feature_type_col is not None:
+            adata_ADT = _adata[:, (_adata.var[feature_type_col]
+                                   == adt_feature_name).values]
+            adata_RNA = _adata[:, (_adata.var[feature_type_col]
+                                   != adt_feature_name).values]
+        elif not isinstance(_adata, Collection):
+            adata_RNA = _adata
+            adata_RNA.var_names_make_unique()
+            adata_RNA.var = adata_RNA.var.copy()
+            adata_RNA.var["features_renamed"] = adata_RNA.var.index
+            adata_ADT = None
+        elif len(_adata) == 2:
+            adata_RNA, adata_ADT = _adata[0], _adata[1]
+            if adata_ADT.shape[0] != adata_RNA.shape[0]:
+                raise Exception(
+                    "ADT and RNA AnnDatas don't have the same number of cells")
+            if np.sum(adata_ADT.obs.index != adata_RNA.obs.index) > 0:
+                raise Exception(
+                    "Inconsistency of the index for the ADT and RNA AnnDatas")
+        else:
+            raise Exception("data should either be an AnnData object or a "
+                            "list of 2 AnnData objects")
+
+        tp10k = normalize_total(adata_RNA, target_sum=librarysize_targetsum)
+        adata_RNA, hvgs = self.normalize_batchcorrect(
+            adata_RNA, harmony_vars=harmony_vars,
+            n_top_genes=n_top_rna_genes,
+            librarysize_targetsum=librarysize_targetsum,
+            max_scaled_thresh=max_scaled_thresh,
+            quantile_thresh=quantile_thresh, theta=theta,
+            makeplots=makeplots, max_iter_harmony=max_iter_harmony)
+
+        if adata_ADT is not None:
+            adata_ADT = adata_ADT[adata_RNA.obs.index, :]
+            adata_ADT = normalize_total(adata_ADT,
+                                        target_sum=librarysize_targetsum)
+            merge_var = pd.concat([tp10k.var, adata_ADT.var], axis=0)
+            if sp.issparse(tp10k.X) or sp.issparse(adata_ADT.X):
+                Xm = sp.hstack([sp.csr_matrix(tp10k.X),
+                                sp.csr_matrix(adata_ADT.X)]).tocsr()
+            else:
+                Xm = np.hstack([tp10k.X, adata_ADT.X])
+            tp10k = AnnDataLite(Xm, obs=tp10k.obs, var=merge_var)
+
+        if save_output_base is not None:
+            write_h5ad(save_output_base + ".Corrected.HVG.Varnorm.h5ad",
+                       adata_RNA)
+            write_h5ad(save_output_base + ".TP10K.h5ad", tp10k)
+            with open(save_output_base + ".Corrected.HVGs.txt", "w") as f:
+                f.write("\n".join(hvgs))
+
+        return adata_RNA, tp10k, hvgs
+
+    # ------------------------------------------------------------------
+
+    def normalize_batchcorrect(self, _adata, normalize_librarysize=False,
+                               harmony_vars=None, n_top_genes=None,
+                               librarysize_targetsum=1e4,
+                               max_scaled_thresh=None,
+                               quantile_thresh=0.9999, theta=1,
+                               makeplots=False, max_iter_harmony=20):
+        """HVG selection (seurat_v3 on raw counts), variance scaling with a
+        quantile ceiling, and — when ``harmony_vars`` is given — PCA on the
+        scaled TP10K view handed to Harmony, whose MOE ridge then corrects
+        the gene matrix itself with negatives clipped to zero
+        (``preprocess.py:250-338``)."""
+        if n_top_genes is not None:
+            hvg_stats = seurat_v3_hvg(_adata.X, n_top_genes=n_top_genes)
+            _adata.var = _adata.var.copy()
+            for col in hvg_stats.columns:
+                _adata.var[col] = hvg_stats[col].values
+        elif "highly_variable" not in _adata.var.columns:
+            raise Exception(
+                "If a numeric value for n_top_genes is not provided, you "
+                "must include a highly_variable column in _adata")
+
+        hv_mask = _adata.var["highly_variable"].values.astype(bool)
+
+        if harmony_vars is not None:
+            anorm = normalize_total(_adata,
+                                    target_sum=librarysize_targetsum)
+            anorm = anorm[:, hv_mask]
+            stdscale_quantile_celing(anorm, max_value=max_scaled_thresh,
+                                     quantile_thresh=quantile_thresh)
+
+            _adata = _adata[:, hv_mask]
+            stdscale_quantile_celing(_adata, max_value=max_scaled_thresh,
+                                     quantile_thresh=quantile_thresh)
+            if makeplots:
+                self._count_hist(anorm)
+
+            X_pca, _, _ = pca(anorm.X, n_comps=50, zero_center=True)
+            _adata.obsm["X_pca"] = X_pca
+
+            src = anorm if normalize_librarysize else _adata
+            X_dense = (src.X.toarray() if sp.issparse(src.X)
+                       else np.asarray(src.X))
+            X_corr, pca_harmony = self.harmony_correct_X(
+                X_dense, src.obs, _adata.obsm["X_pca"], harmony_vars,
+                max_iter_harmony=max_iter_harmony, theta=theta)
+            _adata.X = X_corr
+            _adata.obsm["X_pca_harmony"] = pca_harmony
+        else:
+            if normalize_librarysize:
+                _adata = normalize_total(_adata,
+                                         target_sum=librarysize_targetsum)
+            _adata = _adata[:, hv_mask]
+            stdscale_quantile_celing(_adata, max_value=max_scaled_thresh,
+                                     quantile_thresh=quantile_thresh)
+            if makeplots:
+                self._count_hist(_adata)
+
+        return _adata, list(_adata.var.index)
+
+    # ------------------------------------------------------------------
+
+    def harmony_correct_X(self, X, obs, pca_embedding, harmony_vars,
+                          theta=1, max_iter_harmony=20):
+        """Learn Harmony's correction on the PCs, then apply the MOE ridge
+        to the expression matrix, clipping negatives to zero
+        (``preprocess.py:342-388``). Returns ``(X_corr, X_pca_harmony)``."""
+        res = run_harmony(pca_embedding, obs, harmony_vars,
+                          theta=theta, max_iter_harmony=max_iter_harmony,
+                          random_state=self.random_seed)
+        X_pca_harmony = res.Z_corr.T
+        X_corr = moe_correct_ridge(np.asarray(X).T, res.R, res.Phi_moe,
+                                   res.lamb).T
+        # np.maximum also copies out of the read-only device buffer
+        X_corr = np.maximum(X_corr, 0.0)
+        return X_corr, X_pca_harmony
+
+    # ------------------------------------------------------------------
+
+    def select_features_MI(self, _adata, cluster, max_scaled_thresh=None,
+                           quantile_thresh=0.9999, n_top_features=70,
+                           makeplots=False):
+        """Rank features by mutual information against a cluster label and
+        mark the top ``n_top_features`` as highly variable
+        (``preprocess.py:391-439``). The MI estimator is sklearn's (same
+        dependency the reference uses); a host-side utility, not a TPU
+        kernel."""
+        from sklearn.feature_selection import mutual_info_classif
+
+        _adata = normalize_total(_adata)
+        stdscale_quantile_celing(_adata, max_value=max_scaled_thresh,
+                                 quantile_thresh=quantile_thresh)
+        X = _adata.X.toarray() if sp.issparse(_adata.X) else _adata.X
+        res = mutual_info_classif(X, cluster, discrete_features="auto",
+                                  n_neighbors=3, copy=True,
+                                  random_state=self.random_seed)
+        res = pd.Series(res, index=_adata.var.index).sort_values(
+            ascending=False)
+        resdf = pd.DataFrame(
+            [res.values, np.arange(res.shape[0])],
+            columns=res.index, index=["MI", "MI_Rank"]).T
+        resdf["MI_diff"] = resdf["MI"].diff()
+
+        if makeplots:
+            self._mi_plot(resdf, n_top_features)
+
+        _adata.var = _adata.var.copy()
+        for v in resdf.columns:
+            _adata.var[v] = resdf[v]
+        _adata.var["highly_variable"] = _adata.var["MI_Rank"] < n_top_features
+        return _adata
+
+    # -- plotting helpers (host-side, Agg) -----------------------------
+
+    @staticmethod
+    def _hist(values, title):
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots()
+        ax.hist(np.asarray(values), bins=100)
+        ax.set_title(title)
+        plt.close(fig)
+
+    @staticmethod
+    def _count_hist(adata, num_cells=1000):
+        X = adata.X[:num_cells, :]
+        y = (np.asarray(X.todense()) if sp.issparse(X)
+             else np.asarray(X)).reshape(-1)
+        Preprocess._hist(y[y > 0],
+                         "Quantile thresholded normalized count distribution")
+
+    @staticmethod
+    def _mi_plot(resdf, n_top_features):
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots(1, 1, figsize=(10, 3), dpi=100)
+        ax.scatter(resdf["MI_Rank"], resdf["MI"])
+        ax.set_ylabel("MI", fontsize=11)
+        ax.set_xlabel("MI Rank", fontsize=11)
+        ylim = ax.get_ylim()
+        ax.vlines(x=n_top_features, ymin=ylim[0], ymax=ylim[1],
+                  linestyle="--", color="k")
+        ax.set_ylim(ylim)
+        plt.close(fig)

@@ -1,6 +1,9 @@
+from .harmony import moe_correct_ridge, run_harmony
 from .hvg import highvar_genes
 from .kmeans import kmeans
 from .metrics import local_density, pairwise_euclidean, silhouette_score
+from .pca import pca
+from .seurat_v3 import seurat_v3_hvg
 from .nmf import (
     beta_divergence,
     beta_loss_to_float,
@@ -15,6 +18,10 @@ from .ols import ols_all_cols
 from .stats import column_mean_var, normalize_total, row_sums, scale_columns
 
 __all__ = [
+    "moe_correct_ridge",
+    "run_harmony",
+    "pca",
+    "seurat_v3_hvg",
     "highvar_genes",
     "kmeans",
     "local_density",

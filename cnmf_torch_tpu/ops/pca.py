@@ -1,0 +1,59 @@
+"""Principal component analysis on device.
+
+Replaces the reference's ``sc.pp.pca`` call in the batch-correction path
+(``/root/reference/src/cnmf/preprocess.py:310``). One economy SVD of the
+(optionally centered) matrix on the MXU; signs are fixed to scanpy/sklearn's
+``svd_flip`` convention (largest-|loading| positive per component) so
+downstream Harmony runs see the same basis orientation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.sparse as sp
+
+__all__ = ["pca"]
+
+_HI = jax.lax.Precision.HIGHEST
+
+
+@functools.partial(jax.jit, static_argnames=("n_comps", "zero_center"))
+def _pca_jit(X, n_comps: int, zero_center: bool):
+    if zero_center:
+        X = X - jnp.mean(X, axis=0, keepdims=True)
+    U, S, Vt = jnp.linalg.svd(X, full_matrices=False)
+    U, S, Vt = U[:, :n_comps], S[:n_comps], Vt[:n_comps, :]
+    # svd_flip: orient each component so its largest-|value| loading is
+    # positive (removes SVD sign ambiguity; matches sklearn/scanpy)
+    max_idx = jnp.argmax(jnp.abs(Vt), axis=1)
+    signs = jnp.sign(Vt[jnp.arange(n_comps), max_idx])
+    Vt = Vt * signs[:, None]
+    U = U * signs[None, :]
+    X_pca = U * S[None, :]
+    n = X.shape[0]
+    explained_var = (S ** 2) / jnp.maximum(n - 1, 1)
+    return X_pca, Vt, explained_var
+
+
+def pca(X, n_comps: int = 50, zero_center: bool = True):
+    """Returns ``(X_pca (n, n_comps), components (n_comps, g),
+    explained_variance_ratio (n_comps,))`` as numpy arrays."""
+    if sp.issparse(X):
+        X = X.toarray()
+    X = np.asarray(X, dtype=np.float32)
+    n_comps = int(min(n_comps, min(X.shape) - 1 if zero_center else min(X.shape)))
+    X_pca, Vt, ev = _pca_jit(jnp.asarray(X), n_comps, bool(zero_center))
+    if zero_center:
+        total_var = float(np.var(X, axis=0, ddof=1).sum())
+    else:
+        # uncentered SVD energy includes the mean component, so the ratio
+        # denominator must be the uncentered second moment or ratios blow
+        # past 1 for data with a large mean offset
+        total_var = float((np.asarray(X, np.float64) ** 2).sum()
+                          / max(X.shape[0] - 1, 1))
+    ratio = np.asarray(ev, dtype=np.float64) / max(total_var, 1e-30)
+    return np.asarray(X_pca), np.asarray(Vt), ratio

@@ -29,7 +29,8 @@ class AnnDataLite:
     subsetting at ``cnmf.py:670``, ``adata.X`` mutation, ``.copy()``).
     """
 
-    def __init__(self, X, obs: pd.DataFrame | None = None, var: pd.DataFrame | None = None):
+    def __init__(self, X, obs: pd.DataFrame | None = None, var: pd.DataFrame | None = None,
+                 obsm: dict | None = None):
         if sp.issparse(X):
             X = X.tocsr()
         else:
@@ -46,6 +47,7 @@ class AnnDataLite:
             raise ValueError(f"var has {len(var.index)} rows but X has {g}")
         self.obs = obs
         self.var = var
+        self.obsm = dict(obsm) if obsm else {}
 
     # -- basic protocol ----------------------------------------------------
     @property
@@ -69,7 +71,32 @@ class AnnDataLite:
         return self.var.index
 
     def copy(self) -> "AnnDataLite":
-        return AnnDataLite(self.X.copy(), self.obs.copy(), self.var.copy())
+        return AnnDataLite(self.X.copy(), self.obs.copy(), self.var.copy(),
+                           {k: np.array(v) for k, v in self.obsm.items()})
+
+    def var_names_make_unique(self, join: str = "-"):
+        """Deduplicate var names anndata-style: later occurrences of a
+        repeated name get ``name{join}{i}`` suffixes (i = 1, 2, ...)."""
+        names = list(self.var.index.astype(str))
+        existing = set(names)
+        seen: dict[str, int] = {}
+        out = []
+        for name in names:
+            if name in seen:
+                # re-check candidates against every name so a suffixed name
+                # never collides with a pre-existing one (anndata semantics:
+                # ['GENE', 'GENE-1', 'GENE'] -> ['GENE', 'GENE-1', 'GENE-2'])
+                i = seen[name] + 1
+                while f"{name}{join}{i}" in existing:
+                    i += 1
+                seen[name] = i
+                cand = f"{name}{join}{i}"
+                existing.add(cand)
+                out.append(cand)
+            else:
+                seen[name] = 0
+                out.append(name)
+        self.var.index = pd.Index(out)
 
     def _resolve_idx(self, key, index: pd.Index, axis_len: int):
         """Convert a row/column selector into a positional indexer."""
@@ -95,7 +122,8 @@ class AnnDataLite:
         rows = self._resolve_idx(key[0], self.obs.index, self.n_obs)
         cols = self._resolve_idx(key[1], self.var.index, self.n_vars)
         X = self.X[rows, :][:, cols]
-        return AnnDataLite(X, self.obs.iloc[rows], self.var.iloc[cols])
+        obsm = {k: np.asarray(v)[rows] for k, v in self.obsm.items()}
+        return AnnDataLite(X, self.obs.iloc[rows], self.var.iloc[cols], obsm)
 
     def __repr__(self):
         kind = "sparse" if sp.issparse(self.X) else "dense"
@@ -167,6 +195,10 @@ def write_h5ad(filename: str, adata: AnnDataLite):
             g = f.create_group(aux)
             g.attrs["encoding-type"] = "dict"
             g.attrs["encoding-version"] = "0.1.0"
+        for key, val in getattr(adata, "obsm", {}).items():
+            ds = f["obsm"].create_dataset(key, data=np.asarray(val))
+            ds.attrs["encoding-type"] = "array"
+            ds.attrs["encoding-version"] = "0.2.0"
 
 
 def _decode(v):
@@ -237,4 +269,9 @@ def read_h5ad(filename: str) -> AnnDataLite:
         X = _read_X(f["X"])
         obs = _read_dataframe(f["obs"]) if "obs" in f else None
         var = _read_dataframe(f["var"]) if "var" in f else None
-    return AnnDataLite(X, obs, var)
+        obsm = {}
+        if "obsm" in f:
+            for key, node in f["obsm"].items():
+                if isinstance(node, h5py.Dataset):
+                    obsm[key] = node[()]
+    return AnnDataLite(X, obs, var, obsm)

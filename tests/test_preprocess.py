@@ -1,0 +1,275 @@
+"""Preprocess sidecar tests — coverage the reference never had (SURVEY.md
+§4: "CITE-seq/Preprocess ... have no automated tests"): PCA vs sklearn,
+seurat_v3 HVG recovery, Harmony batch-mixing improvement, MOE-ridge gene
+correction, and the full preprocess -> prepare file handoff."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+import scipy.sparse as sp
+
+from cnmf_torch_tpu.models.preprocess import Preprocess, stdscale_quantile_celing
+from cnmf_torch_tpu.ops import moe_correct_ridge, pca, run_harmony, seurat_v3_hvg
+from cnmf_torch_tpu.utils.anndata_lite import AnnDataLite
+
+
+def test_pca_matches_sklearn(rng):
+    from sklearn.decomposition import PCA as SkPCA
+
+    X = rng.random((80, 30)).astype(np.float32)
+    Xp, comps, ratio = pca(X, n_comps=5)
+    sk = SkPCA(n_components=5, svd_solver="full").fit(X)
+    np.testing.assert_allclose(np.abs(Xp), np.abs(sk.transform(X)),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(ratio, sk.explained_variance_ratio_,
+                               rtol=1e-3)
+    # svd_flip orientation should match sklearn exactly (same convention)
+    np.testing.assert_allclose(comps, sk.components_, rtol=1e-3, atol=1e-3)
+
+
+def test_seurat_v3_recovers_planted_hvgs(rng):
+    n, g = 500, 300
+    # Poisson genes; 30 "planted" genes are bimodal across two cell groups
+    # with a multiplicative (mean-preserving-ish) rate split, so their
+    # means stay inside the bulk regime and only their dispersion exceeds
+    # the mean-variance trend (shifting means instead would let the local
+    # trend fit *through* the planted genes — scanpy's loess included)
+    lam = rng.uniform(0.5, 20.0, size=g)
+    planted = rng.choice(g, size=30, replace=False)
+    groups = rng.integers(0, 2, size=n).astype(bool)
+    rate = np.tile(lam, (n, 1))
+    rate[np.ix_(groups, planted)] *= 1.8
+    rate[np.ix_(~groups, planted)] *= 0.2
+    X = rng.poisson(rate).astype(np.float64)
+
+    stats = seurat_v3_hvg(X, n_top_genes=30)
+    assert stats.highly_variable.sum() == 30
+    hits = np.isin(np.where(stats.highly_variable)[0], planted).sum()
+    assert hits >= 25, f"only {hits}/30 planted HVGs recovered"
+
+    # sparse path must agree with dense
+    stats_sp = seurat_v3_hvg(sp.csr_matrix(X), n_top_genes=30)
+    np.testing.assert_allclose(stats_sp.variances_norm.values,
+                               stats.variances_norm.values, rtol=1e-4)
+    assert (stats_sp.highly_variable.values
+            == stats.highly_variable.values).all()
+
+
+def test_seurat_v3_clipped_statistic_matches_scanpy_formula(rng):
+    """Genes with clipped outlier cells: the statistic is the second moment
+    of upper-clipped standardized values about the RAW mean (scanpy's
+    formula), not re-centered on the clipped mean."""
+    n, g = 100, 20
+    X = rng.poisson(5.0, size=(n, g)).astype(np.float64)
+    X[:3, 0] = 500.0  # extreme outliers in gene 0 -> clipping fires
+    stats = seurat_v3_hvg(X, n_top_genes=5)
+    from cnmf_torch_tpu.ops.seurat_v3 import _loess_trend
+
+    mean = X.mean(axis=0)
+    var = X.var(axis=0, ddof=1)
+    fit = _loess_trend(np.log10(mean), np.log10(var))
+    reg_std = np.sqrt(10.0 ** fit)
+    Z = np.minimum((X - mean[None, :]) / reg_std[None, :], np.sqrt(n))
+    expected = (Z ** 2).sum(axis=0) / (n - 1)
+    np.testing.assert_allclose(stats.variances_norm.values, expected,
+                               rtol=1e-4)
+    # sparse path agrees on the clipped gene too
+    stats_sp = seurat_v3_hvg(sp.csr_matrix(X), n_top_genes=5)
+    np.testing.assert_allclose(stats_sp.variances_norm.values, expected,
+                               rtol=1e-4)
+
+
+def test_var_names_make_unique_avoids_new_collisions():
+    adata = AnnDataLite(np.zeros((2, 3)),
+                        var=pd.DataFrame(index=["GENE", "GENE-1", "GENE"]))
+    adata.var_names_make_unique()
+    assert list(adata.var.index) == ["GENE", "GENE-1", "GENE-2"]
+    assert adata.var.index.is_unique
+
+
+def test_pca_uncentered_ratio_bounded(rng):
+    X = rng.random((50, 20)).astype(np.float32) + 100.0  # large mean offset
+    _, _, ratio = pca(X, n_comps=5, zero_center=False)
+    assert (ratio <= 1.0 + 1e-6).all()
+    assert ratio.sum() <= 1.0 + 1e-6
+
+
+def _two_batch_embedding(rng, n_per=150, d=10, shift=4.0):
+    """Two biological groups x two batches; batch adds a constant offset."""
+    bio = np.repeat([0, 1], n_per)
+    batch = np.tile([0, 1], n_per)
+    Z = rng.normal(size=(2 * n_per, d)).astype(np.float32)
+    Z[bio == 1, 0] += 6.0                      # biological separation
+    Z[batch == 1, 1] += shift                  # batch artifact
+    obs = pd.DataFrame({"batch": [f"b{b}" for b in batch],
+                        "bio": bio})
+    return Z, obs, bio, batch
+
+
+def test_run_harmony_reduces_batch_separation(rng):
+    Z, obs, bio, batch = _two_batch_embedding(rng)
+    res = run_harmony(Z, obs, "batch", theta=2.0, max_iter_harmony=10,
+                      nclust=10, random_state=1)
+    Zc = res.Z_corr.T
+    assert Zc.shape == Z.shape
+
+    def batch_gap(M):
+        return np.linalg.norm(M[batch == 0].mean(0) - M[batch == 1].mean(0))
+
+    def bio_gap(M):
+        return np.linalg.norm(M[bio == 0].mean(0) - M[bio == 1].mean(0))
+
+    assert batch_gap(Zc) < 0.35 * batch_gap(Z), (
+        f"batch gap {batch_gap(Zc):.2f} vs original {batch_gap(Z):.2f}")
+    assert bio_gap(Zc) > 0.7 * bio_gap(Z), "biological signal destroyed"
+    assert res.R.shape[1] == Z.shape[0]
+    assert res.Phi_moe.shape == (3, Z.shape[0])  # intercept + 2 batch levels
+
+
+def test_moe_correct_ridge_removes_batch_offset(rng):
+    # genes x cells matrix with a per-batch offset; a single-cluster R
+    # reduces the MOE to one ridge expert that should strip the offset
+    n, g = 200, 40
+    batch = np.tile([0, 1], n // 2)
+    X = rng.normal(5.0, 1.0, size=(g, n))
+    X[:, batch == 1] += 3.0
+    phi = np.stack([(batch == 0).astype(float),
+                    (batch == 1).astype(float)])
+    Phi_moe = np.vstack([np.ones((1, n)), phi])
+    R = np.ones((1, n))
+    lamb = np.array([0.0, 1.0, 1.0])
+    Xc = moe_correct_ridge(X, R, Phi_moe, lamb)
+    gap0 = np.abs(X[:, batch == 0].mean(1) - X[:, batch == 1].mean(1)).mean()
+    gap1 = np.abs(Xc[:, batch == 0].mean(1) - Xc[:, batch == 1].mean(1)).mean()
+    assert gap1 < 0.05 * gap0
+    # intercept preserved: global mean barely moves
+    assert abs(Xc.mean() - X.mean()) < 0.5
+
+
+def test_stdscale_quantile_ceiling_sparse_matches_dense(rng):
+    X = rng.random((60, 25))
+    X[X < 0.6] = 0.0
+    a_dense = AnnDataLite(X.copy())
+    a_sparse = AnnDataLite(sp.csr_matrix(X))
+    stdscale_quantile_celing(a_dense, quantile_thresh=0.99)
+    stdscale_quantile_celing(a_sparse, quantile_thresh=0.99)
+    np.testing.assert_allclose(np.asarray(a_sparse.X.todense()), a_dense.X,
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_filter_adata(rng):
+    n, g = 100, 50
+    X = rng.poisson(30.0, size=(n, g)).astype(float)
+    X[:5, :] = 0.1          # low-count cells
+    X[:, :3] = 0.0          # genes in no cells
+    X[:, 3] = 0.0
+    X[::2, 3] = 1.0          # gene in half the cells
+    names = [f"G{i}" for i in range(g - 4)] + ["MT-ND1", "MT-CO1",
+                                               "RP11.123", "DOT.GENE"]
+    adata = AnnDataLite(X, var=pd.DataFrame(index=names))
+    # make the MT genes carry most counts for the first 10 kept cells
+    pp = Preprocess(random_seed=0)
+    out = pp.filter_adata(adata, min_cells_per_gene=10,
+                          min_counts_per_cell=50, filter_dot_genes=True,
+                          filter_mito_genes=True, makeplots=False)
+    assert "MT-ND1" not in out.var.index
+    assert "RP11.123" not in out.var.index
+    assert (out.obs["n_counts"] >= 50).all()
+    assert out.n_obs == 95                     # the 5 low-count cells dropped
+    # zero-cell genes dropped by the min_cells filter
+    assert out.n_vars <= g - 4
+
+
+def test_preprocess_for_cnmf_handoff_to_prepare(tmp_path, rng):
+    """The three saved files must feed cNMF.prepare(counts_fn, tpm_fn,
+    genes_file) — the documented integration contract (README.md:88-92)."""
+    n, g = 120, 200
+    usage = rng.dirichlet(np.ones(3) * 0.4, size=n)
+    spectra = rng.gamma(0.4, 1.0, size=(3, g)) * 40.0 / g
+    X = rng.poisson(usage @ spectra * 300.0).astype(float)
+    X[X.sum(axis=1) == 0, 0] = 1
+    adata = AnnDataLite(sp.csr_matrix(X),
+                        obs=pd.DataFrame(index=[f"c{i}" for i in range(n)]),
+                        var=pd.DataFrame(index=[f"g{j}" for j in range(g)]))
+
+    pp = Preprocess(random_seed=0)
+    base = str(tmp_path / "pp")
+    adata_rna, tp10k, hvgs = pp.preprocess_for_cnmf(
+        adata, n_top_rna_genes=100, save_output_base=base, makeplots=False)
+    assert adata_rna.n_vars == 100
+    assert len(hvgs) == 100
+    assert tp10k.n_vars == g
+    for suffix in (".Corrected.HVG.Varnorm.h5ad", ".TP10K.h5ad",
+                   ".Corrected.HVGs.txt"):
+        assert os.path.exists(base + suffix)
+
+    from cnmf_torch_tpu import cNMF
+
+    obj = cNMF(output_dir=str(tmp_path), name="pp_run")
+    obj.prepare(base + ".Corrected.HVG.Varnorm.h5ad",
+                tpm_fn=base + ".TP10K.h5ad",
+                genes_file=base + ".Corrected.HVGs.txt",
+                components=[3], n_iter=4, seed=4, batch_size=64,
+                max_NMF_iter=50)
+    obj.factorize()
+    obj.combine()
+    obj.consensus(3, density_threshold=2.0, show_clustering=False,
+                  build_ref=False)
+    assert os.path.exists(obj.paths["consensus_usages"] % (3, "2_0"))
+
+
+def test_preprocess_citeseq_split(rng):
+    n = 60
+    X = rng.poisson(20.0, size=(n, 30)).astype(float)
+    X[X.sum(axis=1) == 0, 0] = 1
+    var = pd.DataFrame({
+        "feature_types": ["Gene Expression"] * 25 + ["Antibody Capture"] * 5,
+    }, index=[f"f{i}" for i in range(30)])
+    adata = AnnDataLite(X, var=var)
+    pp = Preprocess(random_seed=0)
+    adata_rna, tp10k, hvgs = pp.preprocess_for_cnmf(
+        adata, feature_type_col="feature_types", n_top_rna_genes=10,
+        makeplots=False)
+    assert adata_rna.n_vars == 10          # HVG-filtered RNA only
+    assert tp10k.n_vars == 30              # RNA + ADT hstacked back
+    # ADT rows renormalized separately: each cell's ADT block sums to 1e4
+    adt = np.asarray(tp10k.X[:, 25:].todense() if sp.issparse(tp10k.X)
+                     else tp10k.X[:, 25:])
+    np.testing.assert_allclose(adt.sum(axis=1), 1e4, rtol=1e-3)
+
+
+def test_harmony_corrected_genes_nonnegative(rng):
+    n, g = 150, 60
+    batch = np.tile([0, 1], n // 2)
+    X = rng.poisson(8.0, size=(n, g)).astype(float)
+    X[batch == 1, : g // 2] += rng.poisson(6.0, size=(n // 2, g // 2))
+    X[X.sum(axis=1) == 0, 0] = 1
+    obs = pd.DataFrame({"batch": [f"b{b}" for b in batch]},
+                       index=[f"c{i}" for i in range(n)])
+    adata = AnnDataLite(sp.csr_matrix(X), obs=obs,
+                        var=pd.DataFrame(index=[f"g{j}" for j in range(g)]))
+    pp = Preprocess(random_seed=0)
+    adata_rna, _, hvgs = pp.preprocess_for_cnmf(
+        adata, harmony_vars="batch", n_top_rna_genes=30, theta=2,
+        makeplots=False, max_iter_harmony=5)
+    Xc = np.asarray(adata_rna.X)
+    assert (Xc >= 0).all(), "corrected expression must be clipped at zero"
+    assert adata_rna.obsm["X_pca_harmony"].shape[0] == n
+    assert len(hvgs) == 30
+
+
+def test_select_features_mi(rng):
+    n, g = 150, 40
+    cluster = rng.integers(0, 3, size=n)
+    X = rng.poisson(5.0, size=(n, g)).astype(float)
+    # first 5 genes are strongly cluster-informative
+    for c in range(3):
+        X[np.ix_(cluster == c, range(5))] += c * 10
+    adata = AnnDataLite(X, var=pd.DataFrame(index=[f"g{j}" for j in range(g)]))
+    pp = Preprocess(random_seed=0)
+    out = pp.select_features_MI(adata, cluster, n_top_features=5,
+                                makeplots=False)
+    top = set(out.var.index[out.var["highly_variable"]])
+    assert len(top & {f"g{j}" for j in range(5)}) >= 4
