@@ -115,9 +115,12 @@ def build_parser() -> argparse.ArgumentParser:
                         action="store_true",
                         help="[consensus] Produce a clustergram figure "
                              "summarizing the spectra clustering")
+    # BooleanOptionalAction repairs the reference's dead flag (store_true
+    # with default=True can never be disabled, cnmf.py:1437): here
+    # --no-build-reference actually turns starCAT output off
     parser.add_argument("--build-reference", dest="build_reference",
-                        action="store_true", default=True,
-                        help="[consensus] Generates a reference spectra for "
+                        action=argparse.BooleanOptionalAction, default=True,
+                        help="[consensus] Generate reference spectra for "
                              "use in starCAT")
     return parser
 
@@ -148,9 +151,7 @@ def main(argv=None):
         cnmf_obj.combine(components=args.components)
 
     elif args.command == "consensus":
-        if isinstance(args.components, int):
-            ks = [args.components]
-        elif args.components is None:
+        if args.components is None:
             run_params = load_df_from_npz(
                 cnmf_obj.paths["nmf_replicate_parameters"])
             ks = sorted(set(run_params.n_components))

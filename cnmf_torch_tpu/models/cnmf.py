@@ -49,6 +49,7 @@ from ..utils.io import (
     save_df_to_text,
 )
 from ..utils.paths import build_paths
+from ..utils.profiling import StageTimer, trace
 
 __all__ = ["cNMF"]
 
@@ -56,6 +57,20 @@ __all__ = ["cNMF"]
 def compute_tpm(input_counts: AnnDataLite) -> AnnDataLite:
     """Per-cell scaling to 1e6 total counts (``cnmf.py:241-247``)."""
     return normalize_total(input_counts, target_sum=1e6)
+
+
+def _timed(stage_name: str):
+    """Record a pipeline stage in the run's timing ledger and (when
+    CNMF_TPU_PROFILE_DIR is set) an XLA profiler trace."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            with self._timer.stage(stage_name), trace(stage_name):
+                return fn(self, *args, **kwargs)
+        return wrapper
+    return deco
 
 
 class cNMF:
@@ -73,11 +88,16 @@ class cNMF:
             name = "%s_%s" % (now.strftime("%Y_%m_%d"), uuid.uuid4().hex[:6])
         self.name = name
         self.paths = build_paths(output_dir, name)
+        # per-stage wall-clock ledger + optional XLA traces (SURVEY.md §5.1:
+        # the reference has no tracing; this fills that gap)
+        self._timer = StageTimer(os.path.join(
+            output_dir, name, "cnmf_tmp", name + ".timings.tsv"))
 
     # ------------------------------------------------------------------
     # prepare
     # ------------------------------------------------------------------
 
+    @_timed("prepare")
     def prepare(self, counts_fn, components, n_iter=100, densify=False,
                 tpm_fn=None, seed=None, beta_loss="frobenius",
                 num_highvar_genes=2000, genes_file=None, alpha_usage=0.0,
@@ -274,6 +294,7 @@ class cNMF:
         usages, spectra, _err = run_nmf(X, **kwargs)
         return spectra, usages
 
+    @_timed("factorize")
     def factorize(self, worker_i=0, total_workers=1,
                   skip_completed_runs=False, batched=True, mesh=None,
                   replicates_per_batch=None):
@@ -364,6 +385,7 @@ class cNMF:
     # combine
     # ------------------------------------------------------------------
 
+    @_timed("combine")
     def combine(self, components=None, skip_missing_files=False):
         if isinstance(components, int):
             ks = [components]
@@ -442,6 +464,7 @@ class cNMF:
     # consensus
     # ------------------------------------------------------------------
 
+    @_timed("consensus")
     def consensus(self, k, density_threshold=0.5,
                   local_neighborhood_size=0.30, show_clustering=True,
                   build_ref=True, skip_density_and_return_after_stats=False,
@@ -621,6 +644,7 @@ class cNMF:
         save_df_to_text(ref_spectra,
                         self.paths["starcat_spectra__txt"] % (k, dt_repl))
 
+    @_timed("k_selection_plot")
     def k_selection_plot(self, close_fig=False):
         """Stability (silhouette) / error curve over the K sweep
         (``cnmf.py:1293-1332``; method credit Alexandrov et al. 2013)."""

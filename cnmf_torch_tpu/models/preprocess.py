@@ -67,8 +67,13 @@ class Preprocess:
     """Optional upstream pipeline producing the three files ``prepare()``
     consumes (counts_fn / tpm_fn / genes_file, README.md:88-92)."""
 
-    def __init__(self, random_seed=None):
+    def __init__(self, random_seed=None, plot_dir=None):
+        """``plot_dir``: where ``makeplots=True`` figures are saved as PNGs.
+        When None, figures are left open on the pyplot stack (the
+        reference's notebook-display behavior) for the caller to show or
+        save."""
         self.random_seed = 0 if random_seed is None else int(random_seed)
+        self.plot_dir = plot_dir
         np.random.seed(random_seed)
 
     # ------------------------------------------------------------------
@@ -297,33 +302,36 @@ class Preprocess:
         _adata.var["highly_variable"] = _adata.var["MI_Rank"] < n_top_features
         return _adata
 
-    # -- plotting helpers (host-side, Agg) -----------------------------
+    # -- plotting helpers (host-side) ----------------------------------
 
-    @staticmethod
-    def _hist(values, title):
-        import matplotlib
+    def _finish_fig(self, fig, slug: str):
+        """Save to plot_dir when configured, else leave the figure open on
+        the pyplot stack for interactive display."""
+        if self.plot_dir is not None:
+            import os
 
-        matplotlib.use("Agg")
+            os.makedirs(self.plot_dir, exist_ok=True)
+            fig.savefig(os.path.join(self.plot_dir, slug + ".png"), dpi=150)
+            import matplotlib.pyplot as plt
+
+            plt.close(fig)
+
+    def _hist(self, values, title):
         import matplotlib.pyplot as plt
 
         fig, ax = plt.subplots()
         ax.hist(np.asarray(values), bins=100)
         ax.set_title(title)
-        plt.close(fig)
+        self._finish_fig(fig, title.replace(" ", "_"))
 
-    @staticmethod
-    def _count_hist(adata, num_cells=1000):
+    def _count_hist(self, adata, num_cells=1000):
         X = adata.X[:num_cells, :]
         y = (np.asarray(X.todense()) if sp.issparse(X)
              else np.asarray(X)).reshape(-1)
-        Preprocess._hist(y[y > 0],
-                         "Quantile thresholded normalized count distribution")
+        self._hist(y[y > 0],
+                   "Quantile thresholded normalized count distribution")
 
-    @staticmethod
-    def _mi_plot(resdf, n_top_features):
-        import matplotlib
-
-        matplotlib.use("Agg")
+    def _mi_plot(self, resdf, n_top_features):
         import matplotlib.pyplot as plt
 
         fig, ax = plt.subplots(1, 1, figsize=(10, 3), dpi=100)
@@ -334,4 +342,4 @@ class Preprocess:
         ax.vlines(x=n_top_features, ymin=ylim[0], ymax=ylim[1],
                   linestyle="--", color="k")
         ax.set_ylim(ylim)
-        plt.close(fig)
+        self._finish_fig(fig, "MI_rank")

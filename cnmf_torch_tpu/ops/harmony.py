@@ -83,15 +83,18 @@ def _block_R_update(dist_blk, phi_blk, E, O, R_blk, Pr_b, sigma, theta):
     contribution removed from E/O first (out-of-block statistics)."""
     E = E - jnp.outer(R_blk.sum(axis=1), Pr_b)
     O = O - jnp.matmul(R_blk, phi_blk.T, precision=_HI)
-    # log-domain for stability; theta is per-batch-level, applied before
-    # projecting the (K x B) penalty onto the block's cells
+    # Harmony's published update: the (K x B) penalty matrix
+    # ((E+1)/(O+1))^theta projected onto each cell's active batch levels by
+    # a dot product — i.e. a SUM over batch variables when several are
+    # corrected at once, not a product (the two only coincide for a single
+    # batch variable, where exactly one level is active per cell)
+    dist_term = jnp.exp(-dist_blk / sigma[:, None])
     penalty = jnp.matmul(
-        theta[None, :] * jnp.log((E + 1.0) / (O + 1.0)), phi_blk,
+        jnp.power((E + 1.0) / (O + 1.0), theta[None, :]), phi_blk,
         precision=_HI)
-    Rl = -dist_blk / sigma[:, None] + penalty
-    Rl = Rl - jnp.max(Rl, axis=0, keepdims=True)
-    R_new = jnp.exp(Rl)
-    R_new = R_new / jnp.sum(R_new, axis=0, keepdims=True)
+    R_new = dist_term * penalty
+    R_new = R_new / jnp.maximum(
+        jnp.sum(R_new, axis=0, keepdims=True), 1e-30)
     E = E + jnp.outer(R_new.sum(axis=1), Pr_b)
     O = O + jnp.matmul(R_new, phi_blk.T, precision=_HI)
     return R_new, E, O

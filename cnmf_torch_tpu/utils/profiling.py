@@ -1,0 +1,85 @@
+"""Per-stage tracing: wall-clock ledger + optional XLA profiler traces.
+
+The reference has no tracing at all — only stdout progress prints
+(``/root/reference/src/cnmf/cnmf.py:884, 793, 897``; SURVEY.md §5.1 calls
+this out as a gap to fill). This module provides:
+
+  * :class:`StageTimer` — context manager recording per-stage wall-clock
+    (and optional metadata) to ``<run_dir>/cnmf_tmp/<name>.timings.tsv``,
+    appended across pipeline invocations so a resumed run accumulates a
+    complete timeline;
+  * :func:`trace` — wraps a stage in a ``jax.profiler`` trace when
+    ``CNMF_TPU_PROFILE_DIR`` is set, producing TensorBoard-loadable XLA
+    traces of the device work with zero overhead when unset.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+__all__ = ["StageTimer", "trace", "PROFILE_ENV"]
+
+PROFILE_ENV = "CNMF_TPU_PROFILE_DIR"
+
+
+class StageTimer:
+    """Append-only wall-clock ledger for pipeline stages."""
+
+    def __init__(self, timings_path: str | None):
+        self.timings_path = timings_path
+
+    @contextlib.contextmanager
+    def stage(self, name: str, **meta):
+        t0 = time.perf_counter()
+        err = ""
+        try:
+            yield
+        except BaseException as exc:
+            err = type(exc).__name__
+            raise
+        finally:
+            elapsed = time.perf_counter() - t0
+            self._record(name, elapsed, err, meta)
+
+    def _record(self, name: str, elapsed: float, err: str, meta: dict):
+        if self.timings_path is None:
+            return
+        meta_str = ";".join(f"{k}={v}" for k, v in sorted(meta.items()))
+        header_needed = not os.path.exists(self.timings_path)
+        try:
+            with open(self.timings_path, "a") as f:
+                if header_needed:
+                    f.write("stage\twall_seconds\ttimestamp\terror\tmeta\n")
+                f.write(f"{name}\t{elapsed:.4f}\t{time.time():.1f}\t"
+                        f"{err}\t{meta_str}\n")
+        except OSError:
+            pass  # tracing must never take the pipeline down
+
+
+_trace_active = False
+
+
+@contextlib.contextmanager
+def trace(stage_name: str):
+    """XLA profiler trace of a stage when CNMF_TPU_PROFILE_DIR is set.
+
+    Reentrant-safe: JAX allows only one active profiler session, and
+    pipeline stages nest (k_selection_plot calls consensus), so an inner
+    stage inside an active trace is a no-op — its device work is already
+    captured by the outer session.
+    """
+    global _trace_active
+    profile_dir = os.environ.get(PROFILE_ENV)
+    if not profile_dir or _trace_active:
+        yield
+        return
+    import jax
+
+    _trace_active = True
+    try:
+        with jax.profiler.trace(os.path.join(profile_dir, stage_name)):
+            yield
+    finally:
+        _trace_active = False

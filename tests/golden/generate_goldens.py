@@ -1,0 +1,96 @@
+"""Regenerate the golden artifacts for test_reproducibility.py.
+
+Mirrors the reference's golden design (``/root/reference/tests/
+test_reproducibility.py`` + ``Extras/prepare_unittest_*.ipynb``): the
+stochastic factorize stage is NOT under golden test — a fixed
+merged-spectra fixture is generated once from seeded replicate runs, and
+the deterministic stages around it (prepare artifacts, consensus math) are
+snapshotted for RMS < 1e-4 comparison. The reference fetches its goldens
+from GCS (``download_pytest_data.py``); this environment has no egress, so
+goldens are generated locally by this script and committed.
+
+Run from the repo root:  python tests/golden/generate_goldens.py
+Goldens land in tests/golden/data/ — regenerate ONLY when an intentional
+numeric-contract change is made, and say so in the commit message.
+"""
+
+import os
+import shutil
+import sys
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax  # noqa: E402
+
+# goldens are defined on the CPU backend — the same backend the test suite
+# runs on (conftest.py); fp32 TPU drift is absorbed by the RMS tolerance
+jax.config.update("jax_platforms", "cpu")
+
+from cnmf_torch_tpu import cNMF  # noqa: E402
+from cnmf_torch_tpu.utils import save_df_to_npz  # noqa: E402
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "data")
+N, G, K_TRUE = 90, 180, 4
+KS = [4, 5]
+N_ITER = 6
+SEED = 14
+NUM_HVG = 120
+CONSENSUS = [(4, 0.5), (4, 2.0)]
+
+
+def make_counts() -> pd.DataFrame:
+    rng = np.random.default_rng(123)
+    usage = rng.dirichlet(np.ones(K_TRUE) * 0.3, size=N)
+    spectra = rng.gamma(0.3, 1.0, size=(K_TRUE, G)) * 50.0 / G
+    counts = rng.poisson(usage @ spectra * 250.0).astype(np.float64)
+    counts[counts.sum(axis=1) == 0, 0] = 1.0
+    return pd.DataFrame(counts, index=[f"cell{i}" for i in range(N)],
+                        columns=[f"gene{j}" for j in range(G)])
+
+
+def main():
+    os.makedirs(GOLDEN_DIR, exist_ok=True)
+    workdir = tempfile.mkdtemp(prefix="golden_gen_")
+
+    counts_fn = os.path.join(GOLDEN_DIR, "counts.df.npz")
+    save_df_to_npz(make_counts(), counts_fn)
+
+    obj = cNMF(output_dir=workdir, name="golden")
+    obj.prepare(counts_fn, components=KS, n_iter=N_ITER, seed=SEED,
+                num_highvar_genes=NUM_HVG, batch_size=64, max_NMF_iter=200)
+    obj.factorize()
+    obj.combine()
+    for k, dt in CONSENSUS:
+        obj.consensus(k, density_threshold=dt, show_clustering=False,
+                      build_ref=True)
+    obj.k_selection_plot(close_fig=True)
+
+    keep = [
+        ("nmf_replicate_parameters", ()),
+        ("nmf_run_parameters", ()),
+        ("nmf_genes_list", ()),
+        ("tpm_stats", ()),
+        ("k_selection_stats", ()),
+    ]
+    keep += [("merged_spectra", (k,)) for k in KS]
+    for k, dt in CONSENSUS:
+        dtr = str(dt).replace(".", "_")
+        keep += [(key, (k, dtr)) for key in
+                 ["consensus_spectra", "consensus_usages",
+                  "gene_spectra_score", "gene_spectra_tpm",
+                  "starcat_spectra"]]
+
+    for key, fmt in keep:
+        src = obj.paths[key] % fmt if fmt else obj.paths[key]
+        dst = os.path.join(GOLDEN_DIR, os.path.basename(src))
+        shutil.copyfile(src, dst)
+        print("golden:", os.path.basename(src))
+    shutil.rmtree(workdir)
+
+
+if __name__ == "__main__":
+    main()

@@ -128,6 +128,40 @@ def test_run_harmony_reduces_batch_separation(rng):
     assert res.Phi_moe.shape == (3, Z.shape[0])  # intercept + 2 batch levels
 
 
+def test_run_harmony_multi_variable(rng):
+    """Two batch variables at once: the diversity penalty sums over the
+    variables (Harmony's dot-product projection), and both artifacts should
+    shrink."""
+    Z, obs, bio, batch = _two_batch_embedding(rng)
+    # site must be orthogonal to biology (a confounded variable would make
+    # removing it correctly destroy the signal)
+    site = rng.integers(0, 2, size=len(batch))
+    Z[site == 1, 2] += 3.0
+    obs = obs.copy()
+    obs["site"] = [f"s{s}" for s in site]
+    res = run_harmony(Z, obs, ["batch", "site"], theta=2.0,
+                      max_iter_harmony=10, nclust=10, random_state=1)
+    Zc = res.Z_corr.T
+    assert res.Phi_moe.shape == (5, Z.shape[0])  # intercept + 2 + 2 levels
+
+    def gap(M, lab):
+        return np.linalg.norm(M[lab == 0].mean(0) - M[lab == 1].mean(0))
+
+    assert gap(Zc, batch) < 0.4 * gap(Z, batch)
+    assert gap(Zc, site) < 0.4 * gap(Z, site)
+    assert gap(Zc, bio) > 0.6 * gap(Z, bio)
+
+
+def test_preprocess_plot_dir(tmp_path, rng):
+    X = rng.poisson(10.0, size=(50, 30)).astype(float)
+    adata = AnnDataLite(X)
+    pp = Preprocess(random_seed=0, plot_dir=str(tmp_path / "plots"))
+    pp.filter_adata(adata, min_cells_per_gene=1, min_counts_per_cell=1,
+                    makeplots=True)
+    pngs = list((tmp_path / "plots").glob("*.png"))
+    assert pngs, "makeplots=True with plot_dir must save figures"
+
+
 def test_moe_correct_ridge_removes_batch_offset(rng):
     # genes x cells matrix with a per-batch offset; a single-cluster R
     # reduces the MOE to one ridge expert that should strip the offset
