@@ -91,7 +91,9 @@ def read_10x_mtx(path: str) -> AnnDataLite:
     mtx_fn = _find_10x_sidecar(path, ["matrix.mtx"])
     if mtx_fn is None:
         raise FileNotFoundError(f"no matrix.mtx[.gz] in {path}")
-    X = scipy.io.mmread(mtx_fn).T.tocsr()
+    from ..native import read_mtx
+
+    X = read_mtx(mtx_fn).T.tocsr()
 
     genes_fn = _find_10x_sidecar(path, ["features.tsv", "genes.tsv"])
     barcodes_fn = _find_10x_sidecar(path, ["barcodes.tsv"])
