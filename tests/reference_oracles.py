@@ -1,0 +1,170 @@
+"""Test-only numeric oracles re-deriving the reference implementation's math.
+
+The golden tier (tests/golden/) pins the repo against its own snapshots; this
+module exists so the suite can also detect drift from the *reference's*
+numerics (VERDICT r1 item 2). Each oracle is an independent torch/numpy
+implementation of the algorithm specified by the cited reference function —
+same math and iteration semantics, written from the spec. They run on the
+same dependency stack the reference uses (torch CPU, sklearn, numpy float64)
+so their outputs stand in for the reference's, which cannot be imported here
+(its `nmf-torch`/`scanpy` deps are absent).
+
+Citations refer to /root/reference/src/cnmf/cnmf.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+import scipy.sparse as sp
+from sklearn.preprocessing import StandardScaler
+
+
+def mean_var_oracle(Y):
+    """Population column moments via StandardScaler — the reference's
+    `get_mean_var` (cnmf.py:128-131) delegates to this exact sklearn call."""
+    s = StandardScaler(with_mean=False).fit(Y)
+    return s.mean_, s.var_
+
+
+def ols_oracle(X, Y, batch_size: int = 1024, normalize_y: bool = False):
+    """Batched normal-equation OLS, spec of `efficient_ols_all_cols`
+    (cnmf.py:56-126): float64 XtX/XtY accumulated over row batches; when
+    `normalize_y`, Y's columns are z-scored with *global* population moments
+    (variance floored at 1e-12) one densified batch at a time; solved with
+    `np.linalg.lstsq` on the accumulated system."""
+    X = np.asarray(X, dtype=np.float64)
+    n, p = X.shape
+    g = Y.shape[1]
+    if normalize_y:
+        mu, var = mean_var_oracle(Y)
+        sd = np.sqrt(np.where(var < 1e-12, 1e-12, var))
+    xtx = np.zeros((p, p))
+    xty = np.zeros((p, g))
+    for lo in range(0, n, batch_size):
+        hi = min(lo + batch_size, n)
+        xb = X[lo:hi]
+        yb = Y[lo:hi]
+        if normalize_y:
+            if sp.issparse(yb):
+                yb = yb.toarray()
+            yb = (yb - mu) / sd
+        xtx += xb.T @ xb
+        xty += xb.T @ yb
+    return np.linalg.lstsq(xtx, xty, rcond=None)[0]
+
+
+def highvar_genes_oracle(expression, expected_fano_threshold=None,
+                         minimal_mean: float = 0.5, numgenes=None):
+    """Fano-factor over-dispersion scoring, spec of `get_highvar_genes_sparse`
+    (cnmf.py:133-184): expected-Fano line A²·mean + B² with A = min CV of the
+    20 highest-mean genes and B² = median Fano inside the 10–90th-percentile
+    winsor box; selection by top-`numgenes` fano_ratio, or by
+    `fano_ratio > T` (T = 1 + std of winsorized Fano unless given) with a
+    `minimal_mean` floor. Returns (stats_df, params_dict)."""
+    mean_, var_ = mean_var_oracle(expression)
+    mean_s = pd.Series(mean_)
+    var_s = pd.Series(var_)
+    fano = var_s / mean_s
+
+    top20 = mean_s.sort_values(ascending=False).index[:20]
+    a_param = (np.sqrt(var_s) / mean_s)[top20].min()
+
+    m_lo, m_hi = mean_s.quantile([0.10, 0.90])
+    f_lo, f_hi = fano.quantile([0.10, 0.90])
+    in_box = (fano > f_lo) & (fano < f_hi) & (mean_s > m_lo) & (mean_s < m_hi)
+    b_param = np.sqrt(fano[in_box].median())
+
+    expected = a_param ** 2 * mean_s + b_param ** 2
+    ratio = fano / expected
+
+    if numgenes is not None:
+        chosen = ratio.sort_values(ascending=False).index[:numgenes]
+        high_var = ratio.index.isin(chosen)
+        t_param = None
+    else:
+        t_param = (expected_fano_threshold
+                   if expected_fano_threshold else 1.0 + fano[in_box].std())
+        high_var = (ratio > t_param) & (mean_s > minimal_mean)
+
+    stats = pd.DataFrame({
+        "mean": mean_s, "var": var_s, "fano": fano,
+        "expected_fano": expected, "high_var": high_var,
+        "fano_ratio": ratio,
+    })
+    return stats, {"A": a_param, "B": b_param, "T": t_param,
+                   "minimal_mean": minimal_mean}
+
+
+def fit_h_online_oracle(X, W, H_init, chunk_size: int = 5000,
+                        chunk_max_iter: int = 200, h_tol: float = 0.05,
+                        l1_reg_H: float = 0.0, l2_reg_H: float = 0.0,
+                        eps: float = 1e-16):
+    """Fixed-W online MU usage solver in torch fp32, spec of `fit_H_online`
+    (cnmf.py:260-388): one pass over row chunks; per chunk, the numerator
+    X·Wᵀ is computed once (L1 subtracted and clamped), then MU steps
+    H ← H · numer/(H·WWᵀ + l2·H) run until the relative Frobenius change of
+    the block is below `h_tol` or `chunk_max_iter`, zeroing rates where the
+    denominator underflows `eps`."""
+    import torch
+
+    x_t = torch.as_tensor(np.ascontiguousarray(np.asarray(X, np.float32)))
+    w_t = torch.as_tensor(np.ascontiguousarray(np.asarray(W, np.float32)))
+    h_t = torch.as_tensor(
+        np.ascontiguousarray(np.asarray(H_init, np.float32))).clamp(min=0.0).clone()
+    gram = w_t @ w_t.T
+    n = x_t.shape[0]
+    for lo in range(0, n, chunk_size):
+        x = x_t[lo:lo + chunk_size]
+        h = h_t[lo:lo + chunk_size]
+        numer = x @ w_t.T
+        if l1_reg_H > 0:
+            numer = (numer - l1_reg_H).clamp(min=0.0)
+        for _ in range(chunk_max_iter):
+            denom = h @ gram
+            if l2_reg_H > 0:
+                denom = denom + l2_reg_H * h
+            step = numer / denom
+            step[denom < eps] = 0.0
+            h_new = h * step
+            rel = torch.norm(h_new - h) / (torch.norm(h) + eps)
+            h = h_new
+            if rel < h_tol:
+                break
+        h_t[lo:lo + chunk_size] = h
+    return h_t.numpy()
+
+
+def local_density_oracle(l2_spectra: np.ndarray, n_neighbors: int):
+    """KNN local-density outlier score, spec of the consensus density filter
+    (cnmf.py:1065-1071): full euclidean distance matrix, argpartition to the
+    (n_neighbors+1) closest (self included at distance 0), mean distance to
+    the n nearest."""
+    from sklearn.metrics import euclidean_distances
+
+    dist = euclidean_distances(l2_spectra)
+    order = np.argpartition(dist, n_neighbors + 1)[:, :n_neighbors + 1]
+    nearest = dist[np.arange(dist.shape[0])[:, None], order]
+    return nearest.sum(axis=1) / n_neighbors
+
+
+def consensus_medians_oracle(l2_spectra: pd.DataFrame, labels: pd.Series):
+    """Cluster-median spectra renormalized to probability distributions,
+    spec of cnmf.py:1087-1090."""
+    med = l2_spectra.groupby(labels).median()
+    return (med.T / med.sum(axis=1)).T
+
+
+def reorder_oracle(rf_usages: pd.DataFrame, median_spectra: pd.DataFrame):
+    """GEP reordering by total normalized usage, spec of cnmf.py:1113-1120;
+    returns (rf_usages, norm_usages, median_spectra) with 1..k columns."""
+    norm = rf_usages.div(rf_usages.sum(axis=1), axis=0)
+    order = norm.sum(axis=0).sort_values(ascending=False).index
+    rf_usages = rf_usages.loc[:, order]
+    norm = norm.loc[:, order]
+    median_spectra = median_spectra.loc[order, :]
+    new_cols = np.arange(1, rf_usages.shape[1] + 1)
+    rf_usages.columns = new_cols
+    norm.columns = new_cols
+    median_spectra.index = new_cols
+    return rf_usages, norm, median_spectra
