@@ -103,6 +103,16 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--sequential", action="store_true", default=False,
                         help="[factorize] Run replicates one at a time "
                              "instead of as one batched device program")
+    parser.add_argument("--rowshard", dest="rowshard",
+                        action=argparse.BooleanOptionalAction, default=None,
+                        help="[factorize] Shard the cells axis across the "
+                             "device mesh (atlas-scale inputs), streaming "
+                             "sparse row blocks host-to-HBM instead of "
+                             "densifying. Default: auto above "
+                             "--rowshard-threshold cells")
+    parser.add_argument("--rowshard-threshold", type=int, default=200_000,
+                        help="[factorize] Cell count at which factorize "
+                             "auto-switches to the row-sharded path")
     parser.add_argument("--local-density-threshold", type=float, default=0.5,
                         help="[consensus] Threshold for the local density "
                              "filtering. This string must convert to a float "
@@ -145,7 +155,9 @@ def main(argv=None):
             worker_i=args.worker_index,
             total_workers=max(args.total_workers, 1),
             skip_completed_runs=args.skip_completed_runs,
-            batched=not args.sequential)
+            batched=not args.sequential,
+            rowshard=args.rowshard,
+            rowshard_threshold=args.rowshard_threshold)
 
     elif args.command == "combine":
         cnmf_obj.combine(components=args.components)

@@ -297,7 +297,8 @@ class cNMF:
     @_timed("factorize")
     def factorize(self, worker_i=0, total_workers=1,
                   skip_completed_runs=False, batched=True, mesh=None,
-                  replicates_per_batch=None):
+                  replicates_per_batch=None, rowshard=None,
+                  rowshard_threshold: int = 200_000):
         """Run this worker's share of the replicate ledger.
 
         Contract-compatible with the reference (``cnmf.py:839-892``):
@@ -308,6 +309,13 @@ class cNMF:
         over ``mesh`` when given (defaults to all local devices) — the
         reference's outer Python process loop becomes a batched device
         program. ``batched=False`` preserves the sequential per-task path.
+
+        Atlas-scale inputs (``rowshard=True``, or auto when
+        ``n_cells >= rowshard_threshold``; BASELINE config 5): instead of
+        replicating a densified X to every device, the cells axis is sharded
+        across the mesh — CSR row blocks stream host→HBM one shard at a time
+        (never a host dense copy), the staged device array is reused across
+        all replicates, and each replicate's W statistics psum over ICI.
         """
         run_params = load_df_from_npz(self.paths["nmf_replicate_parameters"])
         norm_counts = read_h5ad(self.paths["normalized_counts"])
@@ -322,6 +330,13 @@ class cNMF:
                 run_params.index[run_params["completed"] == False],  # noqa: E712
                 worker_i, total_workers)
         jobs = list(jobs)
+
+        if rowshard is None:
+            rowshard = norm_counts.X.shape[0] >= int(rowshard_threshold)
+        if rowshard:
+            self._factorize_rowsharded(jobs, run_params, norm_counts,
+                                       _nmf_kwargs, mesh, worker_i)
+            return
 
         if not batched:
             for idx in jobs:
@@ -380,6 +395,46 @@ class cNMF:
                                   index=np.arange(1, k + 1),
                                   columns=norm_counts.var.index)
                 save_df_to_npz(df, self.paths["iter_spectra"] % (k, it))
+
+    def _factorize_rowsharded(self, jobs, run_params, norm_counts,
+                              nmf_kwargs, mesh, worker_i):
+        """Atlas-scale factorize: cells sharded over the mesh, replicates
+        sequential. X streams host→HBM once (shard-sized CSR blocks, no host
+        dense copy) and is reused by every replicate; padded rows contribute
+        nothing to the psum'd W statistics (rowshard.py)."""
+        from ..parallel import default_mesh
+        from ..parallel.rowshard import nmf_fit_rowsharded, prepare_rowsharded
+
+        if mesh is None:
+            mesh = default_mesh(axis_name="cells")
+        if mesh is None:  # single device: a trivial 1-element mesh
+            import jax
+            from jax.sharding import Mesh
+
+            mesh = Mesh(np.asarray(jax.devices()[:1]), ("cells",))
+
+        Xd, n_orig = prepare_rowsharded(norm_counts.X, mesh)
+        print("[Worker %d]. Row-sharded factorize: %d cells over %d devices, "
+              "%d tasks." % (worker_i, n_orig,
+                             int(np.prod(mesh.devices.shape)), len(jobs)))
+        for idx in jobs:
+            p = run_params.iloc[idx, :]
+            k = int(p["n_components"])
+            _H, spectra, _err = nmf_fit_rowsharded(
+                Xd, k, mesh,
+                beta_loss=nmf_kwargs["beta_loss"],
+                seed=int(p["nmf_seed"]),
+                tol=nmf_kwargs.get("tol", 1e-4),
+                n_passes=nmf_kwargs.get("n_passes", 20),
+                chunk_max_iter=nmf_kwargs.get("online_chunk_max_iter", 200),
+                alpha_W=nmf_kwargs.get("alpha_W", 0.0),
+                l1_ratio_W=nmf_kwargs.get("l1_ratio_W", 0.0),
+                alpha_H=nmf_kwargs.get("alpha_H", 0.0),
+                l1_ratio_H=nmf_kwargs.get("l1_ratio_H", 0.0),
+                n_orig=n_orig)
+            df = pd.DataFrame(spectra, index=np.arange(1, k + 1),
+                              columns=norm_counts.var.index)
+            save_df_to_npz(df, self.paths["iter_spectra"] % (k, p["iter"]))
 
     # ------------------------------------------------------------------
     # combine

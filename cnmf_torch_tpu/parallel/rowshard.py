@@ -38,7 +38,8 @@ from ..ops.nmf import (
     split_regularization,
 )
 
-__all__ = ["nmf_fit_rowsharded", "fit_h_rowsharded", "pad_rows_to_mesh"]
+__all__ = ["nmf_fit_rowsharded", "fit_h_rowsharded", "pad_rows_to_mesh",
+           "stream_rows_to_mesh", "prepare_rowsharded"]
 
 
 def pad_rows_to_mesh(X, n_dev: int):
@@ -53,6 +54,40 @@ def pad_rows_to_mesh(X, n_dev: int):
         else:
             X = np.pad(np.asarray(X), ((0, pad), (0, 0)))
     return X, pad
+
+
+def stream_rows_to_mesh(X, mesh: Mesh, axis: str, dtype=jnp.float32):
+    """Out-of-core host→HBM transfer: build the row-sharded device array
+    straight from a host CSR (or dense) matrix, densifying one device
+    shard's row slice at a time. The full dense matrix never exists on
+    host — this is the reference's 5,000-row streaming contract
+    (``cnmf.py:350-381``) with the shard boundary as the streaming unit.
+
+    Returns ``(X_device, pad)`` where ``pad`` rows of zeros were appended to
+    make the cells axis divide the mesh.
+    """
+    n_dev = math.prod(mesh.devices.shape)
+    X, pad = pad_rows_to_mesh(X, n_dev)
+    if sp.issparse(X):
+        X = X.tocsr()
+    sharding = NamedSharding(mesh, P(axis, None))
+
+    def _shard_block(index):
+        blk = X[index[0]]
+        if sp.issparse(blk):
+            blk = blk.toarray()
+        return np.ascontiguousarray(np.asarray(blk, dtype=dtype))
+
+    return jax.make_array_from_callback(X.shape, sharding, _shard_block), pad
+
+
+def prepare_rowsharded(X, mesh: Mesh):
+    """Stage a counts matrix for repeated row-sharded solves (one transfer,
+    many replicates). Returns ``(X_device, n_orig)`` to pass to
+    :func:`nmf_fit_rowsharded` / :func:`fit_h_rowsharded`."""
+    n_orig = int(X.shape[0])
+    Xd, _ = stream_rows_to_mesh(X, mesh, mesh.axis_names[0])
+    return Xd, n_orig
 
 
 def _rowsharded_pass(X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
@@ -128,9 +163,15 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
                        seed: int = 0, tol: float = 1e-4, h_tol: float = 0.05,
                        n_passes: int = 20, chunk_max_iter: int = 200,
                        alpha_W: float = 0.0, l1_ratio_W: float = 0.0,
-                       alpha_H: float = 0.0, l1_ratio_H: float = 0.0):
+                       alpha_H: float = 0.0, l1_ratio_H: float = 0.0,
+                       n_orig: int | None = None):
     """Factorize a cells-sharded X over ``mesh`` (1-D). Returns
     ``(H (n,k), W (k,g), err)`` as numpy arrays.
+
+    ``X`` may be a host matrix (dense or CSR — streamed shard-by-shard to
+    HBM without a host dense copy) or a device array already staged by
+    :func:`prepare_rowsharded` (pass its ``n_orig``), which amortizes the
+    transfer across a replicate sweep.
 
     The semantic contract matches the single-chip online solver (block
     coordinate descent with tightly solved usage blocks and an exact
@@ -145,20 +186,22 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
         # a different objective than the convergence test evaluates
         raise ValueError(
             f"nmf_fit_rowsharded supports beta in {{2, 1, 0}}, got {beta}")
-    n_dev = math.prod(mesh.devices.shape)
     axis = mesh.axis_names[0]
-    n_orig = X.shape[0]
-    if sp.issparse(X):
-        X = X.toarray()
-    X, _ = pad_rows_to_mesh(np.asarray(X), n_dev)
-    n, g = X.shape
+    if isinstance(X, jax.Array):
+        Xd = X
+        if n_orig is None:
+            n_orig = int(X.shape[0])
+    else:
+        n_orig = int(X.shape[0])
+        Xd, _ = stream_rows_to_mesh(X, mesh, axis)
+    n, g = Xd.shape
 
     key = jax.random.key(int(seed) & 0x7FFFFFFF)
-    H0, W0 = random_init(key, n, g, int(k), jnp.float32(np.mean(X)))
+    x_mean = jnp.mean(Xd)  # computed on-device; psum-free (jit reduction)
+    H0, W0 = random_init(key, n, g, int(k), x_mean)
 
     row_sh = NamedSharding(mesh, P(axis, None))
     rep_sh = NamedSharding(mesh, P())
-    Xd = jax.device_put(jnp.asarray(X, jnp.float32), row_sh)
     H0 = jax.device_put(H0, row_sh)
     W0 = jax.device_put(W0, rep_sh)
 
@@ -188,25 +231,31 @@ def _fit_h_rowsharded_jit(X, H0, W, mesh, axis, beta, chunk_max_iter, h_tol,
 
 def fit_h_rowsharded(X, W, mesh: Mesh, h_tol: float = 0.05,
                      chunk_max_iter: int = 200, l1_reg_H: float = 0.0,
-                     l2_reg_H: float = 0.0, beta=2.0, seed: int = 0):
+                     l2_reg_H: float = 0.0, beta=2.0, seed: int = 0,
+                     n_orig: int | None = None):
     """Row-sharded fixed-W usage refit: zero communication (W replicated,
     every H row depends only on its own X row) — the distributed form of
-    ``fit_h`` / the reference's ``fit_H_online`` (cnmf.py:260-388)."""
+    ``fit_h`` / the reference's ``fit_H_online`` (cnmf.py:260-388).
+
+    ``X`` may be a host matrix (streamed shard-by-shard, no host dense copy)
+    or a device array from :func:`prepare_rowsharded` with its ``n_orig``.
+    """
     beta = beta_loss_to_float(beta)
-    n_dev = math.prod(mesh.devices.shape)
     axis = mesh.axis_names[0]
-    n_orig = X.shape[0]
-    if sp.issparse(X):
-        X = X.toarray()
-    X, _ = pad_rows_to_mesh(np.asarray(X), n_dev)
+    if isinstance(X, jax.Array):
+        Xd = X
+        if n_orig is None:
+            n_orig = int(X.shape[0])
+    else:
+        n_orig = int(X.shape[0])
+        Xd, _ = stream_rows_to_mesh(X, mesh, axis)
     W = jnp.asarray(np.asarray(W), jnp.float32)
     k = W.shape[0]
 
     key = jax.random.key(int(seed) & 0x7FFFFFFF)
-    H0 = jax.random.uniform(key, (X.shape[0], k), dtype=jnp.float32)
+    H0 = jax.random.uniform(key, (Xd.shape[0], k), dtype=jnp.float32)
 
     row_sh = NamedSharding(mesh, P(axis, None))
-    Xd = jax.device_put(jnp.asarray(X, jnp.float32), row_sh)
     H0 = jax.device_put(H0, row_sh)
     Wd = jax.device_put(W, NamedSharding(mesh, P()))
 
