@@ -332,7 +332,10 @@ class cNMF:
         jobs = list(jobs)
 
         if rowshard is None:
-            rowshard = norm_counts.X.shape[0] >= int(rowshard_threshold)
+            # auto-engage only for the default batched path: an explicit
+            # batched=False / --sequential request keeps its solver
+            rowshard = (batched
+                        and norm_counts.X.shape[0] >= int(rowshard_threshold))
         if rowshard:
             self._factorize_rowsharded(jobs, run_params, norm_counts,
                                        _nmf_kwargs, mesh, worker_i)
@@ -360,10 +363,20 @@ class cNMF:
 
             mesh = default_mesh()
 
+        import jax
+        import jax.numpy as jnp
+
         X = norm_counts.X
         if sp.issparse(X):
             X = X.toarray()
-        X = np.asarray(X, dtype=np.float32)
+        # device-resident once, reused by every per-K sweep program (a jit
+        # argument, so the host->HBM transfer happens exactly once); with a
+        # mesh, replicate it across devices here rather than per sweep call
+        X = jnp.asarray(np.asarray(X, dtype=np.float32))
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            X = jax.device_put(X, NamedSharding(mesh, PartitionSpec()))
 
         by_k: dict[int, list] = {}
         for idx in jobs:
@@ -371,12 +384,31 @@ class cNMF:
             by_k.setdefault(int(p["n_components"]), []).append(
                 (int(p["iter"]), int(p["nmf_seed"])))
 
+        # pipelined sweep: dispatch runs ahead of fetch+save by a bounded
+        # window, so device->host copies of earlier Ks overlap the compute
+        # of later ones while (a) each K's spectra files still land on disk
+        # as soon as that K is done (crash-resume via --skip-completed-runs
+        # keeps working) and (b) at most `window` Ks' results sit in HBM
+        pending: list[tuple[int, list, object]] = []
+        window = 4
+
+        def _drain(count):
+            while len(pending) > count:
+                k, iters, spectra_d = pending.pop(0)
+                spectra = np.asarray(spectra_d)
+                for r, it in enumerate(iters):
+                    df = pd.DataFrame(spectra[r],
+                                      index=np.arange(1, k + 1),
+                                      columns=norm_counts.var.index)
+                    save_df_to_npz(df,
+                                   self.paths["iter_spectra"] % (k, it))
+
         for k, tasks in sorted(by_k.items()):
             iters = [t[0] for t in tasks]
             seeds = [t[1] for t in tasks]
             print("[Worker %d]. Running %d replicates for k=%d as one "
                   "batched program." % (worker_i, len(tasks), k))
-            spectra, _usages, _errs = replicate_sweep(
+            spectra_d, _, _errs = replicate_sweep(
                 X, seeds, k,
                 beta_loss=_nmf_kwargs["beta_loss"],
                 init=_nmf_kwargs["init"],
@@ -389,12 +421,11 @@ class cNMF:
                 l1_ratio_W=_nmf_kwargs.get("l1_ratio_W", 0.0),
                 alpha_H=_nmf_kwargs.get("alpha_H", 0.0),
                 l1_ratio_H=_nmf_kwargs.get("l1_ratio_H", 0.0),
-                mesh=mesh, replicates_per_batch=replicates_per_batch)
-            for r, it in enumerate(iters):
-                df = pd.DataFrame(spectra[r],
-                                  index=np.arange(1, k + 1),
-                                  columns=norm_counts.var.index)
-                save_df_to_npz(df, self.paths["iter_spectra"] % (k, it))
+                mesh=mesh, replicates_per_batch=replicates_per_batch,
+                fetch=False)
+            pending.append((k, iters, spectra_d))
+            _drain(window - 1)
+        _drain(0)
 
     def _factorize_rowsharded(self, jobs, run_params, norm_counts,
                               nmf_kwargs, mesh, worker_i):
@@ -423,6 +454,7 @@ class cNMF:
             _H, spectra, _err = nmf_fit_rowsharded(
                 Xd, k, mesh,
                 beta_loss=nmf_kwargs["beta_loss"],
+                init=nmf_kwargs.get("init", "random"),
                 seed=int(p["nmf_seed"]),
                 tol=nmf_kwargs.get("tol", 1e-4),
                 n_passes=nmf_kwargs.get("n_passes", 20),

@@ -497,12 +497,34 @@ def nndsvd_init(X, k: int, variant: str = "nndsvd", key=None):
     ``init='nndsvd'`` option of the reference CLI (cnmf.py:1427).
 
     variant: 'nndsvd' (exact zeros), 'nndsvda' (zeros -> mean(X)),
-    'nndsvdar' (zeros -> small random).  For MU solvers exact zeros are
-    absorbing, so the pipeline uses 'nndsvda' filling when MU is selected.
+    'nndsvdar' (zeros -> small seeded random).  For MU solvers exact zeros
+    are absorbing, so the pipeline maps init='nndsvd' to seeded 'nndsvdar'
+    filling (init_factors) — deterministic fills would also make every
+    consensus replicate identical.
     """
     U, S, Vt = jnp.linalg.svd(X, full_matrices=False)
     U, S, Vt = U[:, :k], S[:k], Vt[:k, :]
+    return _nndsvd_from_svd(U, S, Vt, k, variant, key, jnp.mean(X))
 
+
+def nndsvd_init_gram(X, k: int, variant: str = "nndsvdar", key=None):
+    """nndsvd init computed from the gram matrix — the sharding-friendly
+    form for row-sharded X: the only all-to-all object is the g x g gram
+    (one psum'd matmul), eigendecomposed replicated; U comes back as a
+    row-sharded matmul. ``jnp.linalg.svd`` of a sharded X would gather the
+    full matrix to one device, which is exactly what the atlas path exists
+    to avoid. Sign ambiguity of eigenvectors is harmless: nndsvd's
+    positive/negative splitting is invariant to a joint (u, v) sign flip.
+    """
+    G = jnp.matmul(X.T, X, precision=_HI)
+    evals, evecs = jnp.linalg.eigh(G)           # ascending
+    S = jnp.sqrt(jnp.clip(evals[::-1][:k], 0.0))
+    V = evecs[:, ::-1][:, :k]                   # (g, k)
+    U = jnp.matmul(X, V, precision=_HI) / jnp.maximum(S, EPS)
+    return _nndsvd_from_svd(U, S, V.T, k, variant, key, jnp.mean(X))
+
+
+def _nndsvd_from_svd(U, S, Vt, k, variant, key, x_mean):
     def split_pair(j):
         u, v = U[:, j], Vt[j, :]
         up, un = jnp.maximum(u, 0.0), jnp.maximum(-u, 0.0)
@@ -529,11 +551,11 @@ def nndsvd_init(X, k: int, variant: str = "nndsvd", key=None):
     W = jnp.stack(rows, axis=0)
 
     if variant == "nndsvda":
-        avg = jnp.mean(X)
+        avg = x_mean
         H = jnp.where(H == 0.0, avg / 100.0, H)
         W = jnp.where(W == 0.0, avg / 100.0, W)
     elif variant == "nndsvdar":
-        avg = jnp.mean(X)
+        avg = x_mean
         kh, kw = jax.random.split(key if key is not None else jax.random.key(0))
         H = jnp.where(H == 0.0,
                       avg / 100.0 * jax.random.uniform(kh, H.shape), H)
@@ -544,15 +566,22 @@ def nndsvd_init(X, k: int, variant: str = "nndsvd", key=None):
 
 def init_factors(X, k: int, init: str, key, x_mean=None):
     """Dispatch on the reference's init choices {random, nndsvd}
-    (cnmf.py:1427), plus the nndsvda/nndsvdar variants nmf-torch ships."""
+    (cnmf.py:1427), plus the nndsvda/nndsvdar variants nmf-torch ships.
+
+    ``init='nndsvd'`` maps to seeded nndsvdar filling: exact zeros are
+    absorbing under MU (the factor never leaves them), and a deterministic
+    fill would make every replicate of a consensus sweep identical —
+    vacuous consensus. The seeded fill keeps replicates distinct and keeps
+    this sequential path bit-consistent with the batched sweep's per-
+    replicate inits (parallel/replicates.py:_stacked_inits) for the same
+    ledger seed."""
     n, g = X.shape
     if init == "random":
         if x_mean is None:
             x_mean = jnp.mean(X)
         return random_init(key, n, g, k, x_mean)
     if init in ("nndsvd", "nndsvda", "nndsvdar"):
-        # exact-zero nndsvd stalls MU (zeros are absorbing); use 'a' filling
-        variant = "nndsvda" if init == "nndsvd" else init
+        variant = "nndsvdar" if init == "nndsvd" else init
         return nndsvd_init(X, k, variant=variant, key=key)
     raise ValueError(f"unknown init {init!r}")
 

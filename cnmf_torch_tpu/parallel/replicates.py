@@ -24,12 +24,14 @@ import numpy as np
 import scipy.sparse as sp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+import functools
+
 from ..ops.nmf import (
     _chunk_rows,
     beta_loss_to_float,
-    init_factors,
     nmf_fit_batch,
     nmf_fit_online,
+    nndsvd_init,
     random_init,
     split_regularization,
 )
@@ -55,22 +57,88 @@ def default_mesh(axis_name: str = "replicates") -> Mesh | None:
 
 
 def _stacked_inits(X, k: int, seeds, init: str):
-    """Per-replicate (H0, W0) stacks from the ledger's seed list.
+    """Per-replicate (H0, W0) init stacks — traced inside the sweep program.
 
-    ``init='random'`` vmaps the seeded init over replicate keys. The nndsvd
-    family is deterministic given X (as in the reference's solver, where
-    ``random_state`` does not perturb nndsvd), so it is computed once and
-    broadcast — replicate diversity then comes only from MU tie-breaking,
-    mirroring the reference's behavior for that init.
+    ``init='random'`` vmaps the seeded init over replicate keys. For the
+    nndsvd family the SVD base is computed once (it is deterministic given
+    X), then each replicate fills the base's exact zeros with its own
+    seeded small values (nndsvdar semantics, Boutsidis & Gallopoulos 2008):
+    exact zeros are absorbing under MU, so without per-replicate filling
+    every replicate would follow the identical deterministic trajectory and
+    consensus over replicates would be vacuous. (``init='nndsvda'`` keeps
+    its defining deterministic mean-fill and therefore *is* degenerate
+    across replicates — use 'nndsvd'/'nndsvdar' for consensus sweeps.)
     """
     n, g = X.shape
+    R = len(seeds)
+    seeds = jnp.asarray(seeds, dtype=jnp.uint32)
     if init == "random":
         x_mean = jnp.mean(X)
-        keys = jnp.stack([jax.random.key(int(s) & 0x7FFFFFFF) for s in seeds])
-        return jax.vmap(lambda key: random_init(key, n, g, k, x_mean))(keys)
-    H0, W0 = init_factors(X, k, init, jax.random.key(int(seeds[0]) & 0x7FFFFFFF))
-    R = len(seeds)
-    return (jnp.broadcast_to(H0, (R, n, k)), jnp.broadcast_to(W0, (R, k, g)))
+        return jax.vmap(
+            lambda s: random_init(jax.random.key(s), n, g, k, x_mean))(seeds)
+    if init not in ("nndsvd", "nndsvda", "nndsvdar"):
+        raise ValueError(f"unknown init {init!r}")
+    Hb, Wb = nndsvd_init(X, k, variant="nndsvd")
+    fill = jnp.mean(X) / 100.0
+    if init == "nndsvda":
+        Hb = jnp.where(Hb == 0.0, fill, Hb)
+        Wb = jnp.where(Wb == 0.0, fill, Wb)
+        return (jnp.broadcast_to(Hb, (R, n, k)),
+                jnp.broadcast_to(Wb, (R, k, g)))
+
+    def perturb(s):
+        kh, kw = jax.random.split(jax.random.key(s))
+        H = jnp.where(Hb == 0.0, fill * jax.random.uniform(kh, Hb.shape), Hb)
+        W = jnp.where(Wb == 0.0, fill * jax.random.uniform(kw, Wb.shape), Wb)
+        return H, W
+
+    return jax.vmap(perturb)(seeds)
+
+
+@functools.lru_cache(maxsize=128)
+def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
+                   beta: float, tol: float, h_tol: float, chunk: int,
+                   chunk_max_iter: int, n_passes: int, batch_max_iter: int,
+                   l1_H: float, l2_H: float, l1_W: float, l2_W: float,
+                   mesh: Mesh | None, return_usages: bool):
+    """Build (once per static configuration) the jitted sweep executable
+    ``(X (n,g), seeds (R,)) -> (usages | (0,), spectra (R,k,g), errs (R,))``.
+
+    Everything — seeded inits, row chunking, the vmapped solver — lives
+    inside ONE jit so a steady-state sweep call is a single cached XLA
+    dispatch. (Building the vmap wrapper per call re-traced the whole solver
+    through Python each time, which cost ~3x the actual device time.)
+    """
+    spec = (None if mesh is None
+            else NamedSharding(mesh, P(mesh.axis_names[0], None, None)))
+
+    if mode == "batch":
+        def solve(X, h0, w0):
+            return nmf_fit_batch(
+                X, h0, w0, beta=beta, tol=tol, max_iter=batch_max_iter,
+                l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W)
+    elif mode == "online":
+        def solve(X, h0, w0):
+            Xc, Hc, _ = _chunk_rows(X, h0, chunk)
+            Hc, W, err = nmf_fit_online(
+                Xc, Hc, w0, beta=beta, tol=tol, h_tol=h_tol,
+                chunk_max_iter=chunk_max_iter, n_passes=n_passes,
+                l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W)
+            return Hc.reshape(-1, k)[:n], W, err
+    else:
+        raise ValueError(f"unknown mode {mode!r}")
+
+    def sweep(X, seeds):
+        H0, W0 = _stacked_inits(X, k, seeds, init)
+        if spec is not None:
+            H0 = jax.lax.with_sharding_constraint(H0, spec)
+            W0 = jax.lax.with_sharding_constraint(W0, spec)
+        H, W, err = jax.vmap(solve, in_axes=(None, 0, 0))(X, H0, W0)
+        # drop the usage stack inside the program when the caller doesn't
+        # want it — saves the (R, n, k) device->host transfer
+        return (H if return_usages else jnp.zeros((0,), X.dtype)), W, err
+
+    return jax.jit(sweep)
 
 
 def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random",
@@ -81,25 +149,35 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
                     alpha_W: float = 0.0, l1_ratio_W: float = 0.0,
                     alpha_H: float = 0.0, l1_ratio_H: float = 0.0,
                     mesh: Mesh | None = None, return_usages: bool = False,
-                    replicates_per_batch: int | None = None):
+                    replicates_per_batch: int | None = None,
+                    online_h_tol: float = 1e-3, fetch: bool = True):
     """Run ``len(seeds)`` NMF replicates at one K as a batched XLA program.
 
-    Returns ``(spectra (R, k, g), usages (R, n, k) | None, errs (R,))`` as
-    numpy arrays, in ledger seed order — the in-memory equivalent of the
-    reference's per-(k, iter) spectra files (``cnmf.py:888-892``).
+    Returns ``(spectra (R, k, g), usages (R, n, k) | None, errs (R,))`` in
+    ledger seed order — the in-memory equivalent of the reference's
+    per-(k, iter) spectra files (``cnmf.py:888-892``). With ``fetch=True``
+    (default) the results are numpy; with ``fetch=False`` they stay device
+    arrays and the call returns as soon as the work is *dispatched*, so a
+    caller sweeping several Ks can enqueue every program and overlap all
+    device->host copies with compute (one round trip per sweep otherwise —
+    on high-latency links the copies dominate the whole sweep).
 
     ``mesh``: optional 1-D device mesh; the replicate axis is sharded across
     it (R is padded to a mesh multiple; pad replicates are computed and
     dropped). ``replicates_per_batch`` bounds device memory by running the
     sweep in host-level slices (each slice is still one XLA call).
     """
-    if sp.issparse(X):
-        X = X.toarray()
-    X = jnp.asarray(np.asarray(X), dtype=jnp.float32)
+    if not isinstance(X, jax.Array):
+        # transfer once here; callers sweeping several Ks should device_put
+        # X themselves and pass the jax.Array so the transfer amortizes
+        # across calls (X rides as a jit *argument*, not a baked constant)
+        if sp.issparse(X):
+            X = X.toarray()
+        X = jnp.asarray(np.asarray(X), dtype=jnp.float32)
     n, g = X.shape
     k = int(k)
     beta = beta_loss_to_float(beta_loss)
-    seeds = list(seeds)
+    seeds = [int(s) & 0x7FFFFFFF for s in seeds]
     R = len(seeds)
     if R == 0:
         return (np.zeros((0, k, g), np.float32),
@@ -108,28 +186,6 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
 
     l1_W, l2_W = split_regularization(alpha_W, l1_ratio_W)
     l1_H, l2_H = split_regularization(alpha_H, l1_ratio_H)
-
-    if mode == "batch":
-        def solve(H0, W0):
-            return nmf_fit_batch(
-                X, H0, W0, beta=beta, tol=float(tol),
-                max_iter=int(batch_max_iter),
-                l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W)
-    elif mode == "online":
-        chunk = int(min(online_chunk_size, n))
-
-        def solve(H0, W0):
-            Xc, Hc, _ = _chunk_rows(X, H0, chunk)
-            Hc, W, err = nmf_fit_online(
-                Xc, Hc, W0, beta=beta, tol=float(tol),
-                chunk_max_iter=int(online_chunk_max_iter),
-                n_passes=int(n_passes),
-                l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W)
-            return Hc.reshape(-1, k)[:n], W, err
-    else:
-        raise ValueError(f"unknown mode {mode!r}")
-
-    sweep = jax.vmap(solve)
 
     n_dev = 1 if mesh is None else math.prod(mesh.devices.shape)
     if replicates_per_batch is None:
@@ -142,29 +198,41 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
     # slices must stay mesh-multiples so every shard stays busy
     replicates_per_batch = max(n_dev, (replicates_per_batch // n_dev) * n_dev)
 
-    spectra_out = np.empty((R, k, g), dtype=np.float32)
-    usages_out = np.empty((R, n, k), dtype=np.float32) if return_usages else None
-    errs_out = np.empty((R,), dtype=np.float32)
+    if mesh is not None:
+        target = NamedSharding(mesh, P())
+        if X.sharding != target:
+            # callers sweeping several Ks should replicate X onto the mesh
+            # themselves so this broadcast doesn't repeat per call
+            X = jax.device_put(X, target)
 
+    parts = []
     for start in range(0, R, replicates_per_batch):
         sl = seeds[start:start + replicates_per_batch]
-        H0, W0 = _stacked_inits(X, k, sl, init)
         r = len(sl)
         pad = (-r) % n_dev
         if pad:
             # tile modulo r: works even when the slice is smaller than the
             # mesh (pad replicates recompute existing seeds and are dropped)
-            idx = jnp.arange(r + pad) % r
-            H0 = H0[idx]
-            W0 = W0[idx]
-        if mesh is not None:
-            ax = mesh.axis_names[0]
-            H0 = jax.device_put(H0, NamedSharding(mesh, P(ax, None, None)))
-            W0 = jax.device_put(W0, NamedSharding(mesh, P(ax, None, None)))
-        H, W, err = sweep(H0, W0)
-        spectra_out[start:start + r] = np.asarray(W)[:r]
-        if return_usages:
-            usages_out[start:start + r] = np.asarray(H)[:r]
-        errs_out[start:start + r] = np.asarray(err)[:r]
+            sl = sl + [sl[i % r] for i in range(pad)]
+        prog = _sweep_program(
+            n, g, k, len(sl), init, mode, beta, float(tol),
+            float(online_h_tol), int(min(online_chunk_size, n)),
+            int(online_chunk_max_iter), int(n_passes), int(batch_max_iter),
+            l1_H, l2_H, l1_W, l2_W, mesh, bool(return_usages))
+        # async dispatch: every slice is enqueued before any result is read
+        H, W, err = prog(X, np.asarray(sl, dtype=np.uint32))
+        parts.append((H[:r] if return_usages else None, W[:r], err[:r]))
 
-    return spectra_out, usages_out, errs_out
+    if len(parts) == 1:
+        usages_d, spectra_d, errs_d = parts[0]
+    else:
+        usages_d = (jnp.concatenate([p[0] for p in parts])
+                    if return_usages else None)
+        spectra_d = jnp.concatenate([p[1] for p in parts])
+        errs_d = jnp.concatenate([p[2] for p in parts])
+
+    if not fetch:
+        return spectra_d, usages_d, errs_d
+    return (np.asarray(spectra_d),
+            np.asarray(usages_d) if return_usages else None,
+            np.asarray(errs_d))

@@ -34,6 +34,7 @@ from ..ops.nmf import (
     _chunk_h_solve,
     _solve_w_from_stats,
     beta_loss_to_float,
+    nndsvd_init_gram,
     random_init,
     split_regularization,
 )
@@ -164,7 +165,7 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
                        n_passes: int = 20, chunk_max_iter: int = 200,
                        alpha_W: float = 0.0, l1_ratio_W: float = 0.0,
                        alpha_H: float = 0.0, l1_ratio_H: float = 0.0,
-                       n_orig: int | None = None):
+                       n_orig: int | None = None, init: str = "random"):
     """Factorize a cells-sharded X over ``mesh`` (1-D). Returns
     ``(H (n,k), W (k,g), err)`` as numpy arrays.
 
@@ -197,8 +198,17 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
     n, g = Xd.shape
 
     key = jax.random.key(int(seed) & 0x7FFFFFFF)
-    x_mean = jnp.mean(Xd)  # computed on-device; psum-free (jit reduction)
-    H0, W0 = random_init(key, n, g, int(k), x_mean)
+    if init == "random":
+        x_mean = jnp.mean(Xd)  # on-device reduction over the sharded array
+        H0, W0 = random_init(key, n, g, int(k), x_mean)
+    elif init in ("nndsvd", "nndsvda", "nndsvdar"):
+        # gram-based nndsvd: the only replicated object is the g x g gram;
+        # per-replicate seeded zero-fill keeps consensus sweeps non-vacuous
+        # (same mapping as the single-chip path, ops/nmf.py:init_factors)
+        variant = "nndsvdar" if init == "nndsvd" else init
+        H0, W0 = nndsvd_init_gram(Xd, int(k), variant=variant, key=key)
+    else:
+        raise ValueError(f"unknown init {init!r}")
 
     row_sh = NamedSharding(mesh, P(axis, None))
     rep_sh = NamedSharding(mesh, P())
