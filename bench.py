@@ -1,87 +1,197 @@
-"""Headline benchmark: the reference's PBMC3k factorize workload.
+"""Headline benchmarks against BASELINE.md.
 
-The only wall-clock number the reference publishes is "~4 minutes" for the
-PBMC3k tutorial factorize sweep — 2,700 cells x 2,000 HVGs, K=5..10 x
-n_iter=20 = 120 online-MU NMF runs on 4 CPU workers via GNU parallel
-(/root/reference/Tutorials/analyze_pbmc_example_data.ipynb, "Using GNU
-parallel" cell; BASELINE.md). This benchmark runs the same-shaped sweep as
-batched XLA programs (one vmapped call per K) on the local device(s) and
-reports wall-clock vs that 240 s anchor.
+Three tiers, one JSON line (the driver's contract):
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+1. **North star** (BASELINE.json config 2): PBMC-10k-shaped
+   factorize+combine+consensus, K=5..13 x n_iter=100, batch_size=5000 —
+   the reference's primary metric ("PBMC-10k factorize+consensus
+   wall-clock"). The reference publishes no number for it; `vs_baseline`
+   extrapolates its only anchor (PBMC3k: 120 online-MU runs of 2,700x2,000
+   in ~240 s on 4 CPU workers => 2.0 s/run) to this workload's 900 runs of
+   10,000x2,000 (rows scale the online solver linearly: 2.0 x 10000/2700
+   x 900 = 6,667 s), consensus excluded (conservative). Per-stage seconds
+   come from the pipeline's own StageTimer ledger; compile overhead is
+   reported separately from the warm factorize rate.
+2. **PBMC3k anchor** (config 1 shape): the directly comparable 120-run
+   sweep vs the published ~240 s.
+3. **KL beta-loss** (config 3): the beta=1 kernel at K=9 x 100 replicates
+   on the same matrix.
+
+CAVEAT (stated in the output): counts are synthetic Poisson draws from a
+low-rank GEP model with the PBMC shapes — the reference datasets are not
+redistributable in this environment — and the reference comparator for the
+north star is an extrapolation, not a measurement.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import shutil
+import tempfile
 import time
 
 import numpy as np
 
-BASELINE_SECONDS = 240.0  # reference: 4 min, 4 CPU workers, same workload
-N_CELLS, N_GENES = 2700, 2000
-KS = [5, 6, 7, 8, 9, 10]
-N_ITER = 20
+PBMC3K_BASELINE_SECONDS = 240.0   # 4 min, 4 CPU workers, 120 runs
+NORTH_STAR_BASELINE_SECONDS = PBMC3K_BASELINE_SECONDS / 120 * (10000 / 2700) * 900
 
 
-def synthetic_pbmc_like(n=N_CELLS, g=N_GENES, k_true=12, seed=0):
-    """Structured counts with PBMC3k's shape: sparse-ish Poisson draws from
+def synthetic_pbmc_like(n=2700, g=2000, k_true=12, seed=0, scale=400.0):
+    """Structured counts with PBMC-like shape: sparse-ish Poisson draws from
     a low-rank GEP model, variance-scaled the way prepare() feeds the
     solver (unit-variance genes, no centering)."""
     rng = np.random.default_rng(seed)
     usage = rng.dirichlet(np.ones(k_true) * 0.2, size=n)
     spectra = rng.gamma(0.25, 1.0, size=(k_true, g)) * 40.0 / g
-    X = rng.poisson(usage @ spectra * 400.0).astype(np.float32)
+    X = rng.poisson(usage @ spectra * scale).astype(np.float32)
     X[X.sum(axis=1) == 0, 0] = 1.0
     std = X.std(axis=0, ddof=1)
     std[std == 0] = 1.0
     return X / std
 
 
-def main():
+def synthetic_counts_df(n, g, k_true=14, seed=3):
+    import pandas as pd
+
+    rng = np.random.default_rng(seed)
+    usage = rng.dirichlet(np.ones(k_true) * 0.2, size=n)
+    spectra = rng.gamma(0.25, 1.0, size=(k_true, g)) * 40.0 / g
+    counts = rng.poisson(usage @ spectra * 400.0).astype(np.float64)
+    counts[counts.sum(axis=1) == 0, 0] = 1.0
+    return pd.DataFrame(counts, index=[f"c{i}" for i in range(n)],
+                        columns=[f"g{j}" for j in range(g)])
+
+
+def read_stage_seconds(timings_tsv):
+    stages = {}
+    with open(timings_tsv) as f:
+        next(f)
+        for line in f:
+            name, secs = line.split("\t")[:2]
+            stages[name] = stages.get(name, 0.0) + float(secs)
+    return stages
+
+
+def bench_north_star():
+    """PBMC-10k-shaped e2e: prepare -> factorize(K=5..13 x 100) -> combine
+    -> consensus(k=9). Returns the headline seconds + stage breakdown."""
+    from cnmf_torch_tpu import cNMF
+    from cnmf_torch_tpu.utils import save_df_to_npz
+
+    workdir = tempfile.mkdtemp(prefix="bench_ns_")
+    counts_fn = os.path.join(workdir, "counts.df.npz")
+    save_df_to_npz(synthetic_counts_df(10000, 5000), counts_fn)
+
+    obj = cNMF(output_dir=workdir, name="ns")
+    obj.prepare(counts_fn, components=list(range(5, 14)), n_iter=100,
+                seed=14, num_highvar_genes=2000, batch_size=5000)
+
+    t0 = time.perf_counter()
+    obj.factorize()
+    factorize_cold = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    obj.combine()
+    combine_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    try:
+        obj.consensus(k=9, density_threshold=0.5, show_clustering=False)
+    except RuntimeError:
+        # synthetic replicate spectra can be more dispersed than real PBMC
+        # ones; keep the full consensus pipeline in the measurement
+        obj.consensus(k=9, density_threshold=2.0, show_clustering=False)
+    consensus_s = time.perf_counter() - t0
+
+    # warm factorize: every (shape, config) program is now compiled, so this
+    # is the steady-state solver rate; cold - warm ~= XLA compile overhead
+    t0 = time.perf_counter()
+    obj.factorize()
+    factorize_warm = time.perf_counter() - t0
+
+    stages = read_stage_seconds(
+        os.path.join(workdir, "ns", "cnmf_tmp", "ns.timings.tsv"))
+    shutil.rmtree(workdir)
+    e2e = factorize_cold + combine_s + consensus_s
+    return {
+        "e2e_seconds": round(e2e, 3),
+        "factorize_cold_seconds": round(factorize_cold, 3),
+        "factorize_warm_seconds": round(factorize_warm, 3),
+        "compile_overhead_seconds": round(factorize_cold - factorize_warm, 3),
+        "combine_seconds": round(combine_s, 3),
+        "consensus_seconds": round(consensus_s, 3),
+        "prepare_seconds": round(stages.get("prepare", 0.0), 3),
+    }
+
+
+def bench_pbmc3k_anchor():
     import jax.numpy as jnp
 
     from cnmf_torch_tpu.parallel import default_mesh, replicate_sweep
 
-    # one host->HBM transfer, shared by every per-K sweep program
     X = jnp.asarray(synthetic_pbmc_like())
     mesh = default_mesh()
     master = np.random.RandomState(14)
-    seeds_per_k = {
-        k: master.randint(1, 2 ** 31 - 1, size=N_ITER).tolist() for k in KS
-    }
-
-    # warmup: compile every measured (R, k) shape (vmap batch size is part
-    # of the compiled shape) so the sweep measures steady-state solver cost
-    # — the reference's 4-minute figure likewise excludes env startup
-    for k in KS:
-        replicate_sweep(X, [1] * N_ITER, k, mode="online",
-                        online_chunk_size=5000, online_chunk_max_iter=1000,
-                        mesh=mesh)
-
+    ks = [5, 6, 7, 8, 9, 10]
+    seeds_per_k = {k: master.randint(1, 2 ** 31 - 1, size=20).tolist()
+                   for k in ks}
+    for k in ks:  # compile
+        replicate_sweep(X, [1] * 20, k, mode="online", online_chunk_size=5000,
+                        online_chunk_max_iter=1000, mesh=mesh)
     t0 = time.perf_counter()
+    pending = [(k,) + replicate_sweep(
+        X, seeds_per_k[k], k, mode="online", online_chunk_size=5000,
+        online_chunk_max_iter=1000, mesh=mesh, fetch=False)[::2]
+        for k in ks]
     total_err = 0.0
-    # dispatch every K's program before fetching any result: device->host
-    # copies of early Ks overlap later Ks' compute (factorize() pipelines
-    # its sweep the same way)
-    pending = []
-    for k in KS:
-        spectra_d, _, errs_d = replicate_sweep(
-            X, seeds_per_k[k], k, mode="online", online_chunk_size=5000,
-            online_chunk_max_iter=1000, mesh=mesh, fetch=False)
-        pending.append((k, spectra_d, errs_d))
     for k, spectra_d, errs_d in pending:
-        spectra = np.asarray(spectra_d)
-        assert spectra.shape == (N_ITER, k, N_GENES)
+        assert np.asarray(spectra_d).shape == (20, k, 2000)
         total_err += float(np.sum(np.asarray(errs_d)))
     elapsed = time.perf_counter() - t0
     assert np.isfinite(total_err)
+    return round(elapsed, 3)
+
+
+def bench_kl(X_dev):
+    from cnmf_torch_tpu.parallel import replicate_sweep
+
+    seeds = np.random.RandomState(7).randint(1, 2 ** 31 - 1, size=100).tolist()
+    replicate_sweep(X_dev, seeds[:4], 9, beta_loss="kullback-leibler",
+                    mode="online", online_chunk_size=5000)  # compile
+    t0 = time.perf_counter()
+    _, _, errs = replicate_sweep(X_dev, seeds, 9,
+                                 beta_loss="kullback-leibler", mode="online",
+                                 online_chunk_size=5000)
+    elapsed = time.perf_counter() - t0
+    assert np.isfinite(errs).all()
+    return round(elapsed, 3)
+
+
+def main():
+    import jax.numpy as jnp
+
+    ns = bench_north_star()
+    anchor_s = bench_pbmc3k_anchor()
+    kl_s = bench_kl(jnp.asarray(synthetic_pbmc_like(n=10000, seed=5)))
 
     print(json.dumps({
-        "metric": "pbmc3k_factorize_sweep_wallclock",
-        "value": round(elapsed, 3),
-        "unit": "seconds (120 online-MU NMF runs, 2700x2000, K=5..10 x 20)",
-        "vs_baseline": round(BASELINE_SECONDS / elapsed, 2),
+        "metric": "pbmc10k_factorize_consensus_e2e",
+        "value": ns["e2e_seconds"],
+        "unit": ("seconds (factorize K=5..13 x 100 online-MU runs of "
+                 "10000x2000 incl. compiles, + combine + consensus k=9)"),
+        "vs_baseline": round(NORTH_STAR_BASELINE_SECONDS / ns["e2e_seconds"], 2),
+        "stages": ns,
+        "pbmc3k_anchor": {
+            "seconds": anchor_s,
+            "vs_baseline": round(PBMC3K_BASELINE_SECONDS / anchor_s, 2),
+            "baseline": "ref tutorial: ~240 s, 120 runs, 4 CPU workers",
+        },
+        "kl_factorize_k9_x100_seconds": kl_s,
+        "caveats": ("synthetic PBMC-shaped counts (real datasets not "
+                    "redistributable here); north-star baseline is the "
+                    "reference's PBMC3k 2.0 s/run anchor extrapolated "
+                    "linearly in rows and runs (6667 s), consensus excluded"),
     }))
 
 
