@@ -1,33 +1,53 @@
-"""Headline benchmarks against BASELINE.md.
+"""Headline benchmarks against BASELINE.md — fault-isolated tiers.
 
-Three tiers, one JSON line (the driver's contract):
+Driver contract: running ``python bench.py`` prints ONE JSON line
+``{"metric", "value", "unit", "vs_baseline", ...}``.
 
-1. **North star** (BASELINE.json config 2): PBMC-10k-shaped
-   factorize+combine+consensus, K=5..13 x n_iter=100, batch_size=5000 —
-   the reference's primary metric ("PBMC-10k factorize+consensus
-   wall-clock"). The reference publishes no number for it; `vs_baseline`
-   extrapolates its only anchor (PBMC3k: 120 online-MU runs of 2,700x2,000
-   in ~240 s on 4 CPU workers => 2.0 s/run) to this workload's 900 runs of
-   10,000x2,000 (rows scale the online solver linearly: 2.0 x 10000/2700
-   x 900 = 6,667 s), consensus excluded (conservative). Per-stage seconds
-   come from the pipeline's own StageTimer ledger; compile overhead is
-   reported separately from the warm factorize rate.
-2. **PBMC3k anchor** (config 1 shape): the directly comparable 120-run
-   sweep vs the published ~240 s.
-3. **KL beta-loss** (config 3): the beta=1 kernel at K=9 x 100 replicates
-   on the same matrix.
+Round-2 lesson (VERDICT.md): a single in-process bench lost every tier's
+numbers when one tier crashed the TPU worker. Each tier therefore now runs
+in its OWN subprocess (``python bench.py --tier NAME --out FILE``) with a
+timeout, and the orchestrator appends each tier's result to
+``bench_partial.json`` as it lands — a crash in one tier costs exactly that
+tier.
+
+Tiers (BASELINE.md configs):
+
+1. ``north_star`` (config 2): PBMC-10k-shaped factorize+combine+consensus,
+   K=5..13 x n_iter=100, batch_size=5000. The reference publishes no number;
+   ``vs_baseline`` extrapolates its only anchor (PBMC3k: 120 online-MU runs
+   of 2,700x2,000 in ~240 s on 4 CPU workers => 2.0 s/run) linearly in rows
+   and runs (2.0 x 10000/2700 x 900 = 6,667 s), consensus excluded
+   (conservative).
+2. ``anchor`` (config 1 shape): the directly comparable 120-run PBMC3k sweep
+   vs the published ~240 s.
+3. ``kl`` (config 3): the beta=1 kernel, K=9 x 100 replicates — the tier
+   whose HBM blowup crashed round 2, now sliced by the beta-aware budget
+   (parallel/replicates.py: auto_replicates_per_batch).
+4. ``mfu``: fixed-iteration MU probes at the workload shapes; reports
+   achieved TFLOP/s, MFU vs chip peak, and effective HBM bandwidth (the MU
+   kernel at k=9 is bandwidth-bound: arithmetic intensity ~2k FLOP per
+   fp32 element of X).
+5. ``rowshard`` (config 5 scaled to one chip): 1M-cell x 2,000-gene CSR
+   streamed host->HBM shard-wise (never a host dense copy), then
+   row-sharded KL/Frobenius passes — reports streaming GB/s and cells/s.
+6. ``harmony`` (config 4 shape): Preprocess (seurat_v3 HVG -> PCA ->
+   Harmony -> gene-space MOE ridge) -> cNMF prepare -> factorize ->
+   consensus end-to-end.
 
 CAVEAT (stated in the output): counts are synthetic Poisson draws from a
-low-rank GEP model with the PBMC shapes — the reference datasets are not
-redistributable in this environment — and the reference comparator for the
-north star is an extrapolation, not a measurement.
+low-rank GEP model with the reference datasets' shapes — the datasets
+themselves are not redistributable in this environment — and the north-star
+comparator is an extrapolation, not a measurement.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import shutil
+import subprocess
+import sys
 import tempfile
 import time
 
@@ -35,6 +55,21 @@ import numpy as np
 
 PBMC3K_BASELINE_SECONDS = 240.0   # 4 min, 4 CPU workers, 120 runs
 NORTH_STAR_BASELINE_SECONDS = PBMC3K_BASELINE_SECONDS / 120 * (10000 / 2700) * 900
+
+# bf16-multiplicand matmul peak by device kind (TPU default precision for
+# fp32 operands is one bf16 pass, so this is the honest denominator);
+# (peak_flops_per_s, hbm_bytes_per_s)
+_CHIP_PEAKS = {
+    "TPU v4": (275e12, 1.2e12),
+    "TPU v5 lite": (394e12, 0.819e12),
+    "TPU v5": (459e12, 2.765e12),
+    "TPU v5p": (459e12, 2.765e12),
+    "TPU v6 lite": (918e12, 1.64e12),
+}
+
+TIERS = ["north_star", "anchor", "kl", "mfu", "rowshard", "harmony"]
+TIER_TIMEOUT_S = {"north_star": 2400, "anchor": 1200, "kl": 1800,
+                  "mfu": 900, "rowshard": 1500, "harmony": 1500}
 
 
 def synthetic_pbmc_like(n=2700, g=2000, k_true=12, seed=0, scale=400.0):
@@ -72,6 +107,10 @@ def read_stage_seconds(timings_tsv):
             stages[name] = stages.get(name, 0.0) + float(secs)
     return stages
 
+
+# ---------------------------------------------------------------------------
+# tiers (each runs in its own subprocess)
+# ---------------------------------------------------------------------------
 
 def bench_north_star():
     """PBMC-10k-shaped e2e: prepare -> factorize(K=5..13 x 100) -> combine
@@ -122,10 +161,11 @@ def bench_north_star():
         "combine_seconds": round(combine_s, 3),
         "consensus_seconds": round(consensus_s, 3),
         "prepare_seconds": round(stages.get("prepare", 0.0), 3),
+        "vs_baseline": round(NORTH_STAR_BASELINE_SECONDS / e2e, 2),
     }
 
 
-def bench_pbmc3k_anchor():
+def bench_anchor():
     import jax.numpy as jnp
 
     from cnmf_torch_tpu.parallel import default_mesh, replicate_sweep
@@ -150,48 +190,329 @@ def bench_pbmc3k_anchor():
         total_err += float(np.sum(np.asarray(errs_d)))
     elapsed = time.perf_counter() - t0
     assert np.isfinite(total_err)
-    return round(elapsed, 3)
+    return {
+        "seconds": round(elapsed, 3),
+        "vs_baseline": round(PBMC3K_BASELINE_SECONDS / elapsed, 2),
+        "baseline": "ref tutorial: ~240 s, 120 runs, 4 CPU workers",
+    }
 
 
-def bench_kl(X_dev):
-    from cnmf_torch_tpu.parallel import replicate_sweep
+def bench_kl():
+    import jax.numpy as jnp
 
+    from cnmf_torch_tpu.parallel import (auto_replicates_per_batch,
+                                         replicate_sweep)
+
+    X = jnp.asarray(synthetic_pbmc_like(n=10000, seed=5))
     seeds = np.random.RandomState(7).randint(1, 2 ** 31 - 1, size=100).tolist()
-    replicate_sweep(X_dev, seeds[:4], 9, beta_loss="kullback-leibler",
+    slice_size = auto_replicates_per_batch(10000, 2000, 9, beta=1.0,
+                                           chunk=5000)
+    replicate_sweep(X, seeds[:4], 9, beta_loss="kullback-leibler",
                     mode="online", online_chunk_size=5000)  # compile
     t0 = time.perf_counter()
-    _, _, errs = replicate_sweep(X_dev, seeds, 9,
+    _, _, errs = replicate_sweep(X, seeds, 9,
                                  beta_loss="kullback-leibler", mode="online",
                                  online_chunk_size=5000)
     elapsed = time.perf_counter() - t0
     assert np.isfinite(errs).all()
-    return round(elapsed, 3)
+    return {"seconds": round(elapsed, 3),
+            "replicates_per_device_slice": int(slice_size)}
+
+
+def _chip_peaks():
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    peak = _CHIP_PEAKS.get(kind, (None, None))
+    return kind, peak[0], peak[1]
+
+
+def _device_sync(x) -> float:
+    """True device sync: fetch a scalar reduction. (On the axon-tunneled
+    TPU, ``jax.block_until_ready`` returns before the work drains — only a
+    device->host read is a real barrier.)"""
+    import jax.numpy as jnp
+
+    return float(jnp.sum(x if not isinstance(x, tuple) else x[0]))
+
+
+def bench_mfu():
+    """Fixed-iteration MU probes with exact analytic matmul FLOP counts, at
+    the bench workload shapes. Two-point timing (N vs 3N iterations, same
+    program shape) cancels the constant dispatch + tunnel round-trip
+    overhead, so the rate is the kernel's own. MFU = achieved / chip bf16
+    peak; HBM utilization uses per-iteration X traffic (the k=9 kernel's
+    actual bound — arithmetic intensity ~2k FLOP per fp32 element)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from cnmf_torch_tpu.ops.nmf import _update_H, _update_W
+
+    kind, peak_flops, peak_bw = _chip_peaks()
+    results = {"device_kind": kind}
+
+    def probe(n, g, k, R, iters, beta):
+        @functools.partial(jax.jit, static_argnames=("iters",))
+        def batched(H, W, X, iters):
+            def solo(h, w):
+                def body(_, hw):
+                    h, w = hw
+                    h = _update_H(X, h, w, beta, 0.0, 0.0)
+                    w = _update_W(X, h, w, beta, 0.0, 0.0)
+                    return h, w
+                return jax.lax.fori_loop(0, iters, body, (h, w))
+            return jax.vmap(solo)(H, W)
+
+        rng = np.random.default_rng(0)
+        X = jnp.asarray(rng.random((n, g), np.float32) + 0.1)
+        H = jnp.asarray(rng.random((R, n, k), np.float32) + 0.1)
+        W = jnp.asarray(rng.random((R, k, g), np.float32) + 0.1)
+        _device_sync(batched(H, W, X, iters))      # compile short
+        _device_sync(batched(H, W, X, 3 * iters))  # compile long
+
+        def timed(n_it):
+            t0 = time.perf_counter()
+            _device_sync(batched(H, W, X, n_it))
+            return time.perf_counter() - t0
+
+        d_short = min(timed(iters) for _ in range(2))
+        d_long = min(timed(3 * iters) for _ in range(2))
+        dt = max(d_long - d_short, 1e-6)  # time for exactly 2*iters
+
+        if beta == 2.0:
+            # H: X@W.T + W@W.T + H@WWT ; W: H.T@X + H.T@H + HtH@W
+            flops_iter = 4 * n * g * k + 4 * n * k * k + 4 * g * k * k
+        else:
+            # H: H@W + R@W.T ; W: H@W + H.T@R (denominators are reductions)
+            flops_iter = 8 * n * g * k
+        total_flops = flops_iter * 2 * iters * R
+        achieved = total_flops / dt
+        out = {
+            "achieved_tflops": round(achieved / 1e12, 3),
+            "kernel_seconds_per_iter_per_replicate":
+                round(dt / (2 * iters * R), 6),
+            "timed_iters": 2 * iters, "replicates": R,
+        }
+        if peak_flops:
+            # the vmapped replicate batch is what makes a skinny-k MU
+            # update MXU-friendly: X reads amortize across R replicates,
+            # so effective contraction width is R*k, not k
+            out["mfu"] = round(achieved / peak_flops, 4)
+        return out
+
+    results["frobenius_k9"] = probe(10000, 2000, 9, 128, 250, 2.0)
+    results["kl_k9"] = probe(10000, 2000, 9, 16, 100, 1.0)
+    # k=64 shows the kernel's compute ceiling once the matmuls stop being
+    # bandwidth-starved (arithmetic intensity scales with k)
+    results["frobenius_k64"] = probe(10000, 2000, 64, 16, 100, 2.0)
+    return results
+
+
+def bench_rowshard():
+    """Config 5 scaled to one chip: stream a 1M x 2000 CSR host->HBM
+    (shard-wise, no host dense copy) and run row-sharded solver passes."""
+    import jax
+    import scipy.sparse as sp
+    from jax.sharding import Mesh
+
+    from cnmf_torch_tpu.parallel.rowshard import (nmf_fit_rowsharded,
+                                                  prepare_rowsharded)
+
+    n, g, density = 1_000_000, 2000, 0.05
+    rng = np.random.default_rng(11)
+    blocks = []
+    block_rows = 100_000
+    for b in range(n // block_rows):
+        m = sp.random(block_rows, g, density=density, format="csr",
+                      random_state=int(rng.integers(1 << 31)),
+                      data_rvs=lambda size: rng.gamma(2.0, 1.0, size).astype(
+                          np.float32))
+        blocks.append(m.astype(np.float32))
+    X = sp.vstack(blocks, format="csr")
+    nbytes_sparse = X.data.nbytes + X.indices.nbytes + X.indptr.nbytes
+    dense_gb = n * g * 4 / 1e9
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("cells",))
+    t0 = time.perf_counter()
+    Xd, n_orig = prepare_rowsharded(X, mesh)
+    _device_sync(Xd)
+    stream_s = time.perf_counter() - t0
+
+    # compile pass excluded from the steady-state rate
+    nmf_fit_rowsharded(Xd, 9, mesh, seed=1, n_passes=1, n_orig=n_orig)
+    n_passes = 3
+    t0 = time.perf_counter()
+    _, _, err = nmf_fit_rowsharded(Xd, 9, mesh, seed=2, n_passes=n_passes,
+                                   n_orig=n_orig)
+    solve_s = time.perf_counter() - t0
+    assert np.isfinite(err)
+    return {
+        "cells": n, "genes": g, "csr_gb": round(nbytes_sparse / 1e9, 2),
+        "stream_seconds": round(stream_s, 3),
+        "stream_dense_gb_per_s": round(dense_gb / stream_s, 2),
+        "solve_seconds_3pass_k9": round(solve_s, 3),
+        "cells_per_second": int(n * n_passes / solve_s),
+    }
+
+
+def bench_harmony():
+    """Config 4 shape (Baron islets: ~8.5k cells, 4 donors): Preprocess
+    (HVG -> PCA -> Harmony -> gene-space MOE ridge) -> cNMF e2e."""
+    import pandas as pd
+
+    from cnmf_torch_tpu import Preprocess, cNMF
+    from cnmf_torch_tpu.utils.anndata_lite import AnnDataLite
+
+    n, g, k_true, n_batches = 8500, 5000, 8, 4
+    rng = np.random.default_rng(21)
+    usage = rng.dirichlet(np.ones(k_true) * 0.3, size=n)
+    spectra = rng.gamma(0.3, 1.0, size=(k_true, g)) * 50.0 / g
+    batch = rng.integers(0, n_batches, size=n)
+    # per-batch multiplicative gene effects — what Harmony removes
+    batch_fx = rng.gamma(20.0, 0.05, size=(n_batches, g))
+    counts = rng.poisson(usage @ spectra * 300.0 * batch_fx[batch])
+    counts = counts.astype(np.float32)
+    counts[counts.sum(axis=1) == 0, 0] = 1.0
+
+    import scipy.sparse as sp
+    adata = AnnDataLite(
+        X=sp.csr_matrix(counts),
+        obs=pd.DataFrame({"batch": pd.Categorical(batch.astype(str))},
+                         index=[f"c{i}" for i in range(n)]),
+        var=pd.DataFrame(index=[f"g{j}" for j in range(g)]))
+
+    workdir = tempfile.mkdtemp(prefix="bench_harmony_")
+    base = os.path.join(workdir, "islets_pre")
+    t0 = time.perf_counter()
+    p = Preprocess(random_seed=14)
+    p.preprocess_for_cnmf(adata, harmony_vars="batch", n_top_rna_genes=2000,
+                          librarysize_targetsum=1e6, save_output_base=base)
+    preprocess_s = time.perf_counter() - t0
+    counts_fn = base + ".Corrected.HVG.Varnorm.h5ad"
+    tpm_fn = base + ".TP10K.h5ad"
+    genes_fn = base + ".Corrected.HVGs.txt"
+
+    obj = cNMF(output_dir=workdir, name="islets")
+    t0 = time.perf_counter()
+    obj.prepare(counts_fn, components=[8], n_iter=30, seed=14,
+                tpm_fn=tpm_fn, genes_file=genes_fn)
+    obj.factorize()
+    obj.combine()
+    try:
+        obj.consensus(k=8, density_threshold=0.5, show_clustering=False)
+    except RuntimeError:
+        obj.consensus(k=8, density_threshold=2.0, show_clustering=False)
+    cnmf_s = time.perf_counter() - t0
+    shutil.rmtree(workdir)
+    return {
+        "cells": n, "genes": g, "batches": n_batches,
+        "preprocess_seconds": round(preprocess_s, 3),
+        "cnmf_seconds": round(cnmf_s, 3),
+        "e2e_seconds": round(preprocess_s + cnmf_s, 3),
+    }
+
+
+# ---------------------------------------------------------------------------
+# orchestrator
+# ---------------------------------------------------------------------------
+
+def run_tier_subprocess(tier: str) -> dict:
+    out_fd, out_path = tempfile.mkstemp(suffix=".json", prefix=f"bench_{tier}_")
+    os.close(out_fd)
+    cmd = [sys.executable, os.path.abspath(__file__), "--tier", tier,
+           "--out", out_path]
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=TIER_TIMEOUT_S[tier],
+                              cwd=os.path.dirname(os.path.abspath(__file__)))
+        rc = proc.returncode
+        stderr_tail = proc.stderr[-2000:] if proc.stderr else ""
+    except subprocess.TimeoutExpired as e:
+        rc = -1
+        stderr_tail = f"TIMEOUT after {TIER_TIMEOUT_S[tier]}s: " + (
+            (e.stderr or b"")[-1500:].decode("utf-8", "replace")
+            if isinstance(e.stderr, bytes) else str(e.stderr or "")[-1500:])
+    wall = round(time.perf_counter() - t0, 1)
+    result: dict
+    if rc == 0 and os.path.exists(out_path) and os.path.getsize(out_path):
+        with open(out_path) as f:
+            result = json.load(f)
+        result["tier_wall_seconds"] = wall
+    else:
+        result = {"error": f"tier subprocess rc={rc}", "rc": rc,
+                  "tier_wall_seconds": wall, "stderr_tail": stderr_tail}
+    try:
+        os.unlink(out_path)
+    except OSError:
+        pass
+    return result
 
 
 def main():
-    import jax.numpy as jnp
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--tier", choices=TIERS)
+    parser.add_argument("--out")
+    parser.add_argument("--skip", nargs="*", default=[],
+                        help="tiers to skip (debugging)")
+    args = parser.parse_args()
 
-    ns = bench_north_star()
-    anchor_s = bench_pbmc3k_anchor()
-    kl_s = bench_kl(jnp.asarray(synthetic_pbmc_like(n=10000, seed=5)))
+    if args.tier:
+        if not args.out:
+            parser.error("--tier requires --out (checked before the tier "
+                         "runs so a multi-minute measurement is never lost)")
+        fn = {"north_star": bench_north_star, "anchor": bench_anchor,
+              "kl": bench_kl, "mfu": bench_mfu, "rowshard": bench_rowshard,
+              "harmony": bench_harmony}[args.tier]
+        result = fn()
+        with open(args.out, "w") as f:
+            json.dump(result, f)
+        return
 
+    partial_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_partial.json")
+    results: dict = {}
+    for tier in TIERS:
+        if tier in args.skip:
+            continue
+        print(f"[bench] running tier {tier} ...", file=sys.stderr, flush=True)
+        results[tier] = run_tier_subprocess(tier)
+        # land partial results after EVERY tier: a later crash (or an
+        # orchestrator kill) cannot erase what already completed
+        with open(partial_path, "w") as f:
+            json.dump(results, f, indent=1)
+        status = ("ok" if "error" not in results[tier]
+                  else results[tier]["error"])
+        print(f"[bench] tier {tier}: {status} "
+              f"({results[tier].get('tier_wall_seconds')}s)",
+              file=sys.stderr, flush=True)
+
+    ns = results.get("north_star", {})
+    if "e2e_seconds" in ns:
+        value = ns["e2e_seconds"]
+        vs = ns.get("vs_baseline")
+    else:
+        value = None
+        vs = None
+    mfu = results.get("mfu", {})
     print(json.dumps({
         "metric": "pbmc10k_factorize_consensus_e2e",
-        "value": ns["e2e_seconds"],
+        "value": value,
         "unit": ("seconds (factorize K=5..13 x 100 online-MU runs of "
                  "10000x2000 incl. compiles, + combine + consensus k=9)"),
-        "vs_baseline": round(NORTH_STAR_BASELINE_SECONDS / ns["e2e_seconds"], 2),
-        "stages": ns,
-        "pbmc3k_anchor": {
-            "seconds": anchor_s,
-            "vs_baseline": round(PBMC3K_BASELINE_SECONDS / anchor_s, 2),
-            "baseline": "ref tutorial: ~240 s, 120 runs, 4 CPU workers",
-        },
-        "kl_factorize_k9_x100_seconds": kl_s,
-        "caveats": ("synthetic PBMC-shaped counts (real datasets not "
-                    "redistributable here); north-star baseline is the "
-                    "reference's PBMC3k 2.0 s/run anchor extrapolated "
-                    "linearly in rows and runs (6667 s), consensus excluded"),
+        "vs_baseline": vs,
+        "tiers": results,
+        "mfu_frobenius_k9": mfu.get("frobenius_k9", {}).get("mfu"),
+        "achieved_tflops_frobenius_k9":
+            mfu.get("frobenius_k9", {}).get("achieved_tflops"),
+        "caveats": ("synthetic counts at the reference datasets' shapes "
+                    "(the datasets are not redistributable here); the "
+                    "north-star baseline is the reference's PBMC3k "
+                    "2.0 s/run anchor extrapolated linearly in rows and "
+                    "runs (6667 s), consensus excluded; each tier runs "
+                    "fault-isolated in its own subprocess"),
     }))
 
 

@@ -336,12 +336,21 @@ class cNMF:
             # batched=False / --sequential request keeps its solver
             rowshard = (batched
                         and norm_counts.X.shape[0] >= int(rowshard_threshold))
+            if rowshard:
+                print("factorize: %d cells >= rowshard threshold %d — "
+                      "auto-engaging the row-sharded solver (pass "
+                      "rowshard=False / --no-rowshard to keep the batched "
+                      "replicate sweep)."
+                      % (norm_counts.X.shape[0], int(rowshard_threshold)))
         if rowshard:
             self._factorize_rowsharded(jobs, run_params, norm_counts,
                                        _nmf_kwargs, mesh, worker_i)
             return
 
         if not batched:
+            self._save_factorize_provenance(
+                "sequential", worker_i,
+                {k: v for k, v in _nmf_kwargs.items() if k != "n_jobs"})
             for idx in jobs:
                 p = run_params.iloc[idx, :]
                 print("[Worker %d]. Starting task %d." % (worker_i, idx))
@@ -383,6 +392,12 @@ class cNMF:
             p = run_params.iloc[idx, :]
             by_k.setdefault(int(p["n_components"]), []).append(
                 (int(p["iter"]), int(p["nmf_seed"])))
+
+        self._save_factorize_provenance(
+            "batched", worker_i,
+            dict({k: v for k, v in _nmf_kwargs.items() if k != "n_jobs"},
+                 mesh_devices=(1 if mesh is None
+                               else int(np.prod(mesh.devices.shape)))))
 
         # pipelined sweep: dispatch runs ahead of fetch+save by a bounded
         # window, so device->host copies of earlier Ks overlap the compute
@@ -427,6 +442,20 @@ class cNMF:
             _drain(window - 1)
         _drain(0)
 
+    def _save_factorize_provenance(self, engaged_path: str, worker_i,
+                                   effective_params: dict):
+        """Record what factorize ACTUALLY ran. The prepared ledger YAML
+        describes intent; auto-rowshard can swap the solver family, so the
+        run artifacts carry the engaged path + effective parameters too."""
+        record = {"engaged_path": engaged_path,
+                  "worker_index": int(worker_i),
+                  "effective_params": effective_params}
+        path = self.paths["factorize_provenance"] % int(worker_i)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            yaml.dump(record, f)
+        os.replace(tmp, path)  # readers never see a half-written record
+
     def _factorize_rowsharded(self, jobs, run_params, norm_counts,
                               nmf_kwargs, mesh, worker_i):
         """Atlas-scale factorize: cells sharded over the mesh, replicates
@@ -448,6 +477,19 @@ class cNMF:
         print("[Worker %d]. Row-sharded factorize: %d cells over %d devices, "
               "%d tasks." % (worker_i, n_orig,
                              int(np.prod(mesh.devices.shape)), len(jobs)))
+        # the row-sharded block-coordinate solver ignores the ledger's
+        # mode/batch_max_iter/online_chunk_size; record what actually runs
+        self._save_factorize_provenance(
+            "rowshard", worker_i,
+            {"beta_loss": nmf_kwargs["beta_loss"],
+             "init": nmf_kwargs.get("init", "random"),
+             "tol": nmf_kwargs.get("tol", 1e-4),
+             "n_passes": nmf_kwargs.get("n_passes", 20),
+             "chunk_max_iter": nmf_kwargs.get("online_chunk_max_iter", 200),
+             "alpha_W": nmf_kwargs.get("alpha_W", 0.0),
+             "alpha_H": nmf_kwargs.get("alpha_H", 0.0),
+             "mesh_devices": int(np.prod(mesh.devices.shape)),
+             "ledger_keys_ignored": ["mode", "online_chunk_size"]})
         for idx in jobs:
             p = run_params.iloc[idx, :]
             k = int(p["n_components"])

@@ -43,8 +43,17 @@ def stdscale_quantile_celing(_adata, max_value=None, quantile_thresh=None):
         if sp.issparse(X):
             # quantile over the dense value distribution (incl. zeros), as
             # the reference computes it via todense (preprocess.py:25); done
-            # here without densifying: zeros shift the quantile position
+            # here without densifying: zeros shift the quantile position.
+            # The implicit-zero merge below assumes all stored values are
+            # nonnegative (true for scaled counts, which is the only path
+            # the pipeline feeds here) — negatives would sort below the
+            # zeros and the interpolation would be wrong.
             nnz_vals = np.sort(X.tocsr().data)
+            if nnz_vals.size and nnz_vals[0] < 0:
+                raise ValueError(
+                    "stdscale_quantile_celing: sparse input contains "
+                    "negative values; the sparse quantile path assumes "
+                    "nonnegative data (densify first for signed data)")
             n_total = X.shape[0] * X.shape[1]
             pos = quantile_thresh * (n_total - 1)
             n_zeros = n_total - len(nnz_vals)

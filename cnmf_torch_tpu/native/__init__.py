@@ -36,13 +36,28 @@ _build_failed = False
 
 
 def _build() -> bool:
+    # compile to a process-unique temp path, then atomically rename into
+    # place: concurrent worker processes (e.g. a GNU-parallel factorize
+    # fleet) may race this build, and a half-written .so at _LIB_PATH would
+    # poison every loser of the race. rename() on the same filesystem is
+    # atomic, so each racer installs a complete binary and the last one wins.
+    tmp_path = f"{_LIB_PATH}.build-{os.getpid()}"
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-           _SRC, "-o", _LIB_PATH]
+           _SRC, "-o", tmp_path]
     try:
         res = subprocess.run(cmd, capture_output=True, timeout=120)
-        return res.returncode == 0 and os.path.exists(_LIB_PATH)
+        if res.returncode != 0 or not os.path.exists(tmp_path):
+            return False
+        os.replace(tmp_path, _LIB_PATH)
+        return True
     except (OSError, subprocess.TimeoutExpired):
         return False
+    finally:
+        if os.path.exists(tmp_path):
+            try:
+                os.unlink(tmp_path)
+            except OSError:
+                pass
 
 
 def _load():

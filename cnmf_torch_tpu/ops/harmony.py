@@ -111,12 +111,14 @@ def _clustering_objective(Y, Z_cos, R, E, O, sigma, theta):
 
 
 @jax.jit
-def _moe_ridge_scan(Z_orig, R, Phi_moe, lamb_diag):
+def _moe_ridge_scan(Z_orig, R, Phi_moe, lamb):
     """Z_corr = Z_orig - sum_k W_k^T Phi_Rk with per-cluster ridge experts
     W_k = (Phi_Rk Phi_moe^T + lamb)^{-1} Phi_Rk Z_orig^T, intercept row
     zeroed (the correction never removes the global mean) — the
-    ``moe_correct_ridge`` contract (preprocess.py:9-18)."""
-    lamb = jnp.diag(lamb_diag)
+    ``moe_correct_ridge`` contract (preprocess.py:9-18).
+
+    ``lamb``: full (B+1) x (B+1) ridge matrix (harmonypy carries a matrix;
+    callers with a diagonal pass ``jnp.diag`` of it)."""
 
     def body(Z_corr, Rk):
         Phi_Rk = Phi_moe * Rk[None, :]
@@ -131,14 +133,19 @@ def _moe_ridge_scan(Z_orig, R, Phi_moe, lamb_diag):
     return Z_corr
 
 
-def moe_correct_ridge(Z_orig, R, Phi_moe, lamb_diag) -> np.ndarray:
+def moe_correct_ridge(Z_orig, R, Phi_moe, lamb) -> np.ndarray:
     """Apply the mixture-of-experts ridge correction to a (features x cells)
-    matrix. ``lamb_diag`` is the (B+1,) ridge diagonal (intercept entry 0)."""
+    matrix. ``lamb`` is either the (B+1,) ridge diagonal (intercept entry 0)
+    or the full (B+1) x (B+1) matrix as harmonypy's result object carries it
+    (``preprocess.py:382`` passes ``ho.lamb`` straight through)."""
+    lamb = jnp.asarray(np.asarray(lamb), jnp.float32)
+    if lamb.ndim == 1:
+        lamb = jnp.diag(lamb)
     return np.asarray(_moe_ridge_scan(
         jnp.asarray(np.asarray(Z_orig), jnp.float32),
         jnp.asarray(np.asarray(R), jnp.float32),
         jnp.asarray(np.asarray(Phi_moe), jnp.float32),
-        jnp.asarray(np.asarray(lamb_diag), jnp.float32)))
+        lamb))
 
 
 def run_harmony(data_mat, meta_data: pd.DataFrame, vars_use, theta=2.0,
@@ -207,7 +214,7 @@ def run_harmony(data_mat, meta_data: pd.DataFrame, vars_use, theta=2.0,
 
         # --- correction ----------------------------------------------
         Z_corr = _moe_ridge_scan(jnp.asarray(Z), R, Phi_moe_d,
-                                 jnp.asarray(lamb_diag))
+                                 jnp.diag(jnp.asarray(lamb_diag)))
 
         if len(objectives) >= 3:
             o = objectives
@@ -219,7 +226,9 @@ def run_harmony(data_mat, meta_data: pd.DataFrame, vars_use, theta=2.0,
         Z_cos=np.asarray(_normalize_cols(Z_corr)),
         R=np.asarray(R),
         Phi_moe=Phi_moe,
-        lamb=lamb_diag,
+        # full matrix, matching the harmonypy result surface the reference
+        # forwards into moe_correct_ridge (preprocess.py:382)
+        lamb=np.diag(lamb_diag),
         K=K,
         objectives=objectives,
     )

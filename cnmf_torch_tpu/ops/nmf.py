@@ -520,7 +520,16 @@ def nndsvd_init_gram(X, k: int, variant: str = "nndsvdar", key=None):
     evals, evecs = jnp.linalg.eigh(G)           # ascending
     S = jnp.sqrt(jnp.clip(evals[::-1][:k], 0.0))
     V = evecs[:, ::-1][:, :k]                   # (g, k)
-    U = jnp.matmul(X, V, precision=_HI) / jnp.maximum(S, EPS)
+    # floor S relative to S[0]: when k exceeds the numerical rank, clipped
+    # eigenvalues give S ~ 0, and X@V for those columns is fp32 noise —
+    # dividing it by EPS would seed ~1e10-scale factors (the full-SVD path
+    # has orthonormal U and no such blowup). Treat those components as rank
+    # overflow: zero the U column so the nndsvda/ar fill takes over, which
+    # is exactly how the full-SVD variant behaves on a zero singular pair.
+    rank_ok = S > 1e-6 * jnp.maximum(S[0], EPS)
+    S = jnp.where(rank_ok, S, 0.0)
+    U = jnp.where(rank_ok[None, :],
+                  jnp.matmul(X, V, precision=_HI) / jnp.maximum(S, EPS), 0.0)
     return _nndsvd_from_svd(U, S, V.T, k, variant, key, jnp.mean(X))
 
 

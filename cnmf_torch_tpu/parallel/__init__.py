@@ -1,7 +1,15 @@
-from .replicates import default_mesh, replicate_sweep, worker_filter
+from .replicates import (
+    auto_replicates_per_batch,
+    clear_sweep_cache,
+    default_mesh,
+    replicate_sweep,
+    worker_filter,
+)
 from .rowshard import fit_h_rowsharded, nmf_fit_rowsharded, pad_rows_to_mesh
 
 __all__ = [
+    "auto_replicates_per_batch",
+    "clear_sweep_cache",
     "default_mesh",
     "replicate_sweep",
     "worker_filter",

@@ -36,7 +36,8 @@ from ..ops.nmf import (
     split_regularization,
 )
 
-__all__ = ["replicate_sweep", "worker_filter", "default_mesh"]
+__all__ = ["replicate_sweep", "worker_filter", "default_mesh",
+           "auto_replicates_per_batch", "clear_sweep_cache"]
 
 
 def worker_filter(iterable, worker_index: int, total_workers: int):
@@ -54,6 +55,37 @@ def default_mesh(axis_name: str = "replicates") -> Mesh | None:
     if len(devices) <= 1:
         return None
     return Mesh(np.asarray(devices), (axis_name,))
+
+
+def auto_replicates_per_batch(n: int, g: int, k: int, beta: float = 2.0,
+                              chunk: int | None = None, n_dev: int = 1,
+                              budget_elems: int = 1 << 28) -> int:
+    """How many vmapped replicates fit one device slice under the fp32
+    element budget (~1 GiB of live state by default).
+
+    Each replicate carries its factor state (3x (n*k + k*g) for the
+    current/next/temporary H and W, plus the returned usage stack). For
+    beta != 2 the MU numerators materialize chunk x genes intermediates
+    *per replicate* (``ops/nmf.py:_update_H``: H@W, X/WH, and the rate
+    product all live at once inside the inner while_loop) — the beta=2
+    path never builds them (it works from k x k / k x g sufficient
+    statistics). Omitting that charge is what let a 100-replicate KL
+    sweep admit ~4 GB of live intermediates per buffer and crash the TPU
+    worker (round-2 bench, BENCH_r02.json).
+    """
+    per_rep = 3 * (n * k + k * g) + n * k
+    if beta != 2.0:
+        c = n if chunk is None else min(int(chunk), n)
+        per_rep += 3 * c * g
+    return max(n_dev, int(budget_elems // max(per_rep, 1)))
+
+
+def clear_sweep_cache() -> None:
+    """Evict the per-(shape, config) compiled sweep executables (and the
+    mesh/device references they retain). Long-lived library use across many
+    datasets/meshes can otherwise accumulate unbounded compile-cache
+    memory; CLI runs never need this."""
+    _sweep_program.cache_clear()
 
 
 def _stacked_inits(X, k: int, seeds, init: str):
@@ -184,17 +216,23 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
                 np.zeros((0, n, k), np.float32) if return_usages else None,
                 np.zeros((0,), np.float32))
 
+    if init == "nndsvda" and R > 1:
+        import warnings
+
+        warnings.warn(
+            "init='nndsvda' is deterministic given X: all %d replicates of "
+            "this sweep will be identical and consensus over them is "
+            "vacuous. Use init='nndsvd' (seeded nndsvdar fill) or 'random' "
+            "for replicate sweeps." % R, UserWarning, stacklevel=2)
+
     l1_W, l2_W = split_regularization(alpha_W, l1_ratio_W)
     l1_H, l2_H = split_regularization(alpha_H, l1_ratio_H)
 
     n_dev = 1 if mesh is None else math.prod(mesh.devices.shape)
     if replicates_per_batch is None:
-        # bound per-slice device footprint: each replicate holds an n x k
-        # usage state plus solver temporaries of the same order; keep the
-        # whole slice (inputs + X + outputs) well under a single-chip HBM
-        budget_elems = 1 << 28  # ~1 GiB of fp32 state per slice
-        per_rep = 3 * (n * k + k * g) + n * k
-        replicates_per_batch = max(n_dev, int(budget_elems // max(per_rep, 1)))
+        chunk = int(min(online_chunk_size, n)) if mode == "online" else n
+        replicates_per_batch = auto_replicates_per_batch(
+            n, g, k, beta=beta, chunk=chunk, n_dev=n_dev)
     # slices must stay mesh-multiples so every shard stays busy
     replicates_per_batch = max(n_dev, (replicates_per_batch // n_dev) * n_dev)
 

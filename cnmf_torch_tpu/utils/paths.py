@@ -58,4 +58,12 @@ def build_paths(output_dir: str, name: str, create: bool = True) -> dict:
 
         "k_selection_plot": os.path.join(top, name + ".k_selection.png"),
         "k_selection_stats": os.path.join(top, name + ".k_selection_stats.df.npz"),
+
+        # TPU-build addition (no reference counterpart): what factorize
+        # ACTUALLY ran — engaged execution path + effective solver params —
+        # so provenance matches execution even when auto-rowshard swaps the
+        # solver family away from the prepared ledger's settings. Templated
+        # on worker index: fleet workers must not clobber each other's
+        # records (same write-disjointness rule as iter_spectra).
+        "factorize_provenance": os.path.join(tmp, name + ".factorize_provenance.w%d.yaml"),
     }

@@ -135,6 +135,26 @@ def test_nndsvd_init_quality():
             < np.linalg.norm(X - np.asarray(Hr) @ np.asarray(Wr)))
 
 
+def test_nndsvd_gram_rank_deficient_no_blowup():
+    """k > rank(X): clipped eigenvalues must NOT seed ~1e10-scale factors
+    (X@V noise / EPS). Rank-overflow components zero out so the seeded fill
+    takes over, mirroring the full-SVD path."""
+    from cnmf_torch_tpu.ops.nmf import nndsvd_init_gram
+
+    rng = np.random.default_rng(4)
+    # exactly rank-2 nonnegative matrix; ask for k=5
+    X = (rng.random((40, 2)) @ rng.random((2, 30))).astype(np.float32)
+    H, W = nndsvd_init_gram(jnp.asarray(X), 5, variant="nndsvdar",
+                            key=jax.random.key(0))
+    H, W = np.asarray(H), np.asarray(W)
+    assert np.isfinite(H).all() and np.isfinite(W).all()
+    assert H.max() < 100 * max(X.max(), 1.0)
+    assert W.max() < 100 * max(X.max(), 1.0)
+    # overflow components carry the small seeded fill, not zeros (absorbing
+    # under MU) and not noise-driven garbage
+    assert (H > 0).any() and (W > 0).any()
+
+
 def test_run_nmf_nndsvd_end_to_end():
     X, _, _ = _synthetic(n=80, g=40, k=3, noise=0.0)
     H, W, err = run_nmf(X, n_components=3, init="nndsvd", mode="batch", tol=1e-6)

@@ -237,6 +237,45 @@ def test_pipeline_rowsharded_factorize(tmp_path, mesh):
 
 
 # ---------------------------------------------------------------------------
+# device-memory budgeting for the sweep slices
+# ---------------------------------------------------------------------------
+
+def test_kl_budget_splits_crashed_shape():
+    """Regression for the round-2 TPU crash (BENCH_r02: rc=1): the shape
+    100 replicates x (10000 x 2000) under KL must NOT be admitted as one
+    slice — beta != 2 materializes (chunk x genes) intermediates per
+    replicate that the old factor-state-only budget ignored."""
+    from cnmf_torch_tpu.parallel import auto_replicates_per_batch
+
+    kl = auto_replicates_per_batch(10000, 2000, 9, beta=1.0, chunk=5000)
+    assert kl < 100, "KL sweep must split into multiple device slices"
+    # a slice's worth of beta!=2 intermediates stays under the 1 GiB budget
+    per_rep = 3 * (10000 * 9 + 9 * 2000) + 10000 * 9 + 3 * 5000 * 2000
+    assert kl * per_rep <= (1 << 28)
+    # the Frobenius path works from k x k / k x g statistics and admits
+    # far more replicates per slice
+    fro = auto_replicates_per_batch(10000, 2000, 9, beta=2.0, chunk=5000)
+    assert fro > kl
+    # never starves the mesh
+    assert auto_replicates_per_batch(10 ** 6, 2000, 9, beta=1.0,
+                                     chunk=5000, n_dev=8) >= 8
+
+
+def test_kl_sweep_sliced_matches_single_slice():
+    """Slicing a KL sweep across device batches must be semantics-free."""
+    X = _lowrank(n=60, g=30, k=3, seed=29) + 0.01
+    seeds = [7, 8, 9, 10]
+    ref, _, ref_err = replicate_sweep(
+        X, seeds, 3, beta_loss="kullback-leibler", mode="batch",
+        batch_max_iter=50)
+    got, _, got_err = replicate_sweep(
+        X, seeds, 3, beta_loss="kullback-leibler", mode="batch",
+        batch_max_iter=50, replicates_per_batch=2)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(got_err, ref_err, rtol=1e-3)
+
+
+# ---------------------------------------------------------------------------
 # nndsvd replicate diversity (seeded nndsvdar fill)
 # ---------------------------------------------------------------------------
 

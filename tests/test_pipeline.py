@@ -138,6 +138,20 @@ def test_factorize_writes_ledgered_spectra(e2e_run):
         assert np.isfinite(spec.values).all()
 
 
+def test_factorize_records_provenance(e2e_run):
+    """Run artifacts must say which execution path actually ran (batched vs
+    rowshard vs sequential) with its effective solver params — the ledger
+    YAML alone describes intent, not execution."""
+    import yaml
+
+    obj, _ = e2e_run
+    with open(obj.paths["factorize_provenance"] % 0) as f:
+        record = yaml.safe_load(f)
+    assert record["engaged_path"] == "batched"
+    assert record["effective_params"]["beta_loss"] == "frobenius"
+    assert "mesh_devices" in record["effective_params"]
+
+
 def test_combine_shapes_and_labels(e2e_run):
     obj, _ = e2e_run
     merged = load_df_from_npz(obj.paths["merged_spectra"] % 4)

@@ -182,6 +182,32 @@ def test_moe_correct_ridge_removes_batch_offset(rng):
     assert abs(Xc.mean() - X.mean()) < 0.5
 
 
+def test_moe_correct_ridge_matrix_lamb_matches_vector(rng):
+    """harmonypy carries a full (B+1)x(B+1) lamb matrix; the vector form is
+    a convenience — both must produce identical corrections."""
+    n, g = 80, 12
+    batch = np.tile([0, 1], n // 2)
+    X = rng.normal(2.0, 1.0, size=(g, n))
+    phi = np.stack([(batch == 0).astype(float), (batch == 1).astype(float)])
+    Phi_moe = np.vstack([np.ones((1, n)), phi])
+    R = rng.dirichlet(np.ones(3), size=n).T
+    vec = np.array([0.0, 1.0, 1.0])
+    np.testing.assert_allclose(
+        moe_correct_ridge(X, R, Phi_moe, vec),
+        moe_correct_ridge(X, R, Phi_moe, np.diag(vec)),
+        rtol=0, atol=0)
+
+
+def test_stdscale_quantile_ceiling_sparse_rejects_negatives(rng):
+    """The sparse quantile path merges implicit zeros assuming nonnegative
+    stored values; signed input must raise, not silently mis-threshold."""
+    import pytest
+
+    X = sp.csr_matrix(rng.normal(size=(30, 10)))
+    with pytest.raises(ValueError, match="negative"):
+        stdscale_quantile_celing(AnnDataLite(X), quantile_thresh=0.99)
+
+
 def test_stdscale_quantile_ceiling_sparse_matches_dense(rng):
     X = rng.random((60, 25))
     X[X < 0.6] = 0.0
