@@ -81,12 +81,18 @@ class cNMF:
     ``output_dir/name/`` with intermediates in ``cnmf_tmp/``.
     """
 
-    def __init__(self, output_dir: str = ".", name: str | None = None):
+    def __init__(self, output_dir: str = ".", name: str | None = None,
+                 rowshard_threshold: int = 200_000):
         self.output_dir = output_dir
         if name is None:
             now = datetime.datetime.now()
             name = "%s_%s" % (now.strftime("%Y_%m_%d"), uuid.uuid4().hex[:6])
         self.name = name
+        # cell count above which factorize AND the consensus refits switch
+        # to the row-sharded/streaming kernels instead of densifying X
+        # (BASELINE config 5; no reference counterpart — the reference
+        # densifies at every solver boundary, cnmf.py:817-818, 329-330)
+        self.rowshard_threshold = int(rowshard_threshold)
         self.paths = build_paths(output_dir, name)
         # per-stage wall-clock ledger + optional XLA traces (SURVEY.md §5.1:
         # the reference has no tracing; this fills that gap)
@@ -298,7 +304,7 @@ class cNMF:
     def factorize(self, worker_i=0, total_workers=1,
                   skip_completed_runs=False, batched=True, mesh=None,
                   replicates_per_batch=None, rowshard=None,
-                  rowshard_threshold: int = 200_000):
+                  rowshard_threshold: int | None = None):
         """Run this worker's share of the replicate ledger.
 
         Contract-compatible with the reference (``cnmf.py:839-892``):
@@ -331,6 +337,8 @@ class cNMF:
                 worker_i, total_workers)
         jobs = list(jobs)
 
+        if rowshard_threshold is None:
+            rowshard_threshold = self.rowshard_threshold
         if rowshard is None:
             # auto-engage only for the default batched path: an explicit
             # batched=False / --sequential request keeps its solver
@@ -567,7 +575,13 @@ class cNMF:
         (``cnmf.py:923-976`` -> :func:`cnmf_torch_tpu.ops.nmf.fit_h`).
         The H-subproblem is convex, so the fixed-key random init gives a
         deterministic result where the reference's unseeded torch init did
-        not."""
+        not.
+
+        Above ``rowshard_threshold`` cells the refit runs row-sharded
+        (:func:`~cnmf_torch_tpu.parallel.fit_h_rowsharded`): X streams
+        host->HBM shard-wise with no host dense copy — the reference's
+        ``X.toarray()`` at this boundary (cnmf.py:329-330) is the wall for
+        atlas-scale consensus."""
         kwargs = yaml.load(open(self.paths["nmf_run_parameters"]),
                            Loader=yaml.FullLoader)
         beta = beta_loss_to_float(kwargs["beta_loss"])
@@ -575,6 +589,20 @@ class cNMF:
             X = X.values
         if isinstance(spectra, pd.DataFrame):
             spectra = spectra.values
+        if X.shape[0] >= self.rowshard_threshold and usage is None:
+            from ..parallel import default_mesh, fit_h_rowsharded
+
+            mesh = default_mesh(axis_name="cells")
+            if mesh is None:
+                import jax
+                from jax.sharding import Mesh
+
+                mesh = Mesh(np.asarray(jax.devices()[:1]), ("cells",))
+            return fit_h_rowsharded(
+                X, np.asarray(spectra), mesh, h_tol=0.05,
+                chunk_max_iter=int(kwargs["online_chunk_max_iter"]),
+                l1_reg_H=float(kwargs["l1_ratio_H"]), l2_reg_H=0.0,
+                beta=beta)
         return fit_h(
             X, np.asarray(spectra),
             H_init=None if usage is None else np.asarray(usage),
@@ -586,7 +614,23 @@ class cNMF:
             beta=beta)
 
     def refit_spectra(self, X, usage):
-        """Transpose trick (``cnmf.py:979-994``)."""
+        """Transpose trick (``cnmf.py:979-994``) below the rowshard
+        threshold. Above it, the transpose trick is exactly what must NOT
+        happen — its row chunks become (chunk x n_cells) dense buffers — so
+        the W-subproblem is solved directly from k-sized sufficient
+        statistics / streamed row blocks
+        (:func:`~cnmf_torch_tpu.parallel.rowshard.refit_w_rowsharded`)."""
+        if X.shape[0] >= self.rowshard_threshold:
+            from ..parallel.rowshard import refit_w_rowsharded
+
+            kwargs = yaml.load(open(self.paths["nmf_run_parameters"]),
+                               Loader=yaml.FullLoader)
+            return refit_w_rowsharded(
+                X, np.asarray(usage),
+                beta=beta_loss_to_float(kwargs["beta_loss"]),
+                h_tol=0.05,
+                max_iter=int(kwargs["online_chunk_max_iter"]),
+                l1_reg_W=float(kwargs["l1_ratio_W"]))
         return self.refit_usage(X.T, np.asarray(usage).T).T
 
     # ------------------------------------------------------------------
@@ -598,7 +642,8 @@ class cNMF:
                   local_neighborhood_size=0.30, show_clustering=True,
                   build_ref=True, skip_density_and_return_after_stats=False,
                   close_clustergram_fig=False, refit_usage=True,
-                  normalize_tpm_spectra=False, norm_counts=None):
+                  normalize_tpm_spectra=False, norm_counts=None,
+                  ols_batch_size=65536):
         """Consensus spectra/usages from the merged replicate matrix
         (``cnmf.py:997-1256``): L2-normalize, KNN local-density outlier
         filter (cached), k-means(k, 10 inits, fixed key), cluster medians,
@@ -687,8 +732,10 @@ class cNMF:
             spectra_tpm = spectra_tpm.div(spectra_tpm.sum(axis=1),
                                           axis=0) * 1e6
 
-        # z-score spectra: OLS of z-scored TPM against usages (cnmf.py:1132)
-        usage_coef = ols_all_cols(rf_usages.values, tpm.X, normalize_y=True)
+        # z-score spectra: OLS of z-scored TPM against usages (cnmf.py:1132);
+        # sparse TPM densifies one ols_batch_size row block at a time
+        usage_coef = ols_all_cols(rf_usages.values, tpm.X, normalize_y=True,
+                                  batch_size=int(ols_batch_size))
         usage_coef = pd.DataFrame(usage_coef, index=rf_usages.columns,
                                   columns=tpm.var.index)
 

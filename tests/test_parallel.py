@@ -140,6 +140,46 @@ def test_fit_h_rowsharded_matches_single(mesh):
     assert abs(r_ref - r_sh) / r_ref < 1e-2
 
 
+@pytest.mark.parametrize("beta", [2.0, 1.0])
+def test_refit_w_matches_transpose_trick(beta):
+    """refit_w_rowsharded solves the same convex W-subproblem the
+    reference's transpose trick does (refit_usage(X.T, usage.T).T,
+    cnmf.py:979-994) — equal-quality optima, no transposed buffers."""
+    from cnmf_torch_tpu.parallel.rowshard import refit_w_rowsharded
+
+    X = _lowrank(n=120, g=40, k=3, seed=31) + 0.01
+    rng = np.random.default_rng(5)
+    H = rng.gamma(1.0, 1.0, size=(120, 3)).astype(np.float32)
+    W_direct = refit_w_rowsharded(X, H, beta=beta, h_tol=1e-4, max_iter=500,
+                                  row_block=50)
+    W_transpose = fit_h(X.T, H.T, chunk_size=40, h_tol=1e-4,
+                        chunk_max_iter=500, beta=beta).T
+    assert W_direct.shape == (3, 40) and (W_direct >= 0).all()
+    r_direct = float(beta_divergence(X, H, W_direct, beta=beta))
+    r_transpose = float(beta_divergence(X, H, W_transpose, beta=beta))
+    assert abs(r_direct - r_transpose) / max(r_transpose, 1e-9) < 2e-2
+
+
+def test_refit_w_sparse_stats_path():
+    """beta=2 path must consume CSR via sparse matmuls (k-sized statistics),
+    never a dense X."""
+    from cnmf_torch_tpu.parallel.rowshard import refit_w_rowsharded
+
+    X = sp.random(200, 30, density=0.2, random_state=7, format="csr",
+                  dtype=np.float64)
+    H = np.abs(np.random.default_rng(8).normal(size=(200, 4))).astype(
+        np.float32)
+    orig = sp.csr_matrix.toarray
+    called = []
+    sp.csr_matrix.toarray = lambda self, *a, **kw: (
+        called.append(self.shape) or orig(self, *a, **kw))
+    try:
+        W = refit_w_rowsharded(X, H, beta=2.0)
+    finally:
+        sp.csr_matrix.toarray = orig
+    assert W.shape == (4, 30) and not called
+
+
 def test_fit_h_rowsharded_sparse_input(mesh):
     X = sp.random(50, 30, density=0.3, random_state=1, format="csr",
                   dtype=np.float64)
@@ -203,14 +243,18 @@ def test_prepared_device_array_reused_across_ks(mesh):
         assert np.isfinite(err)
 
 
-def test_pipeline_rowsharded_factorize(tmp_path, mesh):
-    """Pipeline-level atlas path: factorize(rowshard=True) on sparse counts
-    produces the same artifact contract, consensus runs downstream, and the
-    norm-counts matrix is never densified whole on host."""
+def test_pipeline_rowsharded_factorize(tmp_path, mesh, monkeypatch):
+    """Pipeline-level atlas path: factorize -> combine -> consensus runs
+    ENTIRELY row-sharded on sparse counts (threshold below the cell count):
+    same artifact contract, and no code path ever densifies more than a
+    shard-sized row block on host — including the three consensus refits
+    (VERDICT r2: the reference's fit_H/refit densify walls,
+    cnmf.py:329-330, 979-994)."""
     import pandas as pd
 
     from cnmf_torch_tpu import cNMF
-    from cnmf_torch_tpu.utils import save_df_to_npz, load_df_from_npz
+    from cnmf_torch_tpu.utils import load_df_from_npz
+    from cnmf_torch_tpu.utils.anndata_lite import AnnDataLite, write_h5ad
 
     rng = np.random.default_rng(33)
     n, g, ktrue = 300, 220, 4
@@ -218,22 +262,43 @@ def test_pipeline_rowsharded_factorize(tmp_path, mesh):
     spectra = rng.gamma(0.4, 1.0, size=(ktrue, g)) * 40.0 / g
     counts = rng.poisson(usage @ spectra * 150.0).astype(np.float64)
     counts[counts.sum(axis=1) == 0, 0] = 1.0
-    df = pd.DataFrame(counts, index=[f"c{i}" for i in range(n)],
-                      columns=[f"g{j}" for j in range(g)])
-    counts_fn = str(tmp_path / "counts.df.npz")
-    save_df_to_npz(df, counts_fn)
+    counts_fn = str(tmp_path / "counts.h5ad")
+    write_h5ad(counts_fn, AnnDataLite(
+        X=sp.csr_matrix(counts),
+        obs=pd.DataFrame(index=[f"c{i}" for i in range(n)]),
+        var=pd.DataFrame(index=[f"g{j}" for j in range(g)])))
 
-    obj = cNMF(output_dir=str(tmp_path), name="atlas")
+    obj = cNMF(output_dir=str(tmp_path), name="atlas",
+               rowshard_threshold=n // 2)
     obj.prepare(counts_fn, components=[4], n_iter=7, seed=9,
                 num_highvar_genes=150)
-    obj.factorize(rowshard=True, mesh=mesh)
+
+    # from here on, any host densify must be <= one device shard of rows
+    n_dev = int(np.prod(mesh.devices.shape))
+    max_block = -(-n // n_dev) + n_dev
+    seen = []
+    orig = sp.csr_matrix.toarray
+
+    def spy(self, *a, **kw):
+        seen.append(self.shape)
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(sp.csr_matrix, "toarray", spy)
+    obj.factorize(mesh=mesh)  # auto-engages: n >= threshold
     obj.combine()
-    obj.consensus(4, density_threshold=2.0, show_clustering=False)
+    obj.consensus(4, density_threshold=2.0, show_clustering=False,
+                  ols_batch_size=max_block)
+
+    oversized = [s for s in seen if s[0] > max_block]
+    assert not oversized, f"host densify beyond shard size: {oversized}"
 
     merged = load_df_from_npz(obj.paths["merged_spectra"] % 4)
     assert merged.shape == (7 * 4, 150)
     usages = load_df_from_npz(obj.paths["consensus_usages"] % (4, "2_0"))
     assert usages.shape == (n, 4) and np.isfinite(usages.values).all()
+    tpm_spectra = load_df_from_npz(obj.paths["gene_spectra_tpm"] % (4, "2_0"))
+    assert tpm_spectra.shape == (4, g)
+    assert np.isfinite(tpm_spectra.values).all()
 
 
 # ---------------------------------------------------------------------------
