@@ -34,7 +34,7 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "command", type=str,
         choices=["prepare", "factorize", "combine", "consensus",
-                 "k_selection_plot"])
+                 "k_selection_plot", "run_parallel"])
     parser.add_argument("--name", type=str, nargs="?", default="cNMF",
                         help="[all] Name for analysis. All output will be "
                              "placed in [output-dir]/[name]/...")
@@ -113,6 +113,31 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--rowshard-threshold", type=int, default=200_000,
                         help="[factorize] Cell count at which factorize "
                              "auto-switches to the row-sharded path")
+    parser.add_argument("--mesh-2d", dest="mesh_2d", action="store_true",
+                        default=False,
+                        help="[factorize] Run the sweep over the 2-D "
+                             "(replicates x cells) device mesh — the "
+                             "multi-host layout: replicate shards across "
+                             "hosts, cells-axis collectives on ICI")
+    parser.add_argument("--distributed", action="store_true", default=False,
+                        help="[factorize] Initialize jax.distributed from "
+                             "CNMF_COORDINATOR_ADDRESS / CNMF_NUM_PROCESSES "
+                             "/ CNMF_PROCESS_ID before running (multi-host "
+                             "pods; also implied when those env vars are "
+                             "set)")
+    parser.add_argument("--engine", type=str, default="subprocess",
+                        choices=["subprocess", "multihost"],
+                        help="[run_parallel] How factorize workers run: "
+                             "independent OS processes sharing files (the "
+                             "reference's GNU-parallel model) or one "
+                             "jax.distributed program over a 2-D mesh")
+    parser.add_argument("--devices-per-host", type=int, default=None,
+                        help="[run_parallel] Virtual CPU devices per "
+                             "multihost process (pod simulation; omit on "
+                             "real hardware)")
+    parser.add_argument("--clean", action="store_true", default=False,
+                        help="[run_parallel] Delete per-replicate spectra "
+                             "files after combine")
     parser.add_argument("--local-density-threshold", type=float, default=0.5,
                         help="[consensus] Threshold for the local density "
                              "filtering. This string must convert to a float "
@@ -136,9 +161,44 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None):
-    from .models.cnmf import cNMF
+    import os
+
+    # pod-simulation hook (set by the multihost launcher engine): force N
+    # virtual CPU devices BEFORE the backend initializes. Env vars are too
+    # late here — this environment pre-imports jax at interpreter startup —
+    # so go through jax.config like tests/conftest.py does.
+    sim = os.environ.get("CNMF_SIM_CPU_DEVICES")
+    if sim:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", int(sim))
 
     args = build_parser().parse_args(argv)
+
+    if args.command == "run_parallel":
+        from .launcher import run_pipeline
+
+        run_pipeline(
+            args.counts, args.output_dir, args.name,
+            components=args.components, n_iter=args.n_iter,
+            total_workers=max(args.total_workers, 1), seed=args.seed,
+            numgenes=args.numgenes, genes_file=args.genes_file,
+            tpm=args.tpm, beta_loss=args.beta_loss, init=args.init,
+            max_nmf_iter=args.max_nmf_iter, batch_size=args.batch_size,
+            engine=args.engine, devices_per_host=args.devices_per_host,
+            clean=args.clean)
+        return
+
+    if args.command == "factorize" and (
+            args.distributed or os.environ.get("CNMF_COORDINATOR_ADDRESS")):
+        from .parallel import initialize_distributed
+
+        pid, nproc = initialize_distributed()
+        print(f"jax.distributed: process {pid}/{nproc}")
+
+    from .models.cnmf import cNMF
+
     cnmf_obj = cNMF(output_dir=args.output_dir, name=args.name)
 
     if args.command == "prepare":
@@ -156,6 +216,7 @@ def main(argv=None):
             total_workers=max(args.total_workers, 1),
             skip_completed_runs=args.skip_completed_runs,
             batched=not args.sequential,
+            mesh="2d" if args.mesh_2d else None,
             rowshard=args.rowshard,
             rowshard_threshold=args.rowshard_threshold)
 

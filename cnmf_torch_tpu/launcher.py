@@ -1,0 +1,136 @@
+"""End-to-end pipeline launcher — the reference ``Extras/run_parallel.py``
+equivalent (``/root/reference/Extras/run_parallel.py:1-70``: prepare -> GNU
+parallel factorize workers -> combine -> k_selection_plot -> clean).
+
+Two engines replace GNU parallel:
+
+  * ``subprocess`` — N independent OS worker processes, round-robin sharded
+    by ``--worker-index`` over the replicate ledger, exactly the reference's
+    model (files as the dataplane). Right for a fleet of single-chip hosts
+    with a shared filesystem and for CPU dev boxes. A dead worker costs only
+    its own replicates: combine runs with ``skip_missing_files=True`` when
+    any worker exits nonzero.
+  * ``multihost`` — ONE single-controller JAX program spanning N processes
+    stitched by ``jax.distributed`` (``parallel/multihost.py``); factorize
+    runs over the 2-D (replicates x cells) mesh, with the cells-psum on ICI
+    and the replicate axis across hosts. On a real TPU pod you normally
+    launch that yourself (same command on every host); this engine spawns
+    the N processes locally — with ``--devices-per-host`` virtual CPU
+    devices each — which is how the multi-host path is CI-tested without a
+    pod.
+
+Python API: :func:`run_pipeline`. CLI: ``cnmf-tpu run_parallel ...``.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import socket
+import subprocess
+import sys
+import warnings
+
+__all__ = ["run_pipeline"]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker_cmd(output_dir: str, name: str, extra: list[str]) -> list[str]:
+    return [sys.executable, "-m", "cnmf_torch_tpu", "factorize",
+            "--output-dir", output_dir, "--name", name] + extra
+
+
+def run_pipeline(counts: str, output_dir: str, name: str,
+                 components, n_iter: int = 100, total_workers: int = 1,
+                 seed: int | None = None, numgenes: int = 2000,
+                 genes_file: str | None = None, tpm: str | None = None,
+                 beta_loss: str = "frobenius", init: str = "random",
+                 max_nmf_iter: int = 1000, batch_size: int = 5000,
+                 engine: str = "subprocess",
+                 devices_per_host: int | None = None,
+                 clean: bool = False, k_selection: bool = True,
+                 env_extra: dict | None = None) -> None:
+    """prepare -> parallel factorize -> combine -> k_selection_plot.
+
+    ``engine='subprocess'``: ``total_workers`` OS processes shard the ledger
+    (the reference's GNU-parallel model). ``engine='multihost'``:
+    ``total_workers`` JAX processes form one distributed program over a 2-D
+    mesh; ``devices_per_host`` forces that many virtual CPU devices per
+    process (pod simulation — omit on real multi-chip hosts).
+    """
+    from .models.cnmf import cNMF
+
+    obj = cNMF(output_dir=output_dir, name=name)
+    obj.prepare(counts, components=components, n_iter=n_iter, seed=seed,
+                num_highvar_genes=numgenes, genes_file=genes_file,
+                tpm_fn=tpm, beta_loss=beta_loss, init=init,
+                max_NMF_iter=max_nmf_iter, batch_size=batch_size,
+                total_workers=max(total_workers, 1))
+
+    base_env = dict(os.environ)
+    # workers must import this package regardless of their cwd (source
+    # checkouts aren't necessarily pip-installed)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base_env["PYTHONPATH"] = os.pathsep.join(
+        [pkg_root] + ([base_env["PYTHONPATH"]]
+                      if base_env.get("PYTHONPATH") else []))
+    if env_extra:
+        base_env.update({k: str(v) for k, v in env_extra.items()})
+
+    any_failed = False
+    if engine == "subprocess":
+        procs = []
+        for i in range(total_workers):
+            cmd = _worker_cmd(output_dir, name,
+                              ["--worker-index", str(i),
+                               "--total-workers", str(total_workers)])
+            procs.append((i, subprocess.Popen(cmd, env=base_env)))
+        for i, p in procs:
+            if p.wait() != 0:
+                any_failed = True
+                warnings.warn(
+                    "factorize worker %d exited with rc=%d; its replicates "
+                    "will be skipped at combine (the reference's dead-worker "
+                    "tolerance, cnmf.py:904-909)" % (i, p.returncode),
+                    RuntimeWarning)
+    elif engine == "multihost":
+        port = _free_port()
+        procs = []
+        for pid in range(total_workers):
+            env = dict(base_env,
+                       CNMF_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                       CNMF_NUM_PROCESSES=str(total_workers),
+                       CNMF_PROCESS_ID=str(pid))
+            if devices_per_host:
+                env["CNMF_SIM_CPU_DEVICES"] = str(devices_per_host)
+            cmd = _worker_cmd(output_dir, name,
+                              ["--mesh-2d", "--distributed"])
+            procs.append((pid, subprocess.Popen(cmd, env=env)))
+        rcs = [(pid, p.wait()) for pid, p in procs]
+        bad = [(pid, rc) for pid, rc in rcs if rc]
+        if bad:
+            # a single-controller program has no partial completion: one
+            # dead process stalls the collective, unlike the subprocess
+            # engine's independent workers
+            raise RuntimeError(
+                f"multihost factorize failed on processes {bad}")
+    else:
+        raise ValueError(f"unknown engine {engine!r}")
+
+    obj.combine(skip_missing_files=any_failed)
+    if k_selection:
+        obj.k_selection_plot(close_fig=True)
+
+    if clean:
+        # the reference's `rm .../cnmf_tmp/*.iter_*.df.npz`
+        # (run_parallel.py:64): per-replicate spectra are redundant once
+        # merged_spectra exists
+        pattern = os.path.join(output_dir, name, "cnmf_tmp",
+                               "*.iter_*.df.npz")
+        for f in glob.glob(pattern):
+            os.remove(f)

@@ -337,6 +337,19 @@ class cNMF:
                 worker_i, total_workers)
         jobs = list(jobs)
 
+        # 2-D replicates x cells mesh (multi-host layout, parallel/multihost):
+        # mesh="2d" auto-builds it; a Mesh with those two axes routes as-is
+        if (mesh == "2d"
+                or (hasattr(mesh, "axis_names")
+                    and tuple(mesh.axis_names) == ("replicates", "cells"))):
+            from ..parallel import mesh_2d
+
+            if mesh == "2d":
+                mesh = mesh_2d()
+            self._factorize_2d(jobs, run_params, norm_counts, _nmf_kwargs,
+                               mesh, worker_i)
+            return
+
         if rowshard_threshold is None:
             rowshard_threshold = self.rowshard_threshold
         if rowshard is None:
@@ -517,6 +530,73 @@ class cNMF:
             df = pd.DataFrame(spectra, index=np.arange(1, k + 1),
                               columns=norm_counts.var.index)
             save_df_to_npz(df, self.paths["iter_spectra"] % (k, p["iter"]))
+
+    def _factorize_2d(self, jobs, run_params, norm_counts, nmf_kwargs,
+                      mesh, worker_i):
+        """Factorize over the 2-D (replicates, cells) mesh — the multi-host
+        layout (``parallel/multihost.py``): each replicate row-shards its
+        cells over the mesh's cell axis (psum'd W statistics on ICI), the
+        replicate axis spans hosts with zero solver traffic. X stages once,
+        cells-sharded and replicate-axis-replicated, reused by every per-K
+        sweep. On multi-host runs every process executes the same programs;
+        only the coordinator writes artifacts (the reference's file
+        dataplane, SURVEY.md §1.1, kept as the durable layer)."""
+        import jax
+
+        from ..parallel import is_coordinator, sync_hosts
+        from ..parallel.multihost import replicate_sweep_2d, stage_x_2d
+
+        Xd = stage_x_2d(norm_counts.X, mesh)
+        n_orig = int(norm_counts.X.shape[0])
+        r_dim, c_dim = mesh.devices.shape
+        print("[Worker %d]. 2-D factorize: %d cells x %d replicate shards "
+              "(%d x %d mesh, %d processes), %d tasks."
+              % (worker_i, n_orig, r_dim, r_dim, c_dim,
+                 jax.process_count(), len(jobs)))
+        if is_coordinator():
+            self._save_factorize_provenance(
+                "mesh2d", worker_i,
+                {"beta_loss": nmf_kwargs["beta_loss"],
+                 "init": nmf_kwargs.get("init", "random"),
+                 "tol": nmf_kwargs.get("tol", 1e-4),
+                 "n_passes": nmf_kwargs.get("n_passes", 20),
+                 "chunk_max_iter": nmf_kwargs.get(
+                     "online_chunk_max_iter", 200),
+                 "alpha_W": nmf_kwargs.get("alpha_W", 0.0),
+                 "l1_ratio_W": nmf_kwargs.get("l1_ratio_W", 0.0),
+                 "alpha_H": nmf_kwargs.get("alpha_H", 0.0),
+                 "l1_ratio_H": nmf_kwargs.get("l1_ratio_H", 0.0),
+                 "mesh_shape": [int(r_dim), int(c_dim)],
+                 "num_processes": int(jax.process_count()),
+                 "ledger_keys_ignored": ["mode", "online_chunk_size"]})
+
+        by_k: dict[int, list] = {}
+        for idx in jobs:
+            p = run_params.iloc[idx, :]
+            by_k.setdefault(int(p["n_components"]), []).append(
+                (int(p["iter"]), int(p["nmf_seed"])))
+
+        for k, tasks in sorted(by_k.items()):
+            iters = [t[0] for t in tasks]
+            seeds = [t[1] for t in tasks]
+            spectra, _errs = replicate_sweep_2d(
+                Xd, seeds, k, mesh,
+                beta_loss=nmf_kwargs["beta_loss"],
+                init=nmf_kwargs.get("init", "random"),
+                tol=nmf_kwargs.get("tol", 1e-4),
+                n_passes=nmf_kwargs.get("n_passes", 20),
+                chunk_max_iter=nmf_kwargs.get("online_chunk_max_iter", 200),
+                alpha_W=nmf_kwargs.get("alpha_W", 0.0),
+                l1_ratio_W=nmf_kwargs.get("l1_ratio_W", 0.0),
+                alpha_H=nmf_kwargs.get("alpha_H", 0.0),
+                l1_ratio_H=nmf_kwargs.get("l1_ratio_H", 0.0))
+            if is_coordinator():
+                for r, it in enumerate(iters):
+                    df = pd.DataFrame(spectra[r],
+                                      index=np.arange(1, k + 1),
+                                      columns=norm_counts.var.index)
+                    save_df_to_npz(df, self.paths["iter_spectra"] % (k, it))
+        sync_hosts("factorize_2d")
 
     # ------------------------------------------------------------------
     # combine

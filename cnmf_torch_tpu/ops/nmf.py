@@ -507,15 +507,12 @@ def nndsvd_init(X, k: int, variant: str = "nndsvd", key=None):
     return _nndsvd_from_svd(U, S, Vt, k, variant, key, jnp.mean(X))
 
 
-def nndsvd_init_gram(X, k: int, variant: str = "nndsvdar", key=None):
-    """nndsvd init computed from the gram matrix — the sharding-friendly
-    form for row-sharded X: the only all-to-all object is the g x g gram
-    (one psum'd matmul), eigendecomposed replicated; U comes back as a
-    row-sharded matmul. ``jnp.linalg.svd`` of a sharded X would gather the
-    full matrix to one device, which is exactly what the atlas path exists
-    to avoid. Sign ambiguity of eigenvectors is harmless: nndsvd's
-    positive/negative splitting is invariant to a joint (u, v) sign flip.
-    """
+def gram_svd_base(X, k: int):
+    """The deterministic truncated-SVD base of the gram-form nndsvd init:
+    ``(U (n,k), S (k,), Vt (k,g))``. Split out so replicate sweeps can
+    compute it ONCE and vmap only the seeded fill over replicate keys
+    (``_nndsvd_from_svd``) instead of batching R identical g x g
+    eigendecompositions."""
     G = jnp.matmul(X.T, X, precision=_HI)
     evals, evecs = jnp.linalg.eigh(G)           # ascending
     S = jnp.sqrt(jnp.clip(evals[::-1][:k], 0.0))
@@ -530,7 +527,20 @@ def nndsvd_init_gram(X, k: int, variant: str = "nndsvdar", key=None):
     S = jnp.where(rank_ok, S, 0.0)
     U = jnp.where(rank_ok[None, :],
                   jnp.matmul(X, V, precision=_HI) / jnp.maximum(S, EPS), 0.0)
-    return _nndsvd_from_svd(U, S, V.T, k, variant, key, jnp.mean(X))
+    return U, S, V.T
+
+
+def nndsvd_init_gram(X, k: int, variant: str = "nndsvdar", key=None):
+    """nndsvd init computed from the gram matrix — the sharding-friendly
+    form for row-sharded X: the only all-to-all object is the g x g gram
+    (one psum'd matmul), eigendecomposed replicated; U comes back as a
+    row-sharded matmul. ``jnp.linalg.svd`` of a sharded X would gather the
+    full matrix to one device, which is exactly what the atlas path exists
+    to avoid. Sign ambiguity of eigenvectors is harmless: nndsvd's
+    positive/negative splitting is invariant to a joint (u, v) sign flip.
+    """
+    U, S, Vt = gram_svd_base(X, k)
+    return _nndsvd_from_svd(U, S, Vt, k, variant, key, jnp.mean(X))
 
 
 def _nndsvd_from_svd(U, S, Vt, k, variant, key, x_mean):

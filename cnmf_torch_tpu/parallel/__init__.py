@@ -1,3 +1,10 @@
+from .multihost import (
+    initialize_distributed,
+    is_coordinator,
+    mesh_2d,
+    replicate_sweep_2d,
+    sync_hosts,
+)
 from .replicates import (
     auto_replicates_per_batch,
     clear_sweep_cache,
@@ -11,7 +18,12 @@ __all__ = [
     "auto_replicates_per_batch",
     "clear_sweep_cache",
     "default_mesh",
+    "initialize_distributed",
+    "is_coordinator",
+    "mesh_2d",
     "replicate_sweep",
+    "replicate_sweep_2d",
+    "sync_hosts",
     "worker_filter",
     "fit_h_rowsharded",
     "nmf_fit_rowsharded",

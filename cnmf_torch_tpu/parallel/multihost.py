@@ -1,0 +1,308 @@
+"""Multi-host execution: ``jax.distributed`` + the 2-D replicates x cells mesh.
+
+The reference's multi-node story is GNU parallel / SGE array jobs — N
+independent OS processes sharing a filesystem, statically sharded by
+``worker_filter`` (``/root/reference/Extras/run_parallel.py:47-51``,
+``Stepwise_Guide.md:46-63``). A TPU pod is a different shape: ONE
+single-controller JAX program spans every host; the same Python script runs
+on each host, ``jax.distributed.initialize`` stitches their local chips into
+one global device set, and collectives ride ICI within a slice / DCN across
+slices (SURVEY.md §2.2, §2.4).
+
+This module provides that story:
+
+  * :func:`initialize_distributed` — env-driven, idempotent
+    ``jax.distributed.initialize``. On Cloud TPU pods the three coordinates
+    are auto-detected; elsewhere (CPU fleets, tests) they come from
+    ``CNMF_COORDINATOR_ADDRESS`` / ``CNMF_NUM_PROCESSES`` /
+    ``CNMF_PROCESS_ID``.
+  * :func:`mesh_2d` — the (replicates, cells) mesh. The replicate axis is
+    laid out ACROSS hosts (replicates never communicate, so the slow DCN
+    hop carries zero solver traffic); the cells axis stays WITHIN a host so
+    the per-pass psum of W sufficient statistics rides ICI.
+  * :func:`replicate_sweep_2d` — the full replicate sweep over that mesh:
+    every replicate row-shards its cells over the mesh's cell axis (the
+    row-sharded block-coordinate solver, identical semantics to
+    :func:`~cnmf_torch_tpu.parallel.rowshard.nmf_fit_rowsharded`), and the
+    replicate axis vmaps/shards over hosts — the reference's "900 worker
+    processes" as one XLA program spanning the pod.
+
+Host-side IO remains the coordinator's job: every process computes, process
+0 writes artifacts (the filesystem stays the durable checkpoint layer, as in
+the reference — SURVEY.md §1.1).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..ops.nmf import (
+    _nndsvd_from_svd,
+    beta_loss_to_float,
+    gram_svd_base,
+    random_init,
+    split_regularization,
+)
+from .rowshard import _rowsharded_solve_local, stream_rows_to_mesh
+
+__all__ = [
+    "initialize_distributed",
+    "is_coordinator",
+    "mesh_2d",
+    "replicate_sweep_2d",
+    "sync_hosts",
+]
+
+_ENV_COORD = "CNMF_COORDINATOR_ADDRESS"
+_ENV_NPROC = "CNMF_NUM_PROCESSES"
+_ENV_PID = "CNMF_PROCESS_ID"
+_initialized = False
+
+
+def initialize_distributed(coordinator_address: str | None = None,
+                           num_processes: int | None = None,
+                           process_id: int | None = None) -> tuple[int, int]:
+    """Idempotent ``jax.distributed.initialize`` and (process_id, count).
+
+    Coordinates resolve in order: explicit arguments, ``CNMF_*`` env vars,
+    JAX auto-detection (Cloud TPU pod metadata). With no explicit/env
+    coordinates and no multi-host platform, this is a no-op single-process
+    setup — safe to call unconditionally from the CLI.
+
+    Multi-host runs launch like a TPU pod job: the SAME command on every
+    host, differing only in ``CNMF_PROCESS_ID`` (see
+    ``docs/Stepwise_Guide.md``), not like the reference's per-worker task
+    sharding (its ``--worker-index`` maps to a *replicate* subset; a
+    process here is a *mesh* participant and runs every replicate's
+    program).
+    """
+    global _initialized
+    if _initialized or getattr(jax.distributed, "is_initialized", lambda: False)():
+        return jax.process_index(), jax.process_count()
+
+    coordinator_address = coordinator_address or os.environ.get(_ENV_COORD)
+    if num_processes is None and os.environ.get(_ENV_NPROC):
+        num_processes = int(os.environ[_ENV_NPROC])
+    if process_id is None and os.environ.get(_ENV_PID):
+        process_id = int(os.environ[_ENV_PID])
+
+    given = {"coordinator_address": coordinator_address,
+             "num_processes": num_processes, "process_id": process_id}
+    missing = [k for k, v in given.items() if v is None]
+    if len(missing) == 3:
+        # single-process (or TPU-pod auto-detect launched via `jax.distributed`
+        # -aware runtimes). Don't force initialize — and don't latch: a later
+        # call WITH coordinates must still be able to initialize.
+        return jax.process_index(), jax.process_count()
+    if missing:
+        # partial coordinates (e.g. a stale CNMF_COORDINATOR_ADDRESS left in
+        # the env) would make jax.distributed.initialize hang or misconfigure
+        # — fail loud instead
+        raise ValueError(
+            "distributed launch needs all three coordinates; missing "
+            f"{missing} (set the CNMF_COORDINATOR_ADDRESS / "
+            "CNMF_NUM_PROCESSES / CNMF_PROCESS_ID env vars together, or "
+            "unset them all for single-process runs)")
+
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+    return jax.process_index(), jax.process_count()
+
+
+def is_coordinator() -> bool:
+    """True on the process that owns host-side IO (artifact writes)."""
+    return jax.process_index() == 0
+
+
+def sync_hosts(name: str = "cnmf") -> None:
+    """Barrier across hosts (no-op single-process). Used around artifact
+    writes so non-coordinator hosts don't race ahead and read files the
+    coordinator hasn't written yet — the same write-then-read discipline the
+    reference gets from stage boundaries (SURVEY.md §5.2)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
+def _balanced_rc(n_dev: int, n_proc: int) -> tuple[int, int]:
+    """Factor the device count into (replicate_shards, cell_shards).
+
+    Multi-host: one replicate shard per host, cells within the host — the
+    cells-axis psum (the only per-pass collective) never crosses DCN.
+    Single host: the most-square factorization, biased so cells get the
+    larger factor (cell counts exceed replicate counts in every BASELINE
+    config)."""
+    if n_proc > 1 and n_dev % n_proc == 0:
+        return n_proc, n_dev // n_proc
+    r = 1
+    for cand in range(int(math.isqrt(n_dev)), 0, -1):
+        if n_dev % cand == 0:
+            r = cand
+            break
+    return r, n_dev // r
+
+
+def mesh_2d(replicate_shards: int | None = None,
+            devices=None) -> Mesh:
+    """The (replicates, cells) mesh over all global devices.
+
+    Device order: ``jax.devices()`` lists process 0's chips first, so
+    reshaping to (replicate_shards, cell_shards) with one replicate shard
+    per host puts each host's chips in one mesh row — the promoted layout
+    from the driver dryrun (``__graft_entry__.py``), now reachable from
+    ``factorize(mesh_shape='2d')``.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n_dev = len(devices)
+    if replicate_shards is None:
+        r, c = _balanced_rc(n_dev, jax.process_count())
+    else:
+        r = int(replicate_shards)
+        if n_dev % r:
+            raise ValueError(
+                f"replicate_shards={r} does not divide {n_dev} devices")
+        c = n_dev // r
+    return Mesh(np.asarray(devices).reshape(r, c), ("replicates", "cells"))
+
+
+@functools.lru_cache(maxsize=64)
+def _sweep2d_program(n: int, g: int, k: int, R: int, init: str, beta: float,
+                     tol: float, h_tol: float, n_passes: int,
+                     chunk_max_iter: int,
+                     l1_H: float, l2_H: float, l1_W: float, l2_W: float,
+                     mesh: Mesh):
+    """Compile (once per static config) the 2-D sweep
+    ``(X (n,g) cells-sharded, seeds (R,)) -> (spectra (R,k,g), errs (R,))``.
+
+    Inits are generated inside the program (vmapped seeded uniform, same
+    mapping as the row-sharded solver) under sharding constraints, so no
+    host materializes an (R, n, k) array.
+    """
+    rep_ax, cell_ax = mesh.axis_names
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(cell_ax, None),            # X: rows over cells, same on
+                                               # every replicate shard
+                  P(rep_ax, cell_ax, None),    # H0: (R, n, k)
+                  P(rep_ax, None, None)),      # W0: (R, k, g)
+        out_specs=(P(rep_ax, None, None), P(rep_ax)),
+    )
+    def run(X_blk, H_blk, W_blk):
+        def one(h, w):
+            h, w, err = _rowsharded_solve_local(
+                X_blk, h, w, cell_ax, beta, tol, h_tol, n_passes,
+                chunk_max_iter, l1_H, l2_H, l1_W, l2_W)
+            return w, err
+
+        # replicate axis: pure vmap, zero communication; cells axis: the
+        # psums inside _rowsharded_pass (ICI-local by mesh construction)
+        return jax.vmap(one)(H_blk, W_blk)
+
+    def sweep(X, seeds):
+        x_mean = jnp.mean(X)
+
+        if init == "random":
+            H0, W0 = jax.vmap(
+                lambda s: random_init(jax.random.key(s), n, g, k, x_mean)
+            )(seeds)
+        elif init in ("nndsvd", "nndsvda", "nndsvdar"):
+            # gram-based nndsvd (the sharding-friendly form — the only
+            # all-to-all object is the g x g gram); the deterministic base
+            # computes ONCE, only the seeded zero-fill vmaps over replicate
+            # keys (nndsvdar semantics, same mapping as the 1-D sweep)
+            variant = "nndsvdar" if init == "nndsvd" else init
+            U, S, Vt = gram_svd_base(X, k)
+            H0, W0 = jax.vmap(
+                lambda s: _nndsvd_from_svd(U, S, Vt, k, variant,
+                                           jax.random.key(s), x_mean)
+            )(seeds)
+        else:
+            raise ValueError(f"unknown init {init!r}")
+        H0 = jax.lax.with_sharding_constraint(
+            H0, NamedSharding(mesh, P(rep_ax, cell_ax, None)))
+        W0 = jax.lax.with_sharding_constraint(
+            W0, NamedSharding(mesh, P(rep_ax, None, None)))
+        return run(X, H0, W0)
+
+    return jax.jit(sweep)
+
+
+def replicate_sweep_2d(X, seeds, k: int, mesh: Mesh, beta_loss="frobenius",
+                       init: str = "random",
+                       tol: float = 1e-4, h_tol: float = 0.05,
+                       n_passes: int = 20, chunk_max_iter: int = 200,
+                       alpha_W: float = 0.0, l1_ratio_W: float = 0.0,
+                       alpha_H: float = 0.0, l1_ratio_H: float = 0.0,
+                       fetch: bool = True):
+    """Run ``len(seeds)`` NMF replicates over a 2-D (replicates, cells) mesh.
+
+    Each replicate is the row-sharded block-coordinate solve of
+    :func:`~cnmf_torch_tpu.parallel.rowshard.nmf_fit_rowsharded` (identical
+    init + pass loop, so per-seed results agree to collective-reduction
+    rounding); replicates are sharded over the replicate axis. This is the
+    layout for the regime where BOTH axes are big — atlas-scale X *and* a
+    wide sweep — and for multi-host pods, where the replicate axis spans
+    hosts (no cross-host solver traffic) and the cells-psum stays on ICI.
+
+    ``X``: host matrix (dense/CSR — streamed, never host-densified whole) or
+    a cells-sharded device array staged by :func:`stage_x_2d` (padded rows
+    are benign: only the returned W depends on them, and zero rows
+    contribute nothing to its psum'd statistics). Returns
+    ``(spectra (R,k,g), errs (R,))`` — numpy on every host with
+    ``fetch=True`` (multi-host: all-gathered across processes), else device
+    arrays.
+    """
+    beta = beta_loss_to_float(beta_loss)
+    if beta not in (2.0, 1.0, 0.0):
+        raise ValueError(
+            f"replicate_sweep_2d supports beta in {{2, 1, 0}}, got {beta}")
+    r_dim, c_dim = mesh.devices.shape
+    Xd = X if isinstance(X, jax.Array) else stage_x_2d(X, mesh)
+    n, g = int(Xd.shape[0]), int(Xd.shape[1])
+
+    seeds = [int(s) & 0x7FFFFFFF for s in seeds]
+    R = len(seeds)
+    if R == 0:
+        return np.zeros((0, int(k), g), np.float32), np.zeros((0,), np.float32)
+    pad_r = (-R) % r_dim
+    padded = seeds + [seeds[i % R] for i in range(pad_r)]
+
+    l1_W, l2_W = split_regularization(alpha_W, l1_ratio_W)
+    l1_H, l2_H = split_regularization(alpha_H, l1_ratio_H)
+
+    prog = _sweep2d_program(n, g, int(k), len(padded), str(init), beta,
+                            float(tol), float(h_tol), int(n_passes),
+                            int(chunk_max_iter),
+                            l1_H, l2_H, l1_W, l2_W, mesh)
+    spectra_d, errs_d = prog(Xd, jnp.asarray(padded, jnp.uint32))
+
+    if not fetch:
+        return spectra_d, errs_d
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        spectra = multihost_utils.process_allgather(spectra_d, tiled=True)
+        errs = multihost_utils.process_allgather(errs_d, tiled=True)
+    else:
+        spectra, errs = np.asarray(spectra_d), np.asarray(errs_d)
+    return spectra[:R], errs[:R]
+
+
+def stage_x_2d(X, mesh: Mesh, dtype=jnp.float32):
+    """Stage a host matrix for repeated 2-D sweeps: rows sharded over the
+    cells axis, replicated over the replicate axis; one shard-sized CSR
+    block densifies at a time (no whole-matrix host densify)."""
+    Xd, _pad = stream_rows_to_mesh(X, mesh, mesh.axis_names[1], dtype=dtype)
+    return Xd

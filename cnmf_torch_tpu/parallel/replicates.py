@@ -82,10 +82,14 @@ def auto_replicates_per_batch(n: int, g: int, k: int, beta: float = 2.0,
 
 def clear_sweep_cache() -> None:
     """Evict the per-(shape, config) compiled sweep executables (and the
-    mesh/device references they retain). Long-lived library use across many
+    mesh/device references they retain), for both the 1-D and the 2-D
+    (multihost) sweep programs. Long-lived library use across many
     datasets/meshes can otherwise accumulate unbounded compile-cache
     memory; CLI runs never need this."""
     _sweep_program.cache_clear()
+    from .multihost import _sweep2d_program
+
+    _sweep2d_program.cache_clear()
 
 
 def _stacked_inits(X, k: int, seeds, init: str):

@@ -64,11 +64,14 @@ def stream_rows_to_mesh(X, mesh: Mesh, axis: str, dtype=jnp.float32):
     host — this is the reference's 5,000-row streaming contract
     (``cnmf.py:350-381``) with the shard boundary as the streaming unit.
 
-    Returns ``(X_device, pad)`` where ``pad`` rows of zeros were appended to
-    make the cells axis divide the mesh.
+    Rows shard over the named ``axis`` of ``mesh`` (1-D cells mesh or the
+    2-D replicates x cells mesh — in the latter the array is replicated
+    over the other axis). Multi-host safe: every process supplies only its
+    addressable shards. Returns ``(X_device, pad)`` where ``pad`` rows of
+    zeros were appended to make the rows axis divide the mesh axis.
     """
-    n_dev = math.prod(mesh.devices.shape)
-    X, pad = pad_rows_to_mesh(X, n_dev)
+    n_shards = dict(mesh.shape)[axis]
+    X, pad = pad_rows_to_mesh(X, n_shards)
     if sp.issparse(X):
         X = X.tocsr()
     sharding = NamedSharding(mesh, P(axis, None))
@@ -123,6 +126,35 @@ def _rowsharded_pass(X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
     return H_local, W, err
 
 
+def _rowsharded_solve_local(X_local, H_local, W, axis, beta, tol, h_tol,
+                            n_passes, chunk_max_iter, l1_H, l2_H, l1_W, l2_W):
+    """Per-device block-coordinate solve loop (runs inside ``shard_map``):
+    passes of :func:`_rowsharded_pass` until the psum'd objective's relative
+    improvement drops below ``tol`` or ``n_passes`` is reached. Shared by the
+    1-D cells mesh (:func:`_fit_rowsharded_jit`) and the 2-D
+    replicates x cells sweep (``multihost.replicate_sweep_2d``), so both
+    paths have identical solver semantics."""
+    def body(carry):
+        H_local, W, err_prev, err, it = carry
+        H_local, W, err_new = _rowsharded_pass(
+            X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
+            l1_H, l2_H, l1_W, l2_W)
+        return (H_local, W, err, err_new, it + 1)
+
+    def cond(carry):
+        _, _, err_prev, err, it = carry
+        rel = (err_prev - err) / jnp.maximum(err_prev, EPS)
+        return (it < n_passes) & ((it < 2) | (rel >= tol))
+
+    H_local, W, err0 = _rowsharded_pass(
+        X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
+        l1_H, l2_H, l1_W, l2_W)
+    H_local, W, _, err, _ = jax.lax.while_loop(
+        cond, body,
+        (H_local, W, err0 * (1.0 + 2.0 * tol) + 1.0, err0, jnp.int32(1)))
+    return H_local, W, err
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "axis", "beta", "n_passes", "chunk_max_iter",
@@ -136,24 +168,9 @@ def _fit_rowsharded_jit(X, H0, W0, mesh, axis, beta, tol, h_tol, n_passes,
         out_specs=(P(axis, None), P(), P()),
     )
     def run(X_local, H_local, W):
-        def body(carry):
-            H_local, W, err_prev, err, it = carry
-            H_local, W, err_new = _rowsharded_pass(
-                X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
-                l1_H, l2_H, l1_W, l2_W)
-            return (H_local, W, err, err_new, it + 1)
-
-        def cond(carry):
-            _, _, err_prev, err, it = carry
-            rel = (err_prev - err) / jnp.maximum(err_prev, EPS)
-            return (it < n_passes) & ((it < 2) | (rel >= tol))
-
-        H_local, W, err0 = _rowsharded_pass(
-            X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
-            l1_H, l2_H, l1_W, l2_W)
-        H_local, W, _, err, _ = jax.lax.while_loop(
-            cond, body,
-            (H_local, W, err0 * (1.0 + 2.0 * tol) + 1.0, err0, jnp.int32(1)))
+        H_local, W, err = _rowsharded_solve_local(
+            X_local, H_local, W, axis, beta, tol, h_tol, n_passes,
+            chunk_max_iter, l1_H, l2_H, l1_W, l2_W)
         return H_local, W, err[None]
 
     H, W, err = run(X, H0, W0)

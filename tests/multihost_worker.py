@@ -1,0 +1,55 @@
+"""Subprocess body for the multi-host tests: one simulated pod host.
+
+Launched N times by tests/test_multihost.py with CNMF_* coordinates in the
+environment. Each process contributes 4 virtual CPU devices, joins the
+distributed program, runs a 2-D replicate sweep on a deterministic fixture,
+and the coordinator writes the gathered results for the parent to compare
+against a single-process run.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", int(os.environ.get(
+    "CNMF_SIM_CPU_DEVICES", "4")))
+
+import numpy as np  # noqa: E402
+
+
+def main(out_path: str) -> None:
+    from cnmf_torch_tpu.parallel import (
+        initialize_distributed,
+        is_coordinator,
+        mesh_2d,
+        replicate_sweep_2d,
+        sync_hosts,
+    )
+
+    pid, nproc = initialize_distributed()
+    assert nproc == int(os.environ["CNMF_NUM_PROCESSES"]), nproc
+
+    mesh = mesh_2d()
+    assert mesh.axis_names == ("replicates", "cells")
+    # one replicate shard per host: the cells psum never crosses processes
+    assert mesh.devices.shape[0] == nproc
+
+    rng = np.random.default_rng(123)
+    X = (rng.gamma(0.8, 1.0, size=(64, 24)) *
+         rng.binomial(1, 0.4, size=(64, 24))).astype(np.float32)
+    spectra, errs = replicate_sweep_2d(
+        X, seeds=[11, 22, 33, 44], k=3, mesh=mesh, beta_loss="frobenius",
+        tol=1e-5, n_passes=30)
+
+    if is_coordinator():
+        np.savez(out_path, spectra=spectra, errs=errs,
+                 mesh_shape=np.asarray(mesh.devices.shape))
+    sync_hosts("test_done")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

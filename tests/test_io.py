@@ -120,7 +120,10 @@ def test_load_counts_10x_mtx(tmp_path, rng):
 
 def test_paths_registry(tmp_path):
     paths = build_paths(str(tmp_path), "run1")
-    assert len(paths) == 24  # every key of the reference registry (cnmf.py:423-455)
+    # every key of the reference registry (cnmf.py:423-455) plus
+    # factorize_provenance (our addition: records the engaged solver path)
+    assert len(paths) == 25
+    assert "factorize_provenance" in paths
     assert paths["iter_spectra"] % (7, 3) == str(
         tmp_path / "run1" / "cnmf_tmp" / "run1.spectra.k_7.iter_3.df.npz"
     )

@@ -1,0 +1,254 @@
+"""Multi-host story: 2-D (replicates x cells) mesh, jax.distributed across
+processes, and the run_parallel launcher (the reference's
+``Extras/run_parallel.py:1-70`` orchestration contract).
+
+The in-process tests run on the conftest 8-device virtual CPU mesh; the
+process-level tests spawn real OS processes that form a 2-process x
+4-device distributed program (a simulated 2-host pod), which is how the
+multi-host path is CI-tested without TPU-pod hardware (SURVEY.md §4).
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from cnmf_torch_tpu.parallel import mesh_2d, replicate_sweep_2d
+from cnmf_torch_tpu.parallel.multihost import _balanced_rc
+from cnmf_torch_tpu.parallel.rowshard import nmf_fit_rowsharded
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fixture_X(n=64, g=24, seed=123):
+    rng = np.random.default_rng(seed)
+    return (rng.gamma(0.8, 1.0, size=(n, g)) *
+            rng.binomial(1, 0.4, size=(n, g))).astype(np.float32)
+
+
+def test_balanced_rc():
+    assert _balanced_rc(8, 1) == (2, 4)      # square-ish, cells larger
+    assert _balanced_rc(8, 2) == (2, 4)      # one replicate shard per host
+    assert _balanced_rc(16, 4) == (4, 4)
+    assert _balanced_rc(7, 1) == (1, 7)      # prime: all cells
+    assert _balanced_rc(8, 3) == (2, 4)      # non-dividing host count
+
+
+def test_initialize_distributed_guards(monkeypatch):
+    """No-op single-process path must not latch (a later call with real
+    coordinates still initializes), and partial coordinates — e.g. a stale
+    CNMF_COORDINATOR_ADDRESS in the env — fail loud instead of hanging in
+    jax.distributed.initialize."""
+    from cnmf_torch_tpu.parallel import initialize_distributed
+    from cnmf_torch_tpu.parallel import multihost
+
+    for var in ("CNMF_COORDINATOR_ADDRESS", "CNMF_NUM_PROCESSES",
+                "CNMF_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setattr(multihost, "_initialized", False)
+    pid, nproc = initialize_distributed()
+    assert (pid, nproc) == (0, 1)
+    assert multihost._initialized is False  # no latch on the no-op path
+
+    monkeypatch.setenv("CNMF_COORDINATOR_ADDRESS", "127.0.0.1:1")
+    with pytest.raises(ValueError, match="all three"):
+        initialize_distributed()
+
+
+def test_mesh_2d_axes():
+    mesh = mesh_2d()
+    assert mesh.axis_names == ("replicates", "cells")
+    assert int(np.prod(mesh.devices.shape)) == len(jax.devices())
+    with pytest.raises(ValueError):
+        mesh_2d(replicate_shards=3)  # does not divide 8
+
+
+@pytest.mark.parametrize("beta_loss", ["frobenius", "kullback-leibler"])
+def test_sweep2d_matches_rowsharded_per_seed(beta_loss):
+    """Each 2-D replicate must solve the same program as the 1-D row-sharded
+    solver: same seeded init, same pass loop, same cells-shard boundaries
+    (4 shards both ways) -> near-identical spectra."""
+    X = _fixture_X()
+    mesh2 = mesh_2d(replicate_shards=2)          # (2, 4)
+    seeds = [11, 22, 33]
+    spectra, errs = replicate_sweep_2d(
+        X, seeds, k=3, mesh=mesh2, beta_loss=beta_loss, tol=1e-5,
+        n_passes=30)
+    assert spectra.shape == (3, 3, 24) and errs.shape == (3,)
+
+    flat4 = Mesh(np.asarray(jax.devices()[:4]), ("cells",))
+    for r, s in enumerate(seeds):
+        _H, W_ref, err_ref = nmf_fit_rowsharded(
+            X, 3, flat4, beta_loss=beta_loss, seed=s, tol=1e-5, n_passes=30)
+        np.testing.assert_allclose(spectra[r], W_ref, rtol=2e-3, atol=2e-4)
+        assert abs(errs[r] - err_ref) / max(err_ref, 1e-9) < 1e-3
+
+
+def test_sweep2d_replicate_padding():
+    """R not divisible by the replicate axis: pad replicates recompute
+    existing seeds and are dropped from the result."""
+    X = _fixture_X()
+    mesh2 = mesh_2d(replicate_shards=2)
+    spectra, errs = replicate_sweep_2d(X, [7, 8, 9], k=2, mesh=mesh2,
+                                       n_passes=10)
+    assert spectra.shape == (3, 2, 24)
+    spectra2, _ = replicate_sweep_2d(X, [7], k=2, mesh=mesh2, n_passes=10)
+    np.testing.assert_allclose(spectra[0], spectra2[0], rtol=1e-5)
+
+
+def test_sweep2d_nndsvd_init():
+    X = _fixture_X()
+    mesh2 = mesh_2d(replicate_shards=2)
+    spectra, errs = replicate_sweep_2d(X, [5, 6], k=3, mesh=mesh2,
+                                       init="nndsvd", n_passes=10)
+    assert np.isfinite(errs).all() and (spectra >= 0).all()
+    # seeded nndsvdar fill: replicates must differ (consensus non-vacuous)
+    assert np.abs(spectra[0] - spectra[1]).max() > 1e-6
+
+
+def test_factorize_mesh2d_pipeline(tmp_path):
+    """factorize(mesh='2d') produces the standard artifact contract and
+    consensus runs downstream — the dryrun layout is now reachable from the
+    pipeline (VERDICT r2 gap #1)."""
+    import pandas as pd
+    import scipy.sparse as sp
+
+    from cnmf_torch_tpu.models.cnmf import cNMF
+    from cnmf_torch_tpu.utils.io import load_df_from_npz
+
+    rng = np.random.default_rng(0)
+    counts = sp.csr_matrix(rng.binomial(40, 0.02, size=(80, 120)).astype(
+        np.float64))
+    counts_fn = str(tmp_path / "counts.df.npz")
+    df = pd.DataFrame(counts.toarray(),
+                      index=[f"c{i}" for i in range(80)],
+                      columns=[f"g{j}" for j in range(120)])
+    from cnmf_torch_tpu.utils.io import save_df_to_npz
+
+    save_df_to_npz(df, counts_fn)
+
+    obj = cNMF(output_dir=str(tmp_path), name="m2d")
+    obj.prepare(counts_fn, components=[3], n_iter=4, seed=9,
+                num_highvar_genes=60, total_workers=1)
+    obj.factorize(mesh="2d")
+    for it in range(4):
+        assert os.path.exists(obj.paths["iter_spectra"] % (3, it))
+    obj.combine()
+    merged = load_df_from_npz(obj.paths["merged_spectra"] % 3)
+    assert merged.shape[0] == 12  # 4 iters x k=3
+    obj.consensus(3, density_threshold=2.0, show_clustering=False,
+                  build_ref=False)
+    assert os.path.exists(obj.paths["consensus_spectra"] % (3, "2_0"))
+    # provenance records the engaged 2-D path
+    import yaml
+
+    prov = yaml.safe_load(open(obj.paths["factorize_provenance"] % 0))
+    assert prov["engaged_path"] == "mesh2d"
+    assert prov["effective_params"]["mesh_shape"] == [2, 4]
+
+
+# ---------------------------------------------------------------------------
+# process-level: a real jax.distributed program across 2 OS processes
+# ---------------------------------------------------------------------------
+
+
+def _spawn(cmd, env):
+    return subprocess.Popen(cmd, env=env, cwd=REPO,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT)
+
+
+def _wait_all(procs, timeout=600):
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=timeout)
+        outs.append(out.decode(errors="replace"))
+    return outs
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_sweep(tmp_path):
+    """2 processes x 4 virtual devices stitch into one 8-device program via
+    jax.distributed; the 2-D sweep's results match a single-process run of
+    the same mesh shape bit-for-tolerance. Proves: cross-process init,
+    global mesh construction, cells-psum collectives, process_allgather
+    fetch, coordinator-only IO."""
+    out = str(tmp_path / "dist_result.npz")
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ,
+                   CNMF_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                   CNMF_NUM_PROCESSES="2", CNMF_PROCESS_ID=str(pid),
+                   CNMF_SIM_CPU_DEVICES="4")
+        procs.append(_spawn(
+            [sys.executable, os.path.join("tests", "multihost_worker.py"),
+             out], env))
+    outs = _wait_all(procs)
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, o
+    assert os.path.exists(out), outs[0]
+
+    got = np.load(out)
+    assert tuple(got["mesh_shape"]) == (2, 4)
+
+    # single-process reference on the same (2, 4) mesh shape
+    X = _fixture_X()
+    mesh2 = mesh_2d(replicate_shards=2)
+    spectra, errs = replicate_sweep_2d(
+        X, seeds=[11, 22, 33, 44], k=3, mesh=mesh2, beta_loss="frobenius",
+        tol=1e-5, n_passes=30)
+    np.testing.assert_allclose(got["spectra"], spectra, rtol=2e-3,
+                               atol=2e-4)
+    np.testing.assert_allclose(got["errs"], errs, rtol=1e-3)
+
+
+@pytest.mark.parametrize("engine,workers,extra", [
+    ("subprocess", 2, []),
+    ("multihost", 2, ["--devices-per-host", "2"]),
+])
+def test_run_parallel_launcher(tmp_path, engine, workers, extra):
+    """The reference orchestration contract (run_parallel.py:1-70): one
+    command does prepare -> parallel factorize -> combine ->
+    k_selection_plot, with per-replicate files cleaned after merge."""
+    import pandas as pd
+
+    rng = np.random.default_rng(1)
+    df = pd.DataFrame(rng.binomial(40, 0.02, size=(60, 100)).astype(float),
+                      index=[f"c{i}" for i in range(60)],
+                      columns=[f"g{j}" for j in range(100)])
+    from cnmf_torch_tpu.utils.io import save_df_to_npz
+
+    counts_fn = str(tmp_path / "counts.df.npz")
+    save_df_to_npz(df, counts_fn)
+
+    env = dict(os.environ, CNMF_SIM_CPU_DEVICES="2")
+    cmd = [sys.executable, "-m", "cnmf_torch_tpu", "run_parallel",
+           "--output-dir", str(tmp_path), "--name", "launch",
+           "--counts", counts_fn, "-k", "3", "4", "--n-iter", "3",
+           "--total-workers", str(workers), "--seed", "4",
+           "--numgenes", "50", "--engine", engine, "--clean"] + extra
+    p = _spawn(cmd, env)
+    (out,) = _wait_all([p])
+    assert p.returncode == 0, out
+
+    base = tmp_path / "launch"
+    assert (base / "launch.k_selection.png").exists(), out
+    for k in (3, 4):
+        assert (base / "cnmf_tmp" / f"launch.spectra.k_{k}.merged.df.npz"
+                ).exists(), out
+    # --clean removed the per-replicate files after merge
+    import glob
+
+    assert not glob.glob(str(base / "cnmf_tmp" / "*.iter_*.df.npz"))
