@@ -179,6 +179,22 @@ def main(argv=None):
     if args.command == "run_parallel":
         from .launcher import run_pipeline
 
+        # forward the factorize-mode flags to every spawned worker (they
+        # share this parser, so accepting-but-dropping them would silently
+        # run a different execution path than the operator asked for)
+        factorize_flags = []
+        if args.mesh_2d:
+            factorize_flags.append("--mesh-2d")
+        if args.sequential:
+            factorize_flags.append("--sequential")
+        if args.rowshard is not None:
+            factorize_flags.append(
+                "--rowshard" if args.rowshard else "--no-rowshard")
+        factorize_flags += ["--rowshard-threshold",
+                            str(args.rowshard_threshold)]
+        if args.skip_completed_runs:
+            factorize_flags.append("--skip-completed-runs")
+
         run_pipeline(
             args.counts, args.output_dir, args.name,
             components=args.components, n_iter=args.n_iter,
@@ -187,14 +203,14 @@ def main(argv=None):
             tpm=args.tpm, beta_loss=args.beta_loss, init=args.init,
             max_nmf_iter=args.max_nmf_iter, batch_size=args.batch_size,
             engine=args.engine, devices_per_host=args.devices_per_host,
-            clean=args.clean)
+            clean=args.clean, factorize_flags=factorize_flags)
         return
 
     if args.command == "factorize" and (
             args.distributed or os.environ.get("CNMF_COORDINATOR_ADDRESS")):
         from .parallel import initialize_distributed
 
-        pid, nproc = initialize_distributed()
+        pid, nproc = initialize_distributed(auto=args.distributed)
         print(f"jax.distributed: process {pid}/{nproc}")
 
     from .models.cnmf import cNMF

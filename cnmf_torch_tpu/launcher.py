@@ -54,7 +54,8 @@ def run_pipeline(counts: str, output_dir: str, name: str,
                  engine: str = "subprocess",
                  devices_per_host: int | None = None,
                  clean: bool = False, k_selection: bool = True,
-                 env_extra: dict | None = None) -> None:
+                 env_extra: dict | None = None,
+                 factorize_flags: list[str] | None = None) -> None:
     """prepare -> parallel factorize -> combine -> k_selection_plot.
 
     ``engine='subprocess'``: ``total_workers`` OS processes shard the ledger
@@ -62,7 +63,13 @@ def run_pipeline(counts: str, output_dir: str, name: str,
     ``total_workers`` JAX processes form one distributed program over a 2-D
     mesh; ``devices_per_host`` forces that many virtual CPU devices per
     process (pod simulation — omit on real multi-chip hosts).
+
+    ``factorize_flags``: extra CLI flags forwarded verbatim to every
+    factorize worker (e.g. ``["--mesh-2d"]``, ``["--rowshard"]``,
+    ``["--sequential"]``) — how the run_parallel subcommand's
+    factorize-mode options reach the workers.
     """
+    factorize_flags = list(factorize_flags or [])
     from .models.cnmf import cNMF
 
     obj = cNMF(output_dir=output_dir, name=name)
@@ -88,16 +95,25 @@ def run_pipeline(counts: str, output_dir: str, name: str,
         for i in range(total_workers):
             cmd = _worker_cmd(output_dir, name,
                               ["--worker-index", str(i),
-                               "--total-workers", str(total_workers)])
+                               "--total-workers", str(total_workers)]
+                              + factorize_flags)
             procs.append((i, subprocess.Popen(cmd, env=base_env)))
+        n_failed = 0
         for i, p in procs:
             if p.wait() != 0:
                 any_failed = True
+                n_failed += 1
                 warnings.warn(
                     "factorize worker %d exited with rc=%d; its replicates "
                     "will be skipped at combine (the reference's dead-worker "
                     "tolerance, cnmf.py:904-909)" % (i, p.returncode),
                     RuntimeWarning)
+        if n_failed == total_workers:
+            # nothing survived — combine/k_selection would only crash on
+            # missing files with a misleading traceback
+            raise RuntimeError(
+                f"all {total_workers} factorize workers failed; see their "
+                "output above")
     elif engine == "multihost":
         port = _free_port()
         procs = []
@@ -108,8 +124,9 @@ def run_pipeline(counts: str, output_dir: str, name: str,
                        CNMF_PROCESS_ID=str(pid))
             if devices_per_host:
                 env["CNMF_SIM_CPU_DEVICES"] = str(devices_per_host)
-            cmd = _worker_cmd(output_dir, name,
-                              ["--mesh-2d", "--distributed"])
+            extra = ["--mesh-2d", "--distributed"] + [
+                f for f in factorize_flags if f != "--mesh-2d"]
+            cmd = _worker_cmd(output_dir, name, extra)
             procs.append((pid, subprocess.Popen(cmd, env=env)))
         rcs = [(pid, p.wait()) for pid, p in procs]
         bad = [(pid, rc) for pid, rc in rcs if rc]

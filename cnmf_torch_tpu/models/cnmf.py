@@ -765,6 +765,22 @@ class cNMF:
                 raise RuntimeError(
                     "Zero components remain after density filtering. "
                     "Consider increasing density threshold")
+            if l2_spectra.shape[0] < k:
+                # fewer surviving replicates than clusters: k-means can only
+                # form l2_spectra.shape[0] distinct programs, so the output
+                # silently has < k GEPs. (The reference crashes inside
+                # sklearn here; warn-and-degrade keeps the two-pass
+                # threshold-tuning workflow usable.)
+                import warnings
+
+                warnings.warn(
+                    "density_threshold=%s keeps only %d of %d replicate "
+                    "spectra — fewer than k=%d, so consensus will produce "
+                    "only %d programs. Raise the threshold (run once with "
+                    "2.0 and read the clustergram histogram)."
+                    % (density_threshold, l2_spectra.shape[0],
+                       len(density_filter), k, l2_spectra.shape[0]),
+                    UserWarning, stacklevel=2)
 
         labels0, _centers, _inertia = kmeans(l2_spectra.values, k,
                                              n_init=10, seed=1)

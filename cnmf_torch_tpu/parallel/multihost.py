@@ -69,13 +69,16 @@ _initialized = False
 
 def initialize_distributed(coordinator_address: str | None = None,
                            num_processes: int | None = None,
-                           process_id: int | None = None) -> tuple[int, int]:
+                           process_id: int | None = None,
+                           auto: bool = False) -> tuple[int, int]:
     """Idempotent ``jax.distributed.initialize`` and (process_id, count).
 
     Coordinates resolve in order: explicit arguments, ``CNMF_*`` env vars,
-    JAX auto-detection (Cloud TPU pod metadata). With no explicit/env
-    coordinates and no multi-host platform, this is a no-op single-process
-    setup — safe to call unconditionally from the CLI.
+    then — only with ``auto=True`` (the CLI's explicit ``--distributed``
+    flag) — JAX's own auto-detection (Cloud TPU pod metadata), which fails
+    loud rather than silently running single-process when detection isn't
+    possible. With no coordinates and ``auto=False`` this is a no-op
+    single-process setup — safe to call unconditionally.
 
     Multi-host runs launch like a TPU pod job: the SAME command on every
     host, differing only in ``CNMF_PROCESS_ID`` (see
@@ -98,9 +101,25 @@ def initialize_distributed(coordinator_address: str | None = None,
              "num_processes": num_processes, "process_id": process_id}
     missing = [k for k, v in given.items() if v is None]
     if len(missing) == 3:
-        # single-process (or TPU-pod auto-detect launched via `jax.distributed`
-        # -aware runtimes). Don't force initialize — and don't latch: a later
-        # call WITH coordinates must still be able to initialize.
+        if auto:
+            # the caller explicitly asked for distributed execution: let JAX
+            # auto-detect the pod coordinates (Cloud TPU metadata). A silent
+            # single-process fallback here would have every pod host run the
+            # full program independently and race on artifact writes.
+            try:
+                jax.distributed.initialize()
+            except Exception as exc:
+                raise RuntimeError(
+                    "distributed initialization was requested "
+                    "(--distributed) but JAX could not auto-detect the "
+                    "cluster and no CNMF_COORDINATOR_ADDRESS / "
+                    "CNMF_NUM_PROCESSES / CNMF_PROCESS_ID are set"
+                ) from exc
+            _initialized = True
+            return jax.process_index(), jax.process_count()
+        # plain single-process call. Don't force initialize — and don't
+        # latch: a later call WITH coordinates must still be able to
+        # initialize.
         return jax.process_index(), jax.process_count()
     if missing:
         # partial coordinates (e.g. a stale CNMF_COORDINATOR_ADDRESS left in

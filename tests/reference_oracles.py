@@ -168,3 +168,70 @@ def reorder_oracle(rf_usages: pd.DataFrame, median_spectra: pd.DataFrame):
     norm.columns = new_cols
     median_spectra.index = new_cols
     return rf_usages, norm, median_spectra
+
+
+def moe_correct_ridge_oracle(Z_orig, R, Phi_moe, lamb):
+    """Mixture-of-experts ridge correction, spec of the reference's
+    `moe_correct_ridge` (preprocess.py:9-18, itself harmonypy's
+    moe_correct_ridge): per cluster i, Phi_Rk = Phi_moe * R[i], W =
+    inv(Phi_Rk Phi_moe^T + lamb) Phi_Rk Z_orig^T with the intercept row
+    zeroed, Z_corr -= W^T Phi_Rk. Float64 throughout; `lamb` is the full
+    (B+1) x (B+1) matrix as harmonypy's result object carries it."""
+    Z_orig = np.asarray(Z_orig, dtype=np.float64)
+    R = np.asarray(R, dtype=np.float64)
+    Phi_moe = np.asarray(Phi_moe, dtype=np.float64)
+    lamb = np.asarray(lamb, dtype=np.float64)
+    if lamb.ndim == 1:
+        lamb = np.diag(lamb)
+    Z_corr = Z_orig.copy()
+    for i in range(R.shape[0]):
+        Phi_Rk = Phi_moe * R[i, :]
+        x = Phi_Rk @ Phi_moe.T + lamb
+        W = np.linalg.inv(x) @ Phi_Rk @ Z_orig.T
+        W[0, :] = 0.0
+        Z_corr -= W.T @ Phi_Rk
+    return Z_corr
+
+
+def harmony_cluster_round_oracle(Z_cos, R, phi, Pr_b, sigma, theta, blocks):
+    """One Harmony clustering round, spec of harmonypy's `_clustering`
+    (the package the reference calls at preprocess.py:373-378; update
+    equations from Korsunsky et al. 2019 and harmonypy's implementation):
+
+      1. centroid refresh Y = colnorm(Z_cos R^T), dist = 2(1 - Y^T Z_cos)
+      2. per cell block: remove the block from the (K x B) counts E
+         (expected) and O (observed); R_blk = exp(-dist/sigma) *
+         [((E+1)/(O+1))^theta  phi_blk]  (theta exponentiates per batch
+         COLUMN; the penalty projects onto each cell's active levels by a
+         dot product); L1-normalize columns; add the block back to E/O.
+
+    Float64 numpy, independent of the JAX kernels. Returns (R, E, O, Y,
+    objective) with the objective from harmonypy's `compute_objective`:
+    sum(R*dist) + sigma*sum(R log R) + sigma*theta*sum(O log((O+1)/(E+1))).
+    """
+    Z_cos = np.asarray(Z_cos, dtype=np.float64)
+    R = np.asarray(R, dtype=np.float64).copy()
+    phi = np.asarray(phi, dtype=np.float64)
+    Pr_b = np.asarray(Pr_b, dtype=np.float64)
+    sigma = np.asarray(sigma, dtype=np.float64)
+    theta = np.asarray(theta, dtype=np.float64)
+
+    Y = Z_cos @ R.T
+    Y = Y / np.linalg.norm(Y, ord=2, axis=0)
+    dist = 2.0 * (1.0 - Y.T @ Z_cos)
+    E = np.outer(R.sum(axis=1), Pr_b)
+    O = R @ phi.T
+    for blk in blocks:
+        E -= np.outer(R[:, blk].sum(axis=1), Pr_b)
+        O -= R[:, blk] @ phi[:, blk].T
+        Rb = np.exp(-dist[:, blk] / sigma[:, None])
+        Rb = Rb * (np.power((E + 1.0) / (O + 1.0), theta) @ phi[:, blk])
+        Rb = Rb / np.linalg.norm(Rb, ord=1, axis=0)
+        R[:, blk] = Rb
+        E += np.outer(Rb.sum(axis=1), Pr_b)
+        O += Rb @ phi[:, blk].T
+    kmeans_err = float(np.sum(R * dist))
+    entropy = float(np.sum(R * np.log(np.maximum(R, 1e-12)) * sigma[:, None]))
+    diversity = float(np.sum(
+        sigma[:, None] * theta * O * np.log((O + 1.0) / (E + 1.0))))
+    return R, E, O, Y, kmeans_err + entropy + diversity

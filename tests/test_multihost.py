@@ -170,12 +170,7 @@ def _wait_all(procs, timeout=600):
     return outs
 
 
-def _free_port():
-    import socket
-
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from cnmf_torch_tpu.launcher import _free_port  # noqa: E402  (shared helper)
 
 
 def test_two_process_distributed_sweep(tmp_path):
@@ -216,6 +211,7 @@ def test_two_process_distributed_sweep(tmp_path):
 
 @pytest.mark.parametrize("engine,workers,extra", [
     ("subprocess", 2, []),
+    ("subprocess", 1, ["--mesh-2d"]),   # factorize-mode flag forwarding
     ("multihost", 2, ["--devices-per-host", "2"]),
 ])
 def test_run_parallel_launcher(tmp_path, engine, workers, extra):
@@ -252,3 +248,13 @@ def test_run_parallel_launcher(tmp_path, engine, workers, extra):
     import glob
 
     assert not glob.glob(str(base / "cnmf_tmp" / "*.iter_*.df.npz"))
+
+    # the workers' provenance must reflect the forwarded execution mode
+    import yaml
+
+    prov = yaml.safe_load(
+        open(base / "cnmf_tmp" / "launch.factorize_provenance.w0.yaml"))
+    if "--mesh-2d" in extra or engine == "multihost":
+        assert prov["engaged_path"] == "mesh2d", out
+    else:
+        assert prov["engaged_path"] == "batched", out

@@ -195,6 +195,13 @@ def test_consensus_density_filter_and_cache(e2e_run):
     with pytest.raises(RuntimeError, match="Zero components remain"):
         obj.consensus(4, density_threshold=float(dens.values.min()) / 2,
                       show_clustering=False, build_ref=False)
+    # a threshold keeping >=1 but < k spectra silently collapses the program
+    # count (the reference crashes in sklearn instead) -> warn the operator
+    thin = float(np.sort(dens.values.ravel())[1]) + 1e-6
+    if (dens.values < thin).sum() < 4:
+        with pytest.warns(UserWarning, match="fewer than k"):
+            obj.consensus(4, density_threshold=thin,
+                          show_clustering=False, build_ref=False)
 
 
 def test_k_selection_plot(e2e_run):

@@ -333,3 +333,85 @@ def test_select_features_mi(rng):
                                 makeplots=False)
     top = set(out.var.index[out.var["highly_variable"]])
     assert len(top & {f"g{j}" for j in range(5)}) >= 4
+
+
+def test_moe_ridge_matches_harmonypy_oracle(rng):
+    """Numeric parity of the MOE ridge against a float64 re-derivation of
+    the reference's moe_correct_ridge (preprocess.py:9-18 == harmonypy's):
+    RMS agreement on a random fixture (VERDICT r2 weak #6 — behavioral
+    tests alone would pass a wrong-but-plausible port)."""
+    from cnmf_torch_tpu.ops.harmony import moe_correct_ridge
+    from tests.reference_oracles import moe_correct_ridge_oracle
+
+    d, n, K, B = 7, 90, 4, 3
+    Z = rng.normal(size=(d, n)).astype(np.float32)
+    R = rng.random(size=(K, n)).astype(np.float32)
+    R /= R.sum(axis=0, keepdims=True)
+    batches = rng.integers(0, B, size=n)
+    phi = np.zeros((B, n), np.float32)
+    phi[batches, np.arange(n)] = 1.0
+    Phi_moe = np.concatenate([np.ones((1, n), np.float32), phi], axis=0)
+    lamb = np.diag(np.concatenate([[0.0], np.full(B, 1.0)])).astype(
+        np.float32)
+
+    ours = moe_correct_ridge(Z, R, Phi_moe, lamb)
+    want = moe_correct_ridge_oracle(Z, R, Phi_moe, lamb)
+    rms = np.sqrt(np.mean((ours - want) ** 2))
+    assert rms < 1e-4, rms
+
+
+def test_harmony_cluster_round_matches_harmonypy_oracle(rng):
+    """One full clustering round (centroid refresh + blockwise
+    diversity-penalty R updates) agrees with the independent float64
+    harmonypy-spec oracle when driven with the same block order — including
+    the multi-variable case, where the penalty must SUM over batch
+    variables (dot with phi), not multiply."""
+    import jax.numpy as jnp
+
+    from cnmf_torch_tpu.ops.harmony import (
+        _block_R_update,
+        _clustering_objective,
+        _normalize_cols,
+    )
+    from tests.reference_oracles import harmony_cluster_round_oracle
+
+    d, n, K = 6, 120, 5
+    # two batch variables -> 2 + 3 = 5 one-hot rows
+    b1 = rng.integers(0, 2, size=n)
+    b2 = rng.integers(0, 3, size=n)
+    phi = np.zeros((5, n), np.float32)
+    phi[b1, np.arange(n)] = 1.0
+    phi[2 + b2, np.arange(n)] = 1.0
+
+    Z_cos = rng.normal(size=(d, n)).astype(np.float32)
+    Z_cos /= np.linalg.norm(Z_cos, axis=0, keepdims=True)
+    R0 = rng.random(size=(K, n)).astype(np.float32)
+    R0 /= R0.sum(axis=0, keepdims=True)
+    Pr_b = phi.sum(axis=1) / n
+    sigma = np.full(K, 0.1, np.float32)
+    theta = np.full(5, 2.0, np.float32)
+    blocks = np.array_split(rng.permutation(n), 4)
+
+    R_want, E_want, O_want, Y_want, obj_want = harmony_cluster_round_oracle(
+        Z_cos, R0, phi, Pr_b, sigma, theta, blocks)
+
+    # drive the jitted kernels through the identical sequence
+    Rj = jnp.asarray(R0)
+    Y = _normalize_cols(jnp.matmul(jnp.asarray(Z_cos), Rj.T))
+    dist = 2.0 * (1.0 - jnp.matmul(Y.T, jnp.asarray(Z_cos)))
+    E = jnp.outer(Rj.sum(axis=1), jnp.asarray(Pr_b))
+    O = jnp.matmul(Rj, jnp.asarray(phi).T)
+    for blk in blocks:
+        blk = jnp.asarray(blk)
+        R_blk, E, O = _block_R_update(
+            dist[:, blk], jnp.asarray(phi)[:, blk], E, O, Rj[:, blk],
+            jnp.asarray(Pr_b), jnp.asarray(sigma), jnp.asarray(theta))
+        Rj = Rj.at[:, blk].set(R_blk)
+    obj = float(_clustering_objective(Y, jnp.asarray(Z_cos), Rj, E, O,
+                                      jnp.asarray(sigma),
+                                      jnp.asarray(theta)))
+
+    assert np.sqrt(np.mean((np.asarray(Rj) - R_want) ** 2)) < 1e-4
+    assert np.sqrt(np.mean((np.asarray(Y) - Y_want) ** 2)) < 1e-5
+    np.testing.assert_allclose(np.asarray(O), O_want, rtol=1e-3, atol=1e-4)
+    assert abs(obj - obj_want) / abs(obj_want) < 1e-3
