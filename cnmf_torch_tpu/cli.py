@@ -163,6 +163,10 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None):
     import os
 
+    # parse BEFORE any jax import: --help / usage errors must not pay the
+    # backend-initialization cost or touch the cache directory
+    args = build_parser().parse_args(argv)
+
     # pod-simulation hook (set by the multihost launcher engine): force N
     # virtual CPU devices BEFORE the backend initializes. Env vars are too
     # late here — this environment pre-imports jax at interpreter startup —
@@ -174,7 +178,11 @@ def main(argv=None):
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", int(sim))
 
-    args = build_parser().parse_args(argv)
+    # persistent XLA compile cache (no-op if the user configured their own):
+    # repeat runs and the per-K k-selection loop skip recompilation
+    from .utils.compile_cache import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
 
     if args.command == "run_parallel":
         from .launcher import run_pipeline

@@ -70,6 +70,9 @@ def run_pipeline(counts: str, output_dir: str, name: str,
     factorize-mode options reach the workers.
     """
     factorize_flags = list(factorize_flags or [])
+    # the CLI's parser default is -1 ("all"); range(-1) would spawn zero
+    # workers and the run would only fail much later at combine
+    total_workers = max(int(total_workers), 1)
     from .models.cnmf import cNMF
 
     obj = cNMF(output_dir=output_dir, name=name)

@@ -420,6 +420,30 @@ class cNMF:
                  mesh_devices=(1 if mesh is None
                                else int(np.prod(mesh.devices.shape)))))
 
+        if len(by_k) > 1:
+            # compile all per-K programs concurrently before sweeping: the
+            # serial first-call compiles otherwise dominate a cold multi-K
+            # run (parallel/replicates.py: warm_sweep_programs)
+            from ..parallel import warm_sweep_programs
+
+            n_progs = warm_sweep_programs(
+                int(X.shape[0]), int(X.shape[1]),
+                {k: len(t) for k, t in by_k.items()},
+                beta_loss=_nmf_kwargs["beta_loss"],
+                init=_nmf_kwargs["init"],
+                mode=_nmf_kwargs.get("mode", "online"),
+                tol=_nmf_kwargs.get("tol", 1e-4),
+                online_chunk_size=_nmf_kwargs.get("online_chunk_size", 5000),
+                online_chunk_max_iter=_nmf_kwargs.get(
+                    "online_chunk_max_iter", 1000),
+                alpha_W=_nmf_kwargs.get("alpha_W", 0.0),
+                l1_ratio_W=_nmf_kwargs.get("l1_ratio_W", 0.0),
+                alpha_H=_nmf_kwargs.get("alpha_H", 0.0),
+                l1_ratio_H=_nmf_kwargs.get("l1_ratio_H", 0.0),
+                mesh=mesh, replicates_per_batch=replicates_per_batch)
+            print("[Worker %d]. Warmed %d sweep programs concurrently."
+                  % (worker_i, n_progs))
+
         # pipelined sweep: dispatch runs ahead of fetch+save by a bounded
         # window, so device->host copies of earlier Ks overlap the compute
         # of later ones while (a) each K's spectra files still land on disk

@@ -100,6 +100,108 @@ def _block_R_update(dist_blk, phi_blk, E, O, R_blk, Pr_b, sigma, theta):
     return R_new, E, O
 
 
+def _one_round(Z_cos, R_pad, phi_pad, E, O, blocks, valid_b, Pr_b, sigma,
+               theta):
+    """One clustering round on the padded state: centroid refresh + every
+    block's diversity-penalty R update, scanned over the blocks of a padded
+    permutation. Numerics per block are identical to
+    :func:`_block_R_update` (same update order, same out-of-block E/O);
+    sentinel entries (valid 0) contribute nothing to the E/O bookkeeping
+    and scatter only into the phantom column."""
+    n = Z_cos.shape[1]
+    Y = _normalize_cols(jnp.matmul(Z_cos, R_pad[:, :n].T, precision=_HI))
+    dist = 2.0 * (1.0 - jnp.matmul(Y.T, Z_cos, precision=_HI))
+    dist_pad = jnp.pad(dist, ((0, 0), (0, 1)))
+
+    def body(carry, inp):
+        R_pad, E, O = carry
+        idx, v = inp                                  # (blk,), (blk,)
+        R_blk = R_pad[:, idx] * v[None, :]
+        phi_blk = phi_pad[:, idx] * v[None, :]
+        E = E - jnp.outer(R_blk.sum(axis=1), Pr_b)
+        O = O - jnp.matmul(R_blk, phi_blk.T, precision=_HI)
+        dist_term = jnp.exp(-dist_pad[:, idx] / sigma[:, None])
+        penalty = jnp.matmul(
+            jnp.power((E + 1.0) / (O + 1.0), theta[None, :]), phi_blk,
+            precision=_HI)
+        R_new = dist_term * penalty
+        R_new = R_new / jnp.maximum(
+            jnp.sum(R_new, axis=0, keepdims=True), 1e-30)
+        R_new = R_new * v[None, :]
+        E = E + jnp.outer(R_new.sum(axis=1), Pr_b)
+        O = O + jnp.matmul(R_new, phi_blk.T, precision=_HI)
+        return (R_pad.at[:, idx].set(R_new), E, O), ()
+
+    (R_pad, E, O), _ = jax.lax.scan(body, (R_pad, E, O), (blocks, valid_b))
+    obj = _clustering_objective(Y, Z_cos, R_pad[:, :n], E, O, sigma, theta)
+    return R_pad, E, O, obj
+
+
+@functools.partial(jax.jit, static_argnames=("n_blocks",))
+def _cluster_round(Z_cos, R, phi, E, O, perm_pad, valid, Pr_b, sigma, theta,
+                   n_blocks):
+    """One full clustering round as ONE device program (testing/oracle
+    surface for :func:`_one_round`). Returns ``(R, E, O, objective)``."""
+    R_pad = jnp.pad(R, ((0, 0), (0, 1)))
+    phi_pad = jnp.pad(phi, ((0, 0), (0, 1)))
+    R_pad, E, O, obj = _one_round(
+        Z_cos, R_pad, phi_pad, E, O, perm_pad.reshape(n_blocks, -1),
+        valid.reshape(n_blocks, -1), Pr_b, sigma, theta)
+    return R_pad[:, :Z_cos.shape[1]], E, O, obj
+
+
+@functools.partial(jax.jit, static_argnames=("n_blocks", "max_iter"))
+def _cluster_phase(Z_cos, R, phi, E, O, perms, valids, Pr_b, sigma, theta,
+                   eps, n_blocks, max_iter):
+    """The whole clustering phase (up to ``max_iter`` rounds with the
+    original early-exit rule) as ONE device program.
+
+    The reference path (harmonypy, and a host loop like it) issues one
+    host->device round trip per cell block — thousands of tiny dispatches
+    per harmony iteration, which dominates wall-clock on high-latency
+    links. Here ALL ``max_iter`` per-round permutations are precomputed
+    host-side up front, padded to ``n_blocks`` equal blocks, and the
+    rounds run under a ``while_loop`` that stops when the objective's
+    relative change drops below ``eps`` (after at least 2 rounds, as
+    harmonypy does).
+
+    Determinism: same seed -> same result. But the seeded STREAM differs
+    from a host loop that draws one permutation per executed round (early
+    exit leaves the precomputed tail unused), and the equal-size block
+    split differs from ``np.array_split``'s first-blocks-larger split —
+    so same-seed outputs are not bit-identical to pre-fusion versions of
+    this module (both are valid optima of the same objective; the
+    reference itself has no cross-version guarantee here, as harmonypy
+    draws from global numpy state).
+
+    Returns ``(R, E, O, obj_prev, obj, rounds_run)`` — the last two
+    objectives so the caller can reproduce the host loop's bookkeeping.
+    """
+    R_pad0 = jnp.pad(R, ((0, 0), (0, 1)))
+    phi_pad = jnp.pad(phi, ((0, 0), (0, 1)))
+
+    def run_round(R_pad, E, O, it):
+        return _one_round(
+            Z_cos, R_pad, phi_pad, E, O,
+            perms[it].reshape(n_blocks, -1),
+            valids[it].reshape(n_blocks, -1), Pr_b, sigma, theta)
+
+    def body(carry):
+        R_pad, E, O, _obj_prev, obj, it = carry
+        R_pad, E, O, obj_new = run_round(R_pad, E, O, it)
+        return (R_pad, E, O, obj, obj_new, it + 1)
+
+    def cond(carry):
+        _, _, _, obj_prev, obj, it = carry
+        converged = jnp.abs(obj_prev - obj) < eps * jnp.abs(obj_prev)
+        return (it < max_iter) & ((it < 2) | ~converged)
+
+    R_pad, E, O, obj0 = run_round(R_pad0, E, O, jnp.int32(0))
+    R_pad, E, O, obj_prev, obj, it = jax.lax.while_loop(
+        cond, body, (R_pad, E, O, jnp.float32(jnp.inf), obj0, jnp.int32(1)))
+    return R_pad[:, :Z_cos.shape[1]], E, O, obj_prev, obj, it
+
+
 @jax.jit
 def _clustering_objective(Y, Z_cos, R, E, O, sigma, theta):
     dist = 2.0 * (1.0 - jnp.matmul(Y.T, Z_cos, precision=_HI))
@@ -187,34 +289,35 @@ def run_harmony(data_mat, meta_data: pd.DataFrame, vars_use, theta=2.0,
 
     rng = np.random.default_rng(random_state)
     n_blocks = max(1, int(np.ceil(1.0 / block_size)))
+    blk_len = int(np.ceil(n / n_blocks))
+    n_pad = n_blocks * blk_len
     objectives: list[float] = []
     Z_corr = jnp.asarray(Z)
+    lamb_mat = jnp.diag(jnp.asarray(lamb_diag))
 
     for _harmony_iter in range(max_iter_harmony):
-        # --- clustering rounds ---------------------------------------
-        Z_cos_d = _normalize_cols(Z_corr)
-        obj_prev = None
-        for _kmeans_iter in range(max_iter_kmeans):
-            Y = _normalize_cols(jnp.matmul(Z_cos_d, R.T, precision=_HI))
-            dist = 2.0 * (1.0 - jnp.matmul(Y.T, Z_cos_d, precision=_HI))
-            perm = rng.permutation(n)
-            for blk in np.array_split(perm, n_blocks):
-                blk = jnp.asarray(blk)
-                R_blk, E, O = _block_R_update(
-                    dist[:, blk], phi_d[:, blk], E, O, R[:, blk],
-                    Pr_b, sigma_vec, theta_d)
-                R = R.at[:, blk].set(R_blk)
-            obj = float(_clustering_objective(Y, Z_cos_d, R, E, O,
-                                              sigma_vec, theta_d))
-            if obj_prev is not None and abs(obj_prev - obj) < (
-                    epsilon_cluster * abs(obj_prev)):
-                break
-            obj_prev = obj
-        objectives.append(obj_prev if obj_prev is not None else obj)
+        # --- clustering phase: ONE device program (ops/harmony.py:
+        # _cluster_phase) instead of one dispatch per cell block — the
+        # permutations are drawn host-side up front, padded with sentinel
+        # index n (masked out on device)
+        perms = np.full((max_iter_kmeans, n_pad), n, dtype=np.int32)
+        valids = np.zeros((max_iter_kmeans, n_pad), dtype=np.float32)
+        for i in range(max_iter_kmeans):
+            perms[i, :n] = rng.permutation(n)
+            valids[i, :n] = 1.0
+        R, E, O, obj_prev, obj, _rounds = _cluster_phase(
+            _normalize_cols(Z_corr), R, phi_d, E, O,
+            jnp.asarray(perms), jnp.asarray(valids), Pr_b, sigma_vec,
+            theta_d, jnp.float32(epsilon_cluster), n_blocks,
+            int(max_iter_kmeans))
+        obj_prev, obj = float(obj_prev), float(obj)
+        # the host loop appended the pre-break objective on convergence and
+        # the final one on exhaustion
+        converged = abs(obj_prev - obj) < epsilon_cluster * abs(obj_prev)
+        objectives.append(obj_prev if converged else obj)
 
         # --- correction ----------------------------------------------
-        Z_corr = _moe_ridge_scan(jnp.asarray(Z), R, Phi_moe_d,
-                                 jnp.diag(jnp.asarray(lamb_diag)))
+        Z_corr = _moe_ridge_scan(jnp.asarray(Z), R, Phi_moe_d, lamb_mat)
 
         if len(objectives) >= 3:
             o = objectives

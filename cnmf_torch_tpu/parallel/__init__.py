@@ -10,6 +10,7 @@ from .replicates import (
     clear_sweep_cache,
     default_mesh,
     replicate_sweep,
+    warm_sweep_programs,
     worker_filter,
 )
 from .rowshard import fit_h_rowsharded, nmf_fit_rowsharded, pad_rows_to_mesh
@@ -24,6 +25,7 @@ __all__ = [
     "replicate_sweep",
     "replicate_sweep_2d",
     "sync_hosts",
+    "warm_sweep_programs",
     "worker_filter",
     "fit_h_rowsharded",
     "nmf_fit_rowsharded",

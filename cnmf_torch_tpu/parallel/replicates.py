@@ -37,7 +37,8 @@ from ..ops.nmf import (
 )
 
 __all__ = ["replicate_sweep", "worker_filter", "default_mesh",
-           "auto_replicates_per_batch", "clear_sweep_cache"]
+           "auto_replicates_per_batch", "clear_sweep_cache",
+           "warm_sweep_programs"]
 
 
 def worker_filter(iterable, worker_index: int, total_workers: int):
@@ -90,6 +91,77 @@ def clear_sweep_cache() -> None:
     from .multihost import _sweep2d_program
 
     _sweep2d_program.cache_clear()
+
+
+def warm_sweep_programs(n: int, g: int, k_to_count: dict,
+                        beta_loss="frobenius", init: str = "random",
+                        mode: str = "online", tol: float = 1e-4,
+                        online_chunk_size: int = 5000,
+                        online_chunk_max_iter: int = 1000,
+                        batch_max_iter: int = 500, n_passes: int = 20,
+                        alpha_W: float = 0.0, l1_ratio_W: float = 0.0,
+                        alpha_H: float = 0.0, l1_ratio_H: float = 0.0,
+                        mesh: Mesh | None = None, return_usages: bool = False,
+                        replicates_per_batch: int | None = None,
+                        online_h_tol: float = 1e-3,
+                        max_workers: int | None = None) -> int:
+    """Compile every sweep executable a K-sweep will need, CONCURRENTLY.
+
+    A multi-K ``factorize`` compiles one program per (K, slice-size); the
+    compiles dominate cold wall-clock (e.g. ~174 s of a 245 s PBMC-10k
+    run) because each first call compiles serially. XLA compilation
+    releases the GIL and scales across Python threads (measured ~1.8x for
+    2 concurrent TPU compiles), and an AOT ``lower().compile()`` populates
+    the same dispatch cache the later ``replicate_sweep`` call hits — so
+    warming in a thread pool turns the serial compile wall into roughly
+    the longest single compile.
+
+    ``k_to_count`` maps K -> replicate count, and every other argument
+    must match the subsequent :func:`replicate_sweep` calls exactly (same
+    static-argument derivation, same ``lru_cache`` keys). Returns the
+    number of distinct programs warmed.
+    """
+    import concurrent.futures
+
+    beta = beta_loss_to_float(beta_loss)
+    l1_W, l2_W = split_regularization(alpha_W, l1_ratio_W)
+    l1_H, l2_H = split_regularization(alpha_H, l1_ratio_H)
+    n_dev = 1 if mesh is None else math.prod(mesh.devices.shape)
+    x_sharding = None if mesh is None else NamedSharding(mesh, P())
+
+    specs: set[tuple[int, int]] = set()
+    for k, R in k_to_count.items():
+        k, R = int(k), int(R)
+        if R <= 0:
+            continue
+        rpb = replicates_per_batch
+        if rpb is None:
+            chunk = int(min(online_chunk_size, n)) if mode == "online" else n
+            rpb = auto_replicates_per_batch(n, g, k, beta=beta, chunk=chunk,
+                                            n_dev=n_dev)
+        rpb = max(n_dev, (rpb // n_dev) * n_dev)
+        for start in range(0, R, rpb):
+            r = min(rpb, R - start)
+            specs.add((k, r + ((-r) % n_dev)))
+    if not specs:
+        return 0
+
+    def compile_one(spec):
+        k, r_pad = spec
+        prog = _sweep_program(
+            n, g, k, r_pad, init, mode, beta, float(tol),
+            float(online_h_tol), int(min(online_chunk_size, n)),
+            int(online_chunk_max_iter), int(n_passes), int(batch_max_iter),
+            l1_H, l2_H, l1_W, l2_W, mesh, bool(return_usages))
+        xs = jax.ShapeDtypeStruct((n, g), jnp.float32, sharding=x_sharding)
+        ss = jax.ShapeDtypeStruct((r_pad,), jnp.uint32)
+        prog.lower(xs, ss).compile()
+
+    workers = max_workers or min(8, len(specs))
+    with concurrent.futures.ThreadPoolExecutor(workers) as ex:
+        # list() propagates the first compile error instead of hiding it
+        list(ex.map(compile_one, sorted(specs)))
+    return len(specs)
 
 
 def _stacked_inits(X, k: int, seeds, init: str):

@@ -1,0 +1,45 @@
+"""Persistent XLA compilation cache, on by default for CLI/bench entry points.
+
+Multi-K sweeps compile one executable per (K, slice) and the consensus/
+k-selection stages compile several more per K — on a cold process the
+compiles dominate wall-clock (measured: a 10000x2000 sweep program is ~14 s
+to compile, ~1.3 s to reload from the persistent cache through the same
+backend). JAX ships a content-addressed on-disk cache for exactly this;
+libraries shouldn't force global config, so this is enabled only from OUR
+process entry points (CLI, bench), and never overrides a user's explicit
+``JAX_COMPILATION_CACHE_DIR`` / ``jax.config`` setting.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["enable_persistent_compilation_cache"]
+
+_DEFAULT_DIR = os.path.join("~", ".cache", "cnmf-tpu", "xla-cache")
+
+
+def enable_persistent_compilation_cache(path: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at ``path`` (default
+    ``~/.cache/cnmf-tpu/xla-cache``) unless the user already configured one.
+    Safe to call multiple times. Returns the directory in effect, or None
+    when unavailable."""
+    import jax
+
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        return os.environ["JAX_COMPILATION_CACHE_DIR"]
+    try:
+        current = jax.config.jax_compilation_cache_dir
+    except AttributeError:  # config name changed; don't fight it
+        return None
+    if current:
+        return current
+    path = os.path.expanduser(path or _DEFAULT_DIR)
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # default threshold is 1s; keep it explicit so behavior is stable
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        return None
+    return path

@@ -415,3 +415,66 @@ def test_harmony_cluster_round_matches_harmonypy_oracle(rng):
     assert np.sqrt(np.mean((np.asarray(Y) - Y_want) ** 2)) < 1e-5
     np.testing.assert_allclose(np.asarray(O), O_want, rtol=1e-3, atol=1e-4)
     assert abs(obj - obj_want) / abs(obj_want) < 1e-3
+
+
+def test_fused_cluster_round_matches_blockwise_loop(rng):
+    """The fused one-dispatch clustering round (_cluster_round: scan over
+    padded equal-size blocks with sentinel masking) must reproduce the
+    sequential per-block loop (_block_R_update) exactly, including when the
+    cell count does not divide the block count."""
+    import jax.numpy as jnp
+
+    from cnmf_torch_tpu.ops.harmony import (
+        _block_R_update,
+        _cluster_round,
+        _clustering_objective,
+        _normalize_cols,
+    )
+
+    d, n, K, n_blocks = 5, 103, 4, 4          # 103 % 4 != 0 -> padding
+    b = rng.integers(0, 3, size=n)
+    phi = np.zeros((3, n), np.float32)
+    phi[b, np.arange(n)] = 1.0
+    Z_cos = rng.normal(size=(d, n)).astype(np.float32)
+    Z_cos /= np.linalg.norm(Z_cos, axis=0, keepdims=True)
+    R0 = rng.random(size=(K, n)).astype(np.float32)
+    R0 /= R0.sum(axis=0, keepdims=True)
+    Pr_b = jnp.asarray(phi.sum(axis=1) / n)
+    sigma = jnp.full((K,), 0.1, jnp.float32)
+    theta = jnp.full((3,), 2.0, jnp.float32)
+
+    blk_len = int(np.ceil(n / n_blocks))
+    perm = rng.permutation(n)
+    perm_pad = np.full(n_blocks * blk_len, n, np.int32)
+    perm_pad[:n] = perm
+    valid = (perm_pad < n).astype(np.float32)
+
+    E0 = jnp.outer(jnp.asarray(R0).sum(axis=1), Pr_b)
+    O0 = jnp.matmul(jnp.asarray(R0), jnp.asarray(phi).T)
+
+    R_f, E_f, O_f, obj_f = _cluster_round(
+        jnp.asarray(Z_cos), jnp.asarray(R0), jnp.asarray(phi), E0, O0,
+        jnp.asarray(perm_pad), jnp.asarray(valid), Pr_b, sigma, theta,
+        n_blocks)
+
+    # sequential reference: same blocks, one _block_R_update per block
+    Rj = jnp.asarray(R0)
+    Y = _normalize_cols(jnp.matmul(jnp.asarray(Z_cos), Rj.T))
+    dist = 2.0 * (1.0 - jnp.matmul(Y.T, jnp.asarray(Z_cos)))
+    E, O = E0, O0
+    for blk in perm_pad.reshape(n_blocks, -1):
+        blk = jnp.asarray(blk[blk < n])
+        R_blk, E, O = _block_R_update(
+            dist[:, blk], jnp.asarray(phi)[:, blk], E, O, Rj[:, blk],
+            Pr_b, sigma, theta)
+        Rj = Rj.at[:, blk].set(R_blk)
+    obj_s = _clustering_objective(Y, jnp.asarray(Z_cos), Rj, E, O, sigma,
+                                  theta)
+
+    np.testing.assert_allclose(np.asarray(R_f), np.asarray(Rj),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(E_f), np.asarray(E),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(O_f), np.asarray(O),
+                               rtol=1e-5, atol=1e-5)
+    assert abs(float(obj_f) - float(obj_s)) / abs(float(obj_s)) < 1e-5
