@@ -270,8 +270,8 @@ class cNMF:
         """Re-probe iter_spectra files to refresh the completed column
         (``cnmf.py:780-795``). Must not run while factorize workers are
         active (undocumented reference invariant, SURVEY.md §5.2)."""
-        _nmf_kwargs = yaml.load(open(self.paths["nmf_run_parameters"]),
-                                Loader=yaml.FullLoader)
+        with open(self.paths["nmf_run_parameters"]) as f:
+            _nmf_kwargs = yaml.load(f, Loader=yaml.FullLoader)
         replicate_params = load_df_from_npz(
             self.paths["nmf_replicate_parameters"])
         for i in replicate_params.index:
@@ -325,8 +325,8 @@ class cNMF:
         """
         run_params = load_df_from_npz(self.paths["nmf_replicate_parameters"])
         norm_counts = read_h5ad(self.paths["normalized_counts"])
-        _nmf_kwargs = yaml.load(open(self.paths["nmf_run_parameters"]),
-                                Loader=yaml.FullLoader)
+        with open(self.paths["nmf_run_parameters"]) as f:
+            _nmf_kwargs = yaml.load(f, Loader=yaml.FullLoader)
 
         if not skip_completed_runs:
             jobs = worker_filter(range(len(run_params)), worker_i,
@@ -686,8 +686,8 @@ class cNMF:
         host->HBM shard-wise with no host dense copy — the reference's
         ``X.toarray()`` at this boundary (cnmf.py:329-330) is the wall for
         atlas-scale consensus."""
-        kwargs = yaml.load(open(self.paths["nmf_run_parameters"]),
-                           Loader=yaml.FullLoader)
+        with open(self.paths["nmf_run_parameters"]) as f:
+            kwargs = yaml.load(f, Loader=yaml.FullLoader)
         beta = beta_loss_to_float(kwargs["beta_loss"])
         if isinstance(X, pd.DataFrame):
             X = X.values
@@ -727,8 +727,8 @@ class cNMF:
         if X.shape[0] >= self.rowshard_threshold:
             from ..parallel.rowshard import refit_w_rowsharded
 
-            kwargs = yaml.load(open(self.paths["nmf_run_parameters"]),
-                               Loader=yaml.FullLoader)
+            with open(self.paths["nmf_run_parameters"]) as f:
+                kwargs = yaml.load(f, Loader=yaml.FullLoader)
             return refit_w_rowsharded(
                 X, np.asarray(usage),
                 beta=beta_loss_to_float(kwargs["beta_loss"]),

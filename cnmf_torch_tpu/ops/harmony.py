@@ -151,7 +151,7 @@ def _cluster_round(Z_cos, R, phi, E, O, perm_pad, valid, Pr_b, sigma, theta,
 
 
 @functools.partial(jax.jit, static_argnames=("n_blocks", "max_iter"))
-def _cluster_phase(Z_cos, R, phi, E, O, perms, valids, Pr_b, sigma, theta,
+def _cluster_phase(Z_cos, R, phi, E, O, perms, Pr_b, sigma, theta,
                    eps, n_blocks, max_iter):
     """The whole clustering phase (up to ``max_iter`` rounds with the
     original early-exit rule) as ONE device program.
@@ -179,6 +179,10 @@ def _cluster_phase(Z_cos, R, phi, E, O, perms, valids, Pr_b, sigma, theta,
     """
     R_pad0 = jnp.pad(R, ((0, 0), (0, 1)))
     phi_pad = jnp.pad(phi, ((0, 0), (0, 1)))
+    n = Z_cos.shape[1]
+    # validity is derivable: sentinel entries of the padded permutations
+    # point at the phantom column n
+    valids = (perms < n).astype(R.dtype)
 
     def run_round(R_pad, E, O, it):
         return _one_round(
@@ -301,13 +305,11 @@ def run_harmony(data_mat, meta_data: pd.DataFrame, vars_use, theta=2.0,
         # permutations are drawn host-side up front, padded with sentinel
         # index n (masked out on device)
         perms = np.full((max_iter_kmeans, n_pad), n, dtype=np.int32)
-        valids = np.zeros((max_iter_kmeans, n_pad), dtype=np.float32)
         for i in range(max_iter_kmeans):
             perms[i, :n] = rng.permutation(n)
-            valids[i, :n] = 1.0
         R, E, O, obj_prev, obj, _rounds = _cluster_phase(
             _normalize_cols(Z_corr), R, phi_d, E, O,
-            jnp.asarray(perms), jnp.asarray(valids), Pr_b, sigma_vec,
+            jnp.asarray(perms), Pr_b, sigma_vec,
             theta_d, jnp.float32(epsilon_cluster), n_blocks,
             int(max_iter_kmeans))
         obj_prev, obj = float(obj_prev), float(obj)

@@ -81,6 +81,29 @@ def auto_replicates_per_batch(n: int, g: int, k: int, beta: float = 2.0,
     return max(n_dev, int(budget_elems // max(per_rep, 1)))
 
 
+def _slice_specs(n: int, g: int, k: int, R: int, beta: float, mode: str,
+                 online_chunk_size: int, replicates_per_batch: int | None,
+                 n_dev: int):
+    """The ONE derivation of how a sweep's replicates split into device
+    slices — shared by :func:`replicate_sweep` (execution) and
+    :func:`warm_sweep_programs` (ahead-of-time compilation), so the warmer
+    can never compile for slice shapes the sweep won't use. Returns
+    ``(replicates_per_batch, [(start, r, r_padded), ...])``.
+    """
+    rpb = replicates_per_batch
+    if rpb is None:
+        chunk = int(min(online_chunk_size, n)) if mode == "online" else n
+        rpb = auto_replicates_per_batch(n, g, k, beta=beta, chunk=chunk,
+                                        n_dev=n_dev)
+    # slices must stay mesh-multiples so every shard stays busy
+    rpb = max(n_dev, (rpb // n_dev) * n_dev)
+    specs = []
+    for start in range(0, R, rpb):
+        r = min(rpb, R - start)
+        specs.append((start, r, r + ((-r) % n_dev)))
+    return rpb, specs
+
+
 def clear_sweep_cache() -> None:
     """Evict the per-(shape, config) compiled sweep executables (and the
     mesh/device references they retain), for both the 1-D and the 2-D
@@ -134,15 +157,10 @@ def warm_sweep_programs(n: int, g: int, k_to_count: dict,
         k, R = int(k), int(R)
         if R <= 0:
             continue
-        rpb = replicates_per_batch
-        if rpb is None:
-            chunk = int(min(online_chunk_size, n)) if mode == "online" else n
-            rpb = auto_replicates_per_batch(n, g, k, beta=beta, chunk=chunk,
-                                            n_dev=n_dev)
-        rpb = max(n_dev, (rpb // n_dev) * n_dev)
-        for start in range(0, R, rpb):
-            r = min(rpb, R - start)
-            specs.add((k, r + ((-r) % n_dev)))
+        _, slices = _slice_specs(n, g, k, R, beta, mode, online_chunk_size,
+                                 replicates_per_batch, n_dev)
+        for _start, _r, r_pad in slices:
+            specs.add((k, r_pad))
     if not specs:
         return 0
 
@@ -305,12 +323,9 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
     l1_H, l2_H = split_regularization(alpha_H, l1_ratio_H)
 
     n_dev = 1 if mesh is None else math.prod(mesh.devices.shape)
-    if replicates_per_batch is None:
-        chunk = int(min(online_chunk_size, n)) if mode == "online" else n
-        replicates_per_batch = auto_replicates_per_batch(
-            n, g, k, beta=beta, chunk=chunk, n_dev=n_dev)
-    # slices must stay mesh-multiples so every shard stays busy
-    replicates_per_batch = max(n_dev, (replicates_per_batch // n_dev) * n_dev)
+    replicates_per_batch, slices = _slice_specs(
+        n, g, k, R, beta, mode, online_chunk_size, replicates_per_batch,
+        n_dev)
 
     if mesh is not None:
         target = NamedSharding(mesh, P())
@@ -320,14 +335,12 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
             X = jax.device_put(X, target)
 
     parts = []
-    for start in range(0, R, replicates_per_batch):
-        sl = seeds[start:start + replicates_per_batch]
-        r = len(sl)
-        pad = (-r) % n_dev
-        if pad:
+    for start, r, r_pad in slices:
+        sl = seeds[start:start + r]
+        if r_pad > r:
             # tile modulo r: works even when the slice is smaller than the
             # mesh (pad replicates recompute existing seeds and are dropped)
-            sl = sl + [sl[i % r] for i in range(pad)]
+            sl = sl + [sl[i % r] for i in range(r_pad - r)]
         prog = _sweep_program(
             n, g, k, len(sl), init, mode, beta, float(tol),
             float(online_h_tol), int(min(online_chunk_size, n)),

@@ -38,8 +38,10 @@ def enable_persistent_compilation_cache(path: str | None = None) -> str | None:
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
-        # default threshold is 1s; keep it explicit so behavior is stable
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        # pin the threshold ONLY when the user hasn't set their own
+        if not os.environ.get("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"):
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              1.0)
     except Exception:
         return None
     return path

@@ -206,13 +206,13 @@ def test_stream_rows_to_mesh_matches_dense(mesh):
 
 
 def test_rowsharded_never_densifies_full_matrix(mesh, monkeypatch):
-    """The no-host-dense guarantee: during a row-sharded solve on CSR input,
-    toarray() is only ever called on shard-sized row blocks."""
+    """The no-host-dense guarantee, now in its strongest form: a row-sharded
+    solve on CSR input never calls toarray() AT ALL — the CSR buffers ship
+    to the devices and densify there (rowshard.py:_csr_densify), so
+    host->HBM bytes scale with nnz, not rows x genes."""
     from cnmf_torch_tpu.parallel.rowshard import prepare_rowsharded
 
     n, g = 160, 32
-    n_dev = int(np.prod(mesh.devices.shape))
-    max_block = -(-n // n_dev) + n_dev  # one shard (+ padding slack)
     X = sp.random(n, g, density=0.15, random_state=9, format="csr")
 
     seen = []
@@ -227,8 +227,10 @@ def test_rowsharded_never_densifies_full_matrix(mesh, monkeypatch):
     H, W, err = nmf_fit_rowsharded(Xd, 3, mesh, seed=5, n_passes=10,
                                    n_orig=n_orig)
     assert n_orig == n and H.shape == (n, 3) and np.isfinite(err)
-    assert seen, "expected streaming toarray calls"
-    assert max(s[0] for s in seen) <= max_block, seen
+    assert not seen, f"host densify happened: {seen}"
+    # and the staged array is exactly the padded dense matrix
+    np.testing.assert_allclose(
+        np.asarray(Xd)[:n], X.toarray().astype(np.float32), atol=0)
 
 
 def test_prepared_device_array_reused_across_ks(mesh):
