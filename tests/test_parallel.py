@@ -380,3 +380,57 @@ def test_rowsharded_nndsvd_init(mesh):
     _, W2, _ = nmf_fit_rowsharded(X, 4, mesh, init="nndsvd", seed=12,
                                   n_passes=20)
     assert not np.allclose(W, W2)
+
+
+def test_warm_sweep_programs_matches_sweep_slicing(mesh):
+    """Warming with the SAME arguments as the subsequent sweep must compile
+    the exact executables the sweep requests: after warming, the sweep call
+    adds no new entries to the jitted program's dispatch cache."""
+    from cnmf_torch_tpu.parallel.replicates import (
+        _slice_specs,
+        _sweep_program,
+        warm_sweep_programs,
+    )
+
+    n, g = 64, 40
+    n_dev = int(np.prod(mesh.devices.shape))
+    counts = {3: 10, 4: 5}
+    expect = set()
+    for k, R in counts.items():
+        _, slices = _slice_specs(n, g, k, R, 2.0, "batch", 5000, None, n_dev)
+        for _s, _r, r_pad in slices:
+            expect.add((k, r_pad))
+    warmed = warm_sweep_programs(n, g, counts, beta_loss="frobenius",
+                                 mode="batch", batch_max_iter=30, mesh=mesh)
+    assert warmed == len(expect)
+
+    # the non-tautological half of the contract: the sweep's subsequent
+    # _sweep_program lookups must HIT the lru entries the warmer built (a
+    # miss means the two paths derived different static arguments and the
+    # warmer compiled executables the sweep will never use)
+    ci0 = _sweep_program.cache_info()
+    X = _lowrank(n=n, g=g, k=3, seed=2)
+    spectra, _, errs = replicate_sweep(X, list(range(10)), 3, mode="batch",
+                                       batch_max_iter=30, mesh=mesh)
+    assert spectra.shape == (10, 3, g) and np.isfinite(errs).all()
+    ci1 = _sweep_program.cache_info()
+    assert ci1.misses == ci0.misses, (
+        "sweep built programs the warmer did not prepare")
+    assert ci1.hits > ci0.hits
+
+
+def test_stream_csr_multislab_assembly(mesh, monkeypatch):
+    """The multi-slab shard assembly (zeros buffer + donated slab writes) is
+    the path atlas-scale shards take; exercise it by shrinking the slab size
+    so every shard needs several scatters, and require bit-exact equality
+    with the dense matrix — including a non-dividing row count."""
+    import cnmf_torch_tpu.parallel.rowshard as rs
+
+    monkeypatch.setattr(rs, "_DENSIFY_SLAB_ROWS", 7)
+    X = sp.random(107, 23, density=0.21, random_state=12, format="csr")
+    Xd, pad = rs.stream_rows_to_mesh(X, mesh, mesh.axis_names[0])
+    got = np.asarray(Xd)
+    assert got.shape[0] == 107 + pad
+    np.testing.assert_allclose(got[:107], X.toarray().astype(np.float32),
+                               atol=0)
+    assert not got[107:].any()

@@ -478,3 +478,47 @@ def test_fused_cluster_round_matches_blockwise_loop(rng):
     np.testing.assert_allclose(np.asarray(O_f), np.asarray(O),
                                rtol=1e-5, atol=1e-5)
     assert abs(float(obj_f) - float(obj_s)) / abs(float(obj_s)) < 1e-5
+
+
+def test_cluster_phase_early_exit_and_exhaustion(rng):
+    """_cluster_phase honors the host loop's stopping rule: at least two
+    rounds before a convergence exit, exhaustion at max_iter otherwise, and
+    the returned (obj_prev, obj) pair lets the caller reproduce the
+    original objective bookkeeping."""
+    import jax.numpy as jnp
+
+    from cnmf_torch_tpu.ops.harmony import _cluster_phase, _normalize_cols
+
+    d, n, K, n_blocks = 4, 64, 3, 4
+    b = rng.integers(0, 2, size=n)
+    phi = np.zeros((2, n), np.float32)
+    phi[b, np.arange(n)] = 1.0
+    Z = rng.normal(size=(d, n)).astype(np.float32)
+    Z_cos = np.asarray(_normalize_cols(jnp.asarray(Z)))
+    R0 = rng.random(size=(K, n)).astype(np.float32)
+    R0 /= R0.sum(axis=0, keepdims=True)
+    Pr_b = jnp.asarray(phi.sum(axis=1) / n)
+    sigma = jnp.full((K,), 0.1, jnp.float32)
+    theta = jnp.full((2,), 1.0, jnp.float32)
+    E0 = jnp.outer(jnp.asarray(R0).sum(axis=1), Pr_b)
+    O0 = jnp.matmul(jnp.asarray(R0), jnp.asarray(phi).T)
+
+    blk = -(-n // n_blocks)
+    perms = np.full((10, n_blocks * blk), n, np.int32)
+    for i in range(10):
+        perms[i, :n] = rng.permutation(n)
+
+    # loose eps -> early exit after exactly 2 rounds
+    *_, obj_prev, obj, rounds = _cluster_phase(
+        jnp.asarray(Z_cos), jnp.asarray(R0), jnp.asarray(phi), E0, O0,
+        jnp.asarray(perms), Pr_b, sigma, theta, jnp.float32(1e30),
+        n_blocks, 10)
+    assert int(rounds) == 2
+    assert np.isfinite(float(obj_prev)) and np.isfinite(float(obj))
+
+    # impossible eps -> runs all max_iter rounds
+    *_, _, _, rounds = _cluster_phase(
+        jnp.asarray(Z_cos), jnp.asarray(R0), jnp.asarray(phi), E0, O0,
+        jnp.asarray(perms), Pr_b, sigma, theta, jnp.float32(0.0),
+        n_blocks, 10)
+    assert int(rounds) == 10
