@@ -158,6 +158,11 @@ def _update_H(X, H, W, beta: float, l1: float, l2: float):
         numer = X @ W.T
         denom = H @ (W @ W.T)
     elif beta == 1.0:
+        # measured on v5e: this chain is HBM-roofline-bound, and XLA's
+        # fusion of the batched (vmapped) form already matches a
+        # hand-fused Pallas one-pass kernel (ratio+both matmuls in VMEM
+        # tiles) — the kernel won 3x single-replicate but 0x under vmap,
+        # so the plain jnp form stays (bench.py mfu tier tracks this)
         R = X / jnp.maximum(H @ W, EPS)
         numer = R @ W.T
         denom = jnp.broadcast_to(W.sum(axis=1)[None, :], H.shape)

@@ -14,6 +14,8 @@ Only the features the cNMF pipeline needs are implemented: ``X``, ``obs``,
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import pandas as pd
 import scipy.sparse as sp
@@ -166,18 +168,36 @@ def _write_dataframe(parent, name: str, df: pd.DataFrame):
             ds.attrs["encoding-version"] = "0.2.0"
 
 
+def _x_compression() -> dict:
+    """anndata's write_h5ad defaults to NO compression, and single-threaded
+    gzip was the largest single cost of the prepare stage (~5 s of a 22 s
+    run at gzip-1). Match the reference default; opt back in with
+    CNMF_H5_COMPRESSION=gzip (level 1) or =lzf (fast, h5py-only filter)."""
+    mode = os.environ.get("CNMF_H5_COMPRESSION", "none").lower()
+    if mode in ("", "none", "0", "off", "false"):
+        return {}
+    if mode == "lzf":
+        return {"compression": "lzf"}
+    if mode == "gzip":
+        return {"compression": "gzip", "compression_opts": 1}
+    raise ValueError(
+        f"CNMF_H5_COMPRESSION={mode!r} not recognized; use 'none', 'gzip', "
+        "or 'lzf'")
+
+
 def _write_X(parent, X):
+    comp = _x_compression()
     if sp.issparse(X):
         X = X.tocsr()
         g = parent.create_group("X")
         g.attrs["encoding-type"] = "csr_matrix"
         g.attrs["encoding-version"] = "0.1.0"
         g.attrs["shape"] = np.asarray(X.shape, dtype=np.int64)
-        g.create_dataset("data", data=X.data, compression="gzip", compression_opts=1)
-        g.create_dataset("indices", data=X.indices, compression="gzip", compression_opts=1)
-        g.create_dataset("indptr", data=X.indptr, compression="gzip", compression_opts=1)
+        g.create_dataset("data", data=X.data, **comp)
+        g.create_dataset("indices", data=X.indices, **comp)
+        g.create_dataset("indptr", data=X.indptr, **comp)
     else:
-        ds = parent.create_dataset("X", data=np.asarray(X), compression="gzip", compression_opts=1)
+        ds = parent.create_dataset("X", data=np.asarray(X), **comp)
         ds.attrs["encoding-type"] = "array"
         ds.attrs["encoding-version"] = "0.2.0"
 

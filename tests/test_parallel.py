@@ -431,6 +431,5 @@ def test_stream_csr_multislab_assembly(mesh, monkeypatch):
     Xd, pad = rs.stream_rows_to_mesh(X, mesh, mesh.axis_names[0])
     got = np.asarray(Xd)
     assert got.shape[0] == 107 + pad
-    np.testing.assert_allclose(got[:107], X.toarray().astype(np.float32),
-                               atol=0)
+    np.testing.assert_array_equal(got[:107], X.toarray().astype(np.float32))
     assert not got[107:].any()
