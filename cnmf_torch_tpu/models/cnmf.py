@@ -681,6 +681,15 @@ class cNMF:
         deterministic result where the reference's unseeded torch init did
         not.
 
+        Documented divergence: the refit solves the run's ACTUAL beta
+        subproblem. The reference maps beta_loss name->number here
+        (cnmf.py:944-951) but its ``fit_H_online`` takes no beta parameter
+        (cnmf.py:260-271) — its KL/IS consensus refits silently minimize
+        the Frobenius objective instead. For beta=2 runs the two agree
+        (oracle-tested, test_reference_parity.py); for KL/IS this refit is
+        consistent with the factorization objective where the reference's
+        is not.
+
         Above ``rowshard_threshold`` cells the refit runs row-sharded
         (:func:`~cnmf_torch_tpu.parallel.fit_h_rowsharded`): X streams
         host->HBM shard-wise with no host dense copy — the reference's

@@ -188,3 +188,41 @@ class TestConsensusMathParity:
                                normalize_y=True)
         assert rms(got_beta, want_beta) < 1e-10
         assert list(med_re.index) == list(range(1, k + 1))
+
+
+def test_refit_usage_solves_the_runs_beta_objective(tmp_path):
+    """Documented divergence (cnmf.py:944-976 vs 260-271): the reference
+    maps beta for its refits but fit_H_online has no beta parameter, so its
+    KL-run refits minimize Frobenius. Our refit must solve the run's ACTUAL
+    objective: on a KL-prepared run, the refit usages score better under KL
+    than the Frobenius-subproblem solution does."""
+    import pandas as pd
+
+    from cnmf_torch_tpu.models.cnmf import cNMF
+    from cnmf_torch_tpu.ops.nmf import beta_divergence, fit_h
+    from cnmf_torch_tpu.utils.io import save_df_to_npz
+
+    rng = np.random.default_rng(3)
+    H_true = rng.gamma(1.0, 1.0, size=(80, 3))
+    W_true = rng.gamma(1.0, 1.0, size=(3, 50))
+    counts = rng.poisson(H_true @ W_true) + 1.0
+    df = pd.DataFrame(counts, index=[f"c{i}" for i in range(80)],
+                      columns=[f"g{j}" for j in range(50)])
+    fn = str(tmp_path / "c.df.npz")
+    save_df_to_npz(df, fn)
+
+    obj = cNMF(output_dir=str(tmp_path), name="kl")
+    obj.prepare(fn, components=[3], n_iter=2, seed=1,
+                beta_loss="kullback-leibler", num_highvar_genes=40)
+    import yaml
+
+    with open(obj.paths["nmf_run_parameters"]) as f:
+        assert yaml.safe_load(f)["beta_loss"] == "kullback-leibler"
+
+    X = counts[:, :40].astype(np.float32) + 0.1
+    spectra = np.abs(rng.normal(size=(3, 40))).astype(np.float32) + 0.1
+    H_ours = obj.refit_usage(X, spectra)
+    H_frob = fit_h(X, spectra, beta=2.0, h_tol=1e-4, chunk_max_iter=500)
+    kl_ours = float(beta_divergence(X, np.asarray(H_ours), spectra, beta=1.0))
+    kl_frob = float(beta_divergence(X, np.asarray(H_frob), spectra, beta=1.0))
+    assert kl_ours < kl_frob, (kl_ours, kl_frob)
