@@ -18,7 +18,6 @@ per-device program is exactly the single-chip kernel.
 from __future__ import annotations
 
 import functools
-import math
 
 import jax
 import jax.numpy as jnp

@@ -19,7 +19,6 @@ import os
 
 import numpy as np
 import pandas as pd
-import scipy.io
 import scipy.sparse as sp
 
 from .anndata_lite import AnnDataLite, read_h5ad, write_h5ad

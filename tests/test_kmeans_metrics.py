@@ -1,5 +1,4 @@
 import numpy as np
-import pytest
 
 from cnmf_torch_tpu.ops import (
     kmeans,
