@@ -143,13 +143,30 @@ def split_regularization(alpha: float, l1_ratio: float) -> tuple[float, float]:
             float(alpha) * (1.0 - float(l1_ratio)))
 
 
-def _apply_rate(M, numer, denom, l1, l2, eps=EPS):
+def mu_gamma(beta: float) -> float:
+    """Févotte & Idier (2011) convergence exponent for the MU rate:
+    ``rate ** gamma`` with gamma = 1/(2-beta) for beta < 1, 1/(beta-1) for
+    beta > 2, and 1 in between. Without it the beta=0 (Itakura-Saito)
+    update is not monotone; sklearn's MU solver applies the same exponent
+    (our IS trajectory is element-wise oracle-tested against it)."""
+    beta = float(beta)
+    if beta < 1.0:
+        return 1.0 / (2.0 - beta)
+    if beta > 2.0:
+        return 1.0 / (beta - 1.0)
+    return 1.0
+
+
+def _apply_rate(M, numer, denom, l1, l2, eps=EPS, gamma: float = 1.0):
     """nmf-torch-convention MU rate (observed at cnmf.py:357-371):
     numerator L1-shifted and clamped, L2 added to denominator, rate zeroed
-    where the denominator underflows."""
+    where the denominator underflows; ``gamma`` exponent per
+    :func:`mu_gamma`."""
     numer = jnp.maximum(numer - l1, 0.0) if l1 else numer
     denom = denom + l2 * M if l2 else denom
     rate = jnp.where(denom < eps, 0.0, numer / jnp.maximum(denom, eps))
+    if gamma != 1.0:
+        rate = rate ** gamma
     return M * rate
 
 
@@ -174,7 +191,7 @@ def _update_H(X, H, W, beta: float, l1: float, l2: float):
         WH = jnp.maximum(H @ W, EPS)
         numer = (X * WH ** (beta - 2.0)) @ W.T
         denom = (WH ** (beta - 1.0)) @ W.T
-    return _apply_rate(H, numer, denom, l1, l2)
+    return _apply_rate(H, numer, denom, l1, l2, gamma=mu_gamma(beta))
 
 
 def _update_W(X, H, W, beta: float, l1: float, l2: float):
@@ -193,7 +210,7 @@ def _update_W(X, H, W, beta: float, l1: float, l2: float):
         WH = jnp.maximum(H @ W, EPS)
         numer = H.T @ (X * WH ** (beta - 2.0))
         denom = H.T @ (WH ** (beta - 1.0))
-    return _apply_rate(W, numer, denom, l1, l2)
+    return _apply_rate(W, numer, denom, l1, l2, gamma=mu_gamma(beta))
 
 
 # ---------------------------------------------------------------------------
@@ -379,7 +396,8 @@ def nmf_fit_online(Xc, Hc0, W0, beta: float = 2.0, tol: float = 1e-4,
                     numer = h.T @ (x * WH ** (beta - 2.0))
                     denom = h.T @ (WH ** (beta - 1.0))
                 err_c = _beta_div_dense(x, WH, beta)
-                W = _apply_rate(W, numer, denom, l1_W, l2_W)
+                W = _apply_rate(W, numer, denom, l1_W, l2_W,
+                                gamma=mu_gamma(beta))
                 return (W, err_acc + err_c), h
 
             (W, err), Hc = jax.lax.scan(scan_chunk, (W, jnp.float32(0.0)),

@@ -29,6 +29,7 @@ from jax import shard_map
 from ..ops.nmf import (
     EPS,
     _apply_rate,
+    mu_gamma,
     _beta_div_dense,
     _chunk_h_solve,
     _solve_w_from_stats,
@@ -204,7 +205,7 @@ def _rowsharded_pass(X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
         else:  # beta == 0.0 (itakura-saito)
             numer = jax.lax.psum(H_local.T @ (X_local / (WH * WH)), axis)
             denom = jax.lax.psum(H_local.T @ (1.0 / WH), axis)
-        W = _apply_rate(W, numer, denom, l1_W, l2_W)
+        W = _apply_rate(W, numer, denom, l1_W, l2_W, gamma=mu_gamma(beta))
     # objective of the updated (H, W): the cancellation-safe per-element
     # forms from _beta_div_dense (the naive KL/IS sums lose the O(u^2)
     # near-convergence terms to fp32 cancellation, breaking the pass-loop
@@ -370,6 +371,12 @@ def refit_w_rowsharded(X, H, beta=2.0, h_tol: float = 0.05,
     Returns W (k x genes) as numpy.
     """
     beta = beta_loss_to_float(beta)
+    if beta not in (2.0, 1.0, 0.0):
+        # same contract as nmf_fit_rowsharded: block_stats implements the
+        # three named losses; a generic beta would silently run the IS
+        # statistics under the wrong divergence
+        raise ValueError(
+            f"refit_w_rowsharded supports beta in {{2, 1, 0}}, got {beta}")
     H = np.asarray(H, dtype=np.float32)
     n, k = H.shape
     g = int(X.shape[1])
@@ -412,7 +419,7 @@ def refit_w_rowsharded(X, H, beta=2.0, h_tol: float = 0.05,
                                  Hd[start:start + row_block], W, beta)
             numer, denom = numer + nb, denom + db
         W_new = _apply_rate(W, numer, denom, float(l1_reg_W),
-                            float(l2_reg_W))
+                            float(l2_reg_W), gamma=mu_gamma(beta))
         rel = float(jnp.linalg.norm(W_new - W)
                     / (jnp.linalg.norm(W) + EPS))
         W = W_new

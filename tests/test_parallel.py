@@ -97,21 +97,25 @@ def test_replicate_sweep_online_sharded(mesh):
     assert (errs / denom < 0.1).all()
 
 
-@pytest.mark.parametrize("beta_loss", ["frobenius", "kullback-leibler"])
+@pytest.mark.parametrize("beta_loss",
+                         ["frobenius", "kullback-leibler", "itakura-saito"])
 def test_rowsharded_nmf_converges(mesh, beta_loss):
     X = _lowrank(n=100, g=48, k=4, seed=5) + 0.01
+    # IS takes gamma=0.5-damped steps (mu_gamma) — give it more passes
+    n_passes = 80 if beta_loss == "itakura-saito" else 30
     H, W, err = nmf_fit_rowsharded(X, 4, mesh, beta_loss=beta_loss,
-                                   seed=42, n_passes=30)
+                                   seed=42, n_passes=n_passes)
     assert H.shape == (100, 4) and W.shape == (4, 48)
     assert (H >= 0).all() and (W >= 0).all()
     if beta_loss == "frobenius":
         denom = (X ** 2).sum() / 2
         assert err / denom < 0.05
     else:
-        # KL err should be far below the trivial (flat W) objective
+        # beta!=2 err should be far below the trivial (flat W) objective
+        beta = {"kullback-leibler": 1.0, "itakura-saito": 0.0}[beta_loss]
         flat = float(beta_divergence(
             np.asarray(X), np.full((100, 4), X.mean() / 4, np.float32),
-            np.ones((4, 48), np.float32), beta=1.0))
+            np.ones((4, 48), np.float32), beta=beta))
         assert err < 0.1 * flat
 
 
@@ -140,7 +144,7 @@ def test_fit_h_rowsharded_matches_single(mesh):
     assert abs(r_ref - r_sh) / r_ref < 1e-2
 
 
-@pytest.mark.parametrize("beta", [2.0, 1.0])
+@pytest.mark.parametrize("beta", [2.0, 1.0, 0.0])
 def test_refit_w_matches_transpose_trick(beta):
     """refit_w_rowsharded solves the same convex W-subproblem the
     reference's transpose trick does (refit_usage(X.T, usage.T).T,
@@ -433,3 +437,15 @@ def test_stream_csr_multislab_assembly(mesh, monkeypatch):
     assert got.shape[0] == 107 + pad
     np.testing.assert_array_equal(got[:107], X.toarray().astype(np.float32))
     assert not got[107:].any()
+
+
+def test_refit_w_rejects_generic_beta():
+    """Same contract as nmf_fit_rowsharded: a generic beta would silently
+    run the IS statistics under the wrong divergence (review finding)."""
+    from cnmf_torch_tpu.parallel.rowshard import refit_w_rowsharded
+
+    X = _lowrank(n=20, g=10, k=2)
+    H = np.abs(np.random.default_rng(0).normal(size=(20, 2))).astype(
+        np.float32)
+    with pytest.raises(ValueError, match="beta"):
+        refit_w_rowsharded(X, H, beta=0.5)
