@@ -165,7 +165,16 @@ def main(argv=None):
 
     # parse BEFORE any jax import: --help / usage errors must not pay the
     # backend-initialization cost or touch the cache directory
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    if args.command in ("prepare", "run_parallel"):
+        # fail as a usage error, not a traceback from deep inside prepare
+        missing = [flag for flag, val in
+                   (("--counts/-c", args.counts),
+                    ("--components/-k", args.components)) if val is None]
+        if missing:
+            parser.error(f"{args.command} requires {' and '.join(missing)}")
 
     # pod-simulation hook (set by the multihost launcher engine): force N
     # virtual CPU devices BEFORE the backend initializes. Env vars are too

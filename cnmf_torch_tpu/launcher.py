@@ -73,6 +73,26 @@ def run_pipeline(counts: str, output_dir: str, name: str,
     # the CLI's parser default is -1 ("all"); range(-1) would spawn zero
     # workers and the run would only fail much later at combine
     total_workers = max(int(total_workers), 1)
+    if engine not in ("subprocess", "multihost"):
+        raise ValueError(f"unknown engine {engine!r}")
+    if engine == "multihost" and devices_per_host is None:
+        # this process is about to initialize a JAX backend for prepare();
+        # N spawned children sharing the parent's real TPU runtime would
+        # contend for the chips and hang or crash. The local-spawn engine
+        # is only safe when each child gets its own virtual CPU devices; on
+        # a real pod, launch the same command on every host instead
+        # (docs/Stepwise_Guide.md). Checked BEFORE prepare so the
+        # misconfiguration costs seconds, not an atlas-scale prepare pass.
+        import jax
+
+        if jax.default_backend() not in ("cpu",):
+            raise RuntimeError(
+                "engine='multihost' without devices_per_host spawns "
+                "local JAX processes that would contend with this "
+                "process's %r backend. Pass devices_per_host=N for a "
+                "CPU-simulated pod, or launch one process per host "
+                "yourself with CNMF_PROCESS_ID/--distributed (see "
+                "docs/Stepwise_Guide.md)." % jax.default_backend())
     from .models.cnmf import cNMF
 
     obj = cNMF(output_dir=output_dir, name=name)
@@ -139,8 +159,6 @@ def run_pipeline(counts: str, output_dir: str, name: str,
             # engine's independent workers
             raise RuntimeError(
                 f"multihost factorize failed on processes {bad}")
-    else:
-        raise ValueError(f"unknown engine {engine!r}")
 
     obj.combine(skip_missing_files=any_failed)
     if k_selection:

@@ -347,7 +347,7 @@ class cNMF:
             if mesh == "2d":
                 mesh = mesh_2d()
             self._factorize_2d(jobs, run_params, norm_counts, _nmf_kwargs,
-                               mesh, worker_i)
+                               mesh, worker_i, replicates_per_batch)
             return
 
         if rowshard_threshold is None:
@@ -530,7 +530,7 @@ class cNMF:
              "init": nmf_kwargs.get("init", "random"),
              "tol": nmf_kwargs.get("tol", 1e-4),
              "n_passes": nmf_kwargs.get("n_passes", 20),
-             "chunk_max_iter": nmf_kwargs.get("online_chunk_max_iter", 200),
+             "chunk_max_iter": nmf_kwargs.get("online_chunk_max_iter", 1000),
              "alpha_W": nmf_kwargs.get("alpha_W", 0.0),
              "alpha_H": nmf_kwargs.get("alpha_H", 0.0),
              "mesh_devices": int(np.prod(mesh.devices.shape)),
@@ -545,7 +545,7 @@ class cNMF:
                 seed=int(p["nmf_seed"]),
                 tol=nmf_kwargs.get("tol", 1e-4),
                 n_passes=nmf_kwargs.get("n_passes", 20),
-                chunk_max_iter=nmf_kwargs.get("online_chunk_max_iter", 200),
+                chunk_max_iter=nmf_kwargs.get("online_chunk_max_iter", 1000),
                 alpha_W=nmf_kwargs.get("alpha_W", 0.0),
                 l1_ratio_W=nmf_kwargs.get("l1_ratio_W", 0.0),
                 alpha_H=nmf_kwargs.get("alpha_H", 0.0),
@@ -556,7 +556,7 @@ class cNMF:
             save_df_to_npz(df, self.paths["iter_spectra"] % (k, p["iter"]))
 
     def _factorize_2d(self, jobs, run_params, norm_counts, nmf_kwargs,
-                      mesh, worker_i):
+                      mesh, worker_i, replicates_per_batch=None):
         """Factorize over the 2-D (replicates, cells) mesh — the multi-host
         layout (``parallel/multihost.py``): each replicate row-shards its
         cells over the mesh's cell axis (psum'd W statistics on ICI), the
@@ -585,7 +585,7 @@ class cNMF:
                  "tol": nmf_kwargs.get("tol", 1e-4),
                  "n_passes": nmf_kwargs.get("n_passes", 20),
                  "chunk_max_iter": nmf_kwargs.get(
-                     "online_chunk_max_iter", 200),
+                     "online_chunk_max_iter", 1000),
                  "alpha_W": nmf_kwargs.get("alpha_W", 0.0),
                  "l1_ratio_W": nmf_kwargs.get("l1_ratio_W", 0.0),
                  "alpha_H": nmf_kwargs.get("alpha_H", 0.0),
@@ -609,11 +609,12 @@ class cNMF:
                 init=nmf_kwargs.get("init", "random"),
                 tol=nmf_kwargs.get("tol", 1e-4),
                 n_passes=nmf_kwargs.get("n_passes", 20),
-                chunk_max_iter=nmf_kwargs.get("online_chunk_max_iter", 200),
+                chunk_max_iter=nmf_kwargs.get("online_chunk_max_iter", 1000),
                 alpha_W=nmf_kwargs.get("alpha_W", 0.0),
                 l1_ratio_W=nmf_kwargs.get("l1_ratio_W", 0.0),
                 alpha_H=nmf_kwargs.get("alpha_H", 0.0),
-                l1_ratio_H=nmf_kwargs.get("l1_ratio_H", 0.0))
+                l1_ratio_H=nmf_kwargs.get("l1_ratio_H", 0.0),
+                replicates_per_batch=replicates_per_batch)
             if is_coordinator():
                 for r, it in enumerate(iters):
                     df = pd.DataFrame(spectra[r],

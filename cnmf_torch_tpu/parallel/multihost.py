@@ -261,9 +261,10 @@ def _sweep2d_program(n: int, g: int, k: int, R: int, init: str, beta: float,
 def replicate_sweep_2d(X, seeds, k: int, mesh: Mesh, beta_loss="frobenius",
                        init: str = "random",
                        tol: float = 1e-4, h_tol: float = 0.05,
-                       n_passes: int = 20, chunk_max_iter: int = 200,
+                       n_passes: int = 20, chunk_max_iter: int = 1000,
                        alpha_W: float = 0.0, l1_ratio_W: float = 0.0,
                        alpha_H: float = 0.0, l1_ratio_H: float = 0.0,
+                       replicates_per_batch: int | None = None,
                        fetch: bool = True):
     """Run ``len(seeds)`` NMF replicates over a 2-D (replicates, cells) mesh.
 
@@ -280,8 +281,10 @@ def replicate_sweep_2d(X, seeds, k: int, mesh: Mesh, beta_loss="frobenius",
     are benign: only the returned W depends on them, and zero rows
     contribute nothing to its psum'd statistics). Returns
     ``(spectra (R,k,g), errs (R,))`` — numpy on every host with
-    ``fetch=True`` (multi-host: all-gathered across processes), else device
-    arrays.
+    ``fetch=True`` (multi-host: all-gathered across processes).
+    ``fetch=False`` keeps device arrays single-process; multi-process it
+    still gathers to numpy (sliced sweeps' sharded handles cannot be
+    stitched without cross-host resharding).
     """
     beta = beta_loss_to_float(beta_loss)
     if beta not in (2.0, 1.0, 0.0):
@@ -295,28 +298,61 @@ def replicate_sweep_2d(X, seeds, k: int, mesh: Mesh, beta_loss="frobenius",
     R = len(seeds)
     if R == 0:
         return np.zeros((0, int(k), g), np.float32), np.zeros((0,), np.float32)
-    pad_r = (-R) % r_dim
-    padded = seeds + [seeds[i % R] for i in range(pad_r)]
 
     l1_W, l2_W = split_regularization(alpha_W, l1_ratio_W)
     l1_H, l2_H = split_regularization(alpha_H, l1_ratio_H)
 
-    prog = _sweep2d_program(n, g, int(k), len(padded), str(init), beta,
-                            float(tol), float(h_tol), int(n_passes),
-                            int(chunk_max_iter),
-                            l1_H, l2_H, l1_W, l2_W, mesh)
-    spectra_d, errs_d = prog(Xd, jnp.asarray(padded, jnp.uint32))
+    # memory-bounded slicing, same budget model as the 1-D sweep: per-device
+    # live state per replicate is H (n/c_dim rows) + W, and beta != 2
+    # materializes block x genes MU intermediates. _slice_specs keeps slices
+    # replicate-shard multiples; without this a wide sweep at atlas scale
+    # admits an unbounded (R/r_dim, n/c_dim, k) H stack per device and OOMs.
+    from .replicates import _slice_specs
 
-    if not fetch:
-        return spectra_d, errs_d
-    if jax.process_count() > 1:
+    n_local = -(-n // c_dim)
+    _, slices = _slice_specs(n_local, g, int(k), R, beta, "batch", n_local,
+                             replicates_per_batch, r_dim)
+
+    # every slice stays PADDED on device: trimming (w[:r]) or concatenating
+    # sharded arrays eagerly would cut across shard boundaries of
+    # non-fully-addressable arrays on a real multi-host pod — gather first,
+    # trim in numpy (single-process arrays are fully addressable, so the
+    # same order is merely free there)
+    parts = []
+    for start, r, r_pad in slices:
+        sl = seeds[start:start + r]
+        if r_pad > r:
+            sl = sl + [sl[i % r] for i in range(r_pad - r)]
+        prog = _sweep2d_program(n, g, int(k), len(sl), str(init), beta,
+                                float(tol), float(h_tol), int(n_passes),
+                                int(chunk_max_iter),
+                                l1_H, l2_H, l1_W, l2_W, mesh)
+        w, e = prog(Xd, jnp.asarray(sl, jnp.uint32))
+        parts.append((r, w, e))
+
+    multiproc = jax.process_count() > 1
+    if not fetch and not multiproc:
+        # device arrays, trimmed/concatenated (fully addressable here)
+        if len(parts) == 1:
+            r, w, e = parts[0]
+            return w[:r], e[:r]
+        return (jnp.concatenate([w[:r] for r, w, _ in parts]),
+                jnp.concatenate([e[:r] for r, _, e in parts]))
+
+    # fetch=True, or multi-process (where device handles of a sliced sweep
+    # cannot be safely stitched — every host needs the full result anyway)
+    if multiproc:
         from jax.experimental import multihost_utils
 
-        spectra = multihost_utils.process_allgather(spectra_d, tiled=True)
-        errs = multihost_utils.process_allgather(errs_d, tiled=True)
+        host_parts = [
+            (r, multihost_utils.process_allgather(w, tiled=True),
+             multihost_utils.process_allgather(e, tiled=True))
+            for r, w, e in parts]
     else:
-        spectra, errs = np.asarray(spectra_d), np.asarray(errs_d)
-    return spectra[:R], errs[:R]
+        host_parts = [(r, np.asarray(w), np.asarray(e)) for r, w, e in parts]
+    spectra = np.concatenate([w[:r] for r, w, _ in host_parts])
+    errs = np.concatenate([e[:r] for r, _, e in host_parts])
+    return spectra, errs
 
 
 def stage_x_2d(X, mesh: Mesh, dtype=jnp.float32):

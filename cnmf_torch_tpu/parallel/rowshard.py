@@ -267,7 +267,7 @@ def _fit_rowsharded_jit(X, H0, W0, mesh, axis, beta, tol, h_tol, n_passes,
 
 def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
                        seed: int = 0, tol: float = 1e-4, h_tol: float = 0.05,
-                       n_passes: int = 20, chunk_max_iter: int = 200,
+                       n_passes: int = 20, chunk_max_iter: int = 1000,
                        alpha_W: float = 0.0, l1_ratio_W: float = 0.0,
                        alpha_H: float = 0.0, l1_ratio_H: float = 0.0,
                        n_orig: int | None = None, init: str = "random"):

@@ -84,3 +84,14 @@ def test_cli_skip_completed(tmp_path, counts_file):
 def test_cli_rejects_bad_command(capsys):
     with pytest.raises(SystemExit):
         main(["frobnicate"])
+
+
+@pytest.mark.parametrize("command", ["prepare", "run_parallel"])
+def test_cli_requires_counts_and_components(command, capsys):
+    """Omitting -c/-k must die as a usage error, not a traceback from deep
+    inside prepare (advisor finding, round 3)."""
+    with pytest.raises(SystemExit) as exc:
+        main([command, "--output-dir", "/tmp/nonexistent-cnmf-test"])
+    assert exc.value.code == 2
+    err = capsys.readouterr().err
+    assert "--counts" in err and "--components" in err

@@ -100,6 +100,21 @@ def test_sweep2d_replicate_padding():
     np.testing.assert_allclose(spectra[0], spectra2[0], rtol=1e-5)
 
 
+def test_sweep2d_memory_bounded_slicing():
+    """replicates_per_batch slices a wide sweep into replicate-shard-multiple
+    batches (the 1-D path's OOM guard, now shared): sliced and unsliced
+    sweeps must agree replicate-for-replicate."""
+    X = _fixture_X()
+    mesh2 = mesh_2d(replicate_shards=2)
+    seeds = [3, 1, 4, 1, 5, 9]
+    full, errs_full = replicate_sweep_2d(X, seeds, k=2, mesh=mesh2,
+                                         n_passes=10)
+    sliced, errs_sl = replicate_sweep_2d(X, seeds, k=2, mesh=mesh2,
+                                         n_passes=10, replicates_per_batch=2)
+    np.testing.assert_allclose(sliced, full, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(errs_sl, errs_full, rtol=1e-5)
+
+
 def test_sweep2d_nndsvd_init():
     X = _fixture_X()
     mesh2 = mesh_2d(replicate_shards=2)
