@@ -53,10 +53,18 @@ from ..utils.profiling import StageTimer, trace
 
 __all__ = ["cNMF"]
 
+# Fallback when a hand-edited solver YAML omits online_chunk_max_iter — the
+# reference CLI's --max-nmf-iter default (cnmf.py:1424); prepare() always
+# persists the key. NOT the same knob as the usage-refit's inner cap, whose
+# reference default is 200 (fit_H_online, cnmf.py:264) and which this
+# pipeline always passes explicitly from the YAML.
+_DEFAULT_CHUNK_MAX_ITER = 1000
 
-def compute_tpm(input_counts: AnnDataLite) -> AnnDataLite:
-    """Per-cell scaling to 1e6 total counts (``cnmf.py:241-247``)."""
-    return normalize_total(input_counts, target_sum=1e6)
+
+def compute_tpm(input_counts: AnnDataLite, totals=None) -> AnnDataLite:
+    """Per-cell scaling to 1e6 total counts (``cnmf.py:241-247``);
+    ``totals`` threads precomputed row sums through (one matrix pass)."""
+    return normalize_total(input_counts, target_sum=1e6, totals=totals)
 
 
 def _timed(stage_name: str):
@@ -118,21 +126,33 @@ class cNMF:
         """
         input_counts = load_counts(counts_fn, densify=densify)
 
+        from ..ops.stats import (cell_scale_factors, column_moments_staged,
+                                 row_sums)
+
         if tpm_fn is None:
-            tpm = compute_tpm(input_counts)
+            # TPM = diag(1e6/rowsum) @ counts: its moments AND the raw-count
+            # moments (gene scaling, cnmf.py:674-679) come from ONE fused
+            # pass over the counts, and the row totals are computed once for
+            # both the TPM artifact and the moment pass
+            totals = row_sums(input_counts.X)
+            tpm_scale = cell_scale_factors(totals, 1e6)
+            tpm = compute_tpm(input_counts, totals=totals)
             write_h5ad(self.paths["tpm"], tpm)
-        elif tpm_fn.endswith(".h5ad") or tpm_fn.endswith(".mtx") or tpm_fn.endswith(".mtx.gz"):
-            tpm = load_counts(tpm_fn, densify=False)
-            write_h5ad(self.paths["tpm"], tpm)
+            counts_moments, tpm_moments = column_moments_staged(
+                input_counts.X, row_scale=tpm_scale)
         else:
-            tpm = load_counts(tpm_fn, densify=densify)
+            if tpm_fn.endswith((".h5ad", ".mtx", ".mtx.gz")):
+                tpm = load_counts(tpm_fn, densify=False)
+            else:
+                tpm = load_counts(tpm_fn, densify=densify)
             write_h5ad(self.paths["tpm"], tpm)
+            # separate TPM file: two unrelated matrices, one staged pass each
+            tpm_moments, _ = column_moments_staged(tpm.X)
+            counts_moments, _ = column_moments_staged(input_counts.X)
 
         # per-gene TPM mean/std, population moments (ddof=0) on both the
         # sparse and dense paths (cnmf.py:570-580)
-        from ..ops.stats import column_mean_var
-
-        gene_tpm_mean, gene_tpm_var = column_mean_var(tpm.X, ddof=0)
+        gene_tpm_mean, gene_tpm_var = tpm_moments
         input_tpm_stats = pd.DataFrame(
             [gene_tpm_mean, np.sqrt(gene_tpm_var)],
             index=["__mean", "__std"], columns=tpm.var.index,
@@ -146,7 +166,8 @@ class cNMF:
 
         norm_counts = self.get_norm_counts(
             input_counts, tpm, num_highvar_genes=num_highvar_genes,
-            high_variance_genes_filter=highvargenes)
+            high_variance_genes_filter=highvargenes,
+            tpm_moments=tpm_moments, counts_var0=counts_moments[1])
         self.save_norm_counts(norm_counts)
 
         replicate_params, run_params = self.get_nmf_iter_params(
@@ -158,29 +179,46 @@ class cNMF:
         self.save_nmf_iter_params(replicate_params, run_params)
 
     def get_norm_counts(self, counts, tpm, high_variance_genes_filter=None,
-                        num_highvar_genes=None):
+                        num_highvar_genes=None, tpm_moments=None,
+                        counts_var0=None):
         """HVG subset + unit-variance gene scaling WITHOUT centering
-        (``cnmf.py:624-698``); raises on cells with zero HVG counts."""
+        (``cnmf.py:624-698``); raises on cells with zero HVG counts.
+
+        ``tpm_moments`` / ``counts_var0``: optional precomputed TPM (mean,
+        var) and raw-count population variance over ALL genes — prepare()
+        derives both from one staged device pass; a column's moments are
+        unchanged by subsetting, so the HVG slice reuses them directly.
+        """
         if high_variance_genes_filter is None:
-            gene_stats, _ = highvar_genes(tpm.X, numgenes=num_highvar_genes)
+            gene_stats, _ = highvar_genes(tpm.X, numgenes=num_highvar_genes,
+                                          precomputed_moments=tpm_moments)
             high_variance_genes_filter = list(
                 tpm.var.index[gene_stats.high_var.values])
 
         norm_counts = counts[:, high_variance_genes_filter].copy()
         norm_counts.X = norm_counts.X.astype(np.float64)
 
+        n = counts.X.shape[0]
+        sub_var1 = None
+        if counts_var0 is not None and n > 1:
+            pos = counts.var.index.get_indexer(high_variance_genes_filter)
+            if (pos >= 0).all():
+                sub_var1 = np.asarray(counts_var0)[pos] * (n / (n - 1))
+
         if sp.issparse(tpm.X):
             # sparse path: zero-variance genes pass through unchanged
             # (sc.pp.scale semantics, cnmf.py:675)
             norm_counts.X, _ = scale_columns(norm_counts.X, ddof=1,
-                                             zero_std_to_one=True)
+                                             zero_std_to_one=True,
+                                             precomputed_var=sub_var1)
             if np.isnan(norm_counts.X.data).sum() > 0:
                 print("Warning NaNs in normalized counts matrix")
         else:
             # dense path: division by a zero std produces NaN; the reference
             # only warns (cnmf.py:679)
             norm_counts.X, _ = scale_columns(norm_counts.X, ddof=1,
-                                             zero_std_to_one=False)
+                                             zero_std_to_one=False,
+                                             precomputed_var=sub_var1)
             if np.isnan(norm_counts.X).sum().sum() > 0:
                 print("Warning NaNs in normalized counts matrix")
 
@@ -435,7 +473,7 @@ class cNMF:
                 tol=_nmf_kwargs.get("tol", 1e-4),
                 online_chunk_size=_nmf_kwargs.get("online_chunk_size", 5000),
                 online_chunk_max_iter=_nmf_kwargs.get(
-                    "online_chunk_max_iter", 1000),
+                    "online_chunk_max_iter", _DEFAULT_CHUNK_MAX_ITER),
                 alpha_W=_nmf_kwargs.get("alpha_W", 0.0),
                 l1_ratio_W=_nmf_kwargs.get("l1_ratio_W", 0.0),
                 alpha_H=_nmf_kwargs.get("alpha_H", 0.0),
@@ -476,7 +514,7 @@ class cNMF:
                 tol=_nmf_kwargs.get("tol", 1e-4),
                 online_chunk_size=_nmf_kwargs.get("online_chunk_size", 5000),
                 online_chunk_max_iter=_nmf_kwargs.get(
-                    "online_chunk_max_iter", 1000),
+                    "online_chunk_max_iter", _DEFAULT_CHUNK_MAX_ITER),
                 alpha_W=_nmf_kwargs.get("alpha_W", 0.0),
                 l1_ratio_W=_nmf_kwargs.get("l1_ratio_W", 0.0),
                 alpha_H=_nmf_kwargs.get("alpha_H", 0.0),
@@ -530,7 +568,7 @@ class cNMF:
              "init": nmf_kwargs.get("init", "random"),
              "tol": nmf_kwargs.get("tol", 1e-4),
              "n_passes": nmf_kwargs.get("n_passes", 20),
-             "chunk_max_iter": nmf_kwargs.get("online_chunk_max_iter", 1000),
+             "chunk_max_iter": nmf_kwargs.get("online_chunk_max_iter", _DEFAULT_CHUNK_MAX_ITER),
              "alpha_W": nmf_kwargs.get("alpha_W", 0.0),
              "alpha_H": nmf_kwargs.get("alpha_H", 0.0),
              "mesh_devices": int(np.prod(mesh.devices.shape)),
@@ -545,7 +583,7 @@ class cNMF:
                 seed=int(p["nmf_seed"]),
                 tol=nmf_kwargs.get("tol", 1e-4),
                 n_passes=nmf_kwargs.get("n_passes", 20),
-                chunk_max_iter=nmf_kwargs.get("online_chunk_max_iter", 1000),
+                chunk_max_iter=nmf_kwargs.get("online_chunk_max_iter", _DEFAULT_CHUNK_MAX_ITER),
                 alpha_W=nmf_kwargs.get("alpha_W", 0.0),
                 l1_ratio_W=nmf_kwargs.get("l1_ratio_W", 0.0),
                 alpha_H=nmf_kwargs.get("alpha_H", 0.0),
@@ -585,7 +623,7 @@ class cNMF:
                  "tol": nmf_kwargs.get("tol", 1e-4),
                  "n_passes": nmf_kwargs.get("n_passes", 20),
                  "chunk_max_iter": nmf_kwargs.get(
-                     "online_chunk_max_iter", 1000),
+                     "online_chunk_max_iter", _DEFAULT_CHUNK_MAX_ITER),
                  "alpha_W": nmf_kwargs.get("alpha_W", 0.0),
                  "l1_ratio_W": nmf_kwargs.get("l1_ratio_W", 0.0),
                  "alpha_H": nmf_kwargs.get("alpha_H", 0.0),
@@ -609,7 +647,7 @@ class cNMF:
                 init=nmf_kwargs.get("init", "random"),
                 tol=nmf_kwargs.get("tol", 1e-4),
                 n_passes=nmf_kwargs.get("n_passes", 20),
-                chunk_max_iter=nmf_kwargs.get("online_chunk_max_iter", 1000),
+                chunk_max_iter=nmf_kwargs.get("online_chunk_max_iter", _DEFAULT_CHUNK_MAX_ITER),
                 alpha_W=nmf_kwargs.get("alpha_W", 0.0),
                 l1_ratio_W=nmf_kwargs.get("l1_ratio_W", 0.0),
                 alpha_H=nmf_kwargs.get("alpha_H", 0.0),

@@ -1,6 +1,6 @@
 """Over-dispersed (high-variance) gene selection by Fano factor.
 
-JAX reimplementation of ``get_highvar_genes_sparse`` / ``get_highvar_genes``
+Reimplementation of ``get_highvar_genes_sparse`` / ``get_highvar_genes``
 (``/root/reference/src/cnmf/cnmf.py:133-238``): genes are scored by the ratio
 of their Fano factor (var/mean) to an expected-Fano line ``A^2 * mean + B^2``,
 where ``A`` comes from the top-20-mean genes' coefficient of variation and
@@ -8,17 +8,18 @@ where ``A`` comes from the top-20-mean genes' coefficient of variation and
 either top-``numgenes`` by ``fano_ratio`` or thresholded at
 ``T = 1 + std(fano in box)`` with a ``minimal_mean`` floor.
 
-The moment pass is the only O(cells x genes) work and runs on device via
-:func:`cnmf_torch_tpu.ops.stats.column_mean_var`; the scoring itself is
-O(genes) and computed in one fused jit.
+The O(cells x genes) moment pass runs through
+:func:`cnmf_torch_tpu.ops.stats.column_moments_staged` /
+:func:`~cnmf_torch_tpu.ops.stats.column_mean_var`; the scoring itself is
+O(genes) quantile/median/ranking work and runs on HOST in exact float64 —
+a jitted version spent ~70 s compiling TPU sorting networks for a
+5,000-element computation that numpy finishes in microseconds, and host f64
+reproduces the reference's pandas ranking exactly (no fp32 ties at the
+selection cutoff).
 """
 
 from __future__ import annotations
 
-import functools
-
-import jax
-import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
@@ -27,50 +28,51 @@ from .stats import column_mean_var
 __all__ = ["highvar_genes"]
 
 
-@functools.partial(jax.jit, static_argnames=("numgenes", "has_threshold"))
 def _fano_scores(mean, var, numgenes, has_threshold, expected_fano_threshold,
                  minimal_mean):
-    fano = var / mean
+    with np.errstate(divide="ignore", invalid="ignore"):
+        fano = var / mean
 
-    # A: min CV among the 20 highest-mean genes (cnmf.py:144-145)
-    top20 = jax.lax.top_k(mean, min(20, mean.shape[0]))[1]
-    A = jnp.min(jnp.sqrt(var[top20]) / mean[top20])
+        # A: min CV among the 20 highest-mean genes (cnmf.py:144-145);
+        # stable sort = pandas sort_values tie order
+        top20 = np.argsort(-mean, kind="stable")[: min(20, mean.shape[0])]
+        A = float(np.min(np.sqrt(var[top20]) / mean[top20]))
 
-    # winsor box: 10th-90th pctile in both mean and fano (cnmf.py:147-152).
-    # NaN fano (zero-mean genes) never enters the box: comparisons are False.
-    w_mean_low, w_mean_high = jnp.nanquantile(mean, jnp.array([0.10, 0.90]))
-    w_fano_low, w_fano_high = jnp.nanquantile(fano, jnp.array([0.10, 0.90]))
-    box = ((fano > w_fano_low) & (fano < w_fano_high)
-           & (mean > w_mean_low) & (mean < w_mean_high))
-    boxed_fano = jnp.where(box, fano, jnp.nan)
-    fano_median = jnp.nanmedian(boxed_fano)
-    B = jnp.sqrt(fano_median)
+        # winsor box: 10th-90th pctile in both mean and fano
+        # (cnmf.py:147-152); pandas .quantile skips NaN -> nanquantile. NaN
+        # fano (zero-mean genes) never enters the box: comparisons are False.
+        w_mean_low, w_mean_high = np.nanquantile(mean, [0.10, 0.90])
+        w_fano_low, w_fano_high = np.nanquantile(fano, [0.10, 0.90])
+        box = ((fano > w_fano_low) & (fano < w_fano_high)
+               & (mean > w_mean_low) & (mean < w_mean_high))
+        boxed = fano[box]
+        B = float(np.sqrt(np.median(boxed)))
 
-    expected_fano = (A ** 2) * mean + (B ** 2)
-    fano_ratio = fano / expected_fano
+        expected_fano = (A ** 2) * mean + (B ** 2)
+        fano_ratio = fano / expected_fano
 
     if numgenes is not None:
         # top-N selection; NaN ratios (zero-mean genes) sort last
-        score = jnp.where(jnp.isnan(fano_ratio), -jnp.inf, fano_ratio)
-        idx = jax.lax.top_k(score, numgenes)[1]
-        high_var = jnp.zeros(mean.shape, dtype=bool).at[idx].set(True)
-        T = jnp.nan
+        score = np.where(np.isnan(fano_ratio), -np.inf, fano_ratio)
+        idx = np.argsort(-score, kind="stable")[:numgenes]
+        high_var = np.zeros(mean.shape, dtype=bool)
+        high_var[idx] = True
+        T = np.nan
     else:
         if has_threshold:
-            T = expected_fano_threshold
+            T = float(expected_fano_threshold)
         else:
-            # pandas .std() on the boxed fano = sample std, ddof=1 (cnmf.py:167)
-            nbox = jnp.sum(box)
-            mu = jnp.nanmean(boxed_fano)
-            ssq = jnp.nansum((boxed_fano - mu) ** 2)
-            T = 1.0 + jnp.sqrt(ssq / jnp.maximum(nbox - 1, 1))
-        high_var = (fano_ratio > T) & (mean > minimal_mean)
+            # pandas .std() on the boxed fano = sample std, ddof=1
+            # (cnmf.py:167)
+            T = float(1.0 + boxed.std(ddof=1))
+        with np.errstate(invalid="ignore"):
+            high_var = (fano_ratio > T) & (mean > minimal_mean)
 
     return fano, expected_fano, fano_ratio, high_var, A, B, T
 
 
 def highvar_genes(X, expected_fano_threshold=None, minimal_mean: float = 0.5,
-                  numgenes: int | None = None):
+                  numgenes: int | None = None, precomputed_moments=None):
     """Score genes for over-dispersion; X is cells x genes (sparse or dense).
 
     Returns ``(gene_stats, params)`` with the same schema as the reference:
@@ -80,10 +82,19 @@ def highvar_genes(X, expected_fano_threshold=None, minimal_mean: float = 0.5,
     The reference's sparse path uses population variance (ddof=0 via
     StandardScaler, cnmf.py:138) and its dense path likewise (ddof=0,
     cnmf.py:192); both map to one kernel here.
+
+    ``precomputed_moments``: optional ``(mean, var)`` population moments of
+    X — prepare() already computes them for the tpm_stats artifact
+    (``cnmf.py:570-580``) from one fused moment pass
+    (:func:`~cnmf_torch_tpu.ops.stats.column_moments_staged`); passing them
+    here skips a redundant O(cells x genes) pass.
     """
-    mean, var = column_mean_var(X, ddof=0)
-    mean = jnp.asarray(mean, dtype=jnp.float32)
-    var = jnp.asarray(var, dtype=jnp.float32)
+    if precomputed_moments is not None:
+        mean, var = precomputed_moments
+    else:
+        mean, var = column_mean_var(X, ddof=0)
+    mean = np.asarray(mean, dtype=np.float64)
+    var = np.asarray(var, dtype=np.float64)
     # mirrors the reference's truthiness test `if not expected_fano_threshold`
     # (cnmf.py:166): None or 0.0 both fall back to the computed T
     has_threshold = bool(expected_fano_threshold)
@@ -91,16 +102,16 @@ def highvar_genes(X, expected_fano_threshold=None, minimal_mean: float = 0.5,
         mean, var,
         None if numgenes is None else min(int(numgenes), X.shape[1]),
         has_threshold,
-        jnp.float32(expected_fano_threshold if has_threshold else 0.0),
-        jnp.float32(minimal_mean),
+        expected_fano_threshold if has_threshold else 0.0,
+        minimal_mean,
     )
     gene_stats = pd.DataFrame({
-        "mean": np.asarray(mean, dtype=np.float64),
-        "var": np.asarray(var, dtype=np.float64),
-        "fano": np.asarray(fano, dtype=np.float64),
-        "expected_fano": np.asarray(expected_fano, dtype=np.float64),
-        "high_var": np.asarray(high_var),
-        "fano_ratio": np.asarray(fano_ratio, dtype=np.float64),
+        "mean": mean,
+        "var": var,
+        "fano": fano,
+        "expected_fano": expected_fano,
+        "high_var": high_var,
+        "fano_ratio": fano_ratio,
     })
     params = {
         "A": float(A), "B": float(B),

@@ -22,12 +22,12 @@ import jax.numpy as jnp
 import numpy as np
 import scipy.sparse as sp
 
-__all__ = ["column_mean_var", "normalize_total", "scale_columns", "row_sums"]
+__all__ = ["cell_scale_factors", "column_mean_var", "column_moments_staged",
+           "normalize_total", "scale_columns", "row_sums"]
 
 # Row-block size for streaming sparse buffers host->device. Large enough to
 # amortize transfer, small enough to bound device memory at atlas scale.
 _BLOCK_ROWS = 262_144
-
 
 @functools.partial(jax.jit, static_argnames=("n_cols",))
 def _sparse_block_sums(data, col_idx, n_cols):
@@ -113,6 +113,105 @@ def column_mean_var(X, ddof: int = 0, block_rows: int = _BLOCK_ROWS):
     return mean, var
 
 
+def column_moments_staged(X, row_scale=None, block_rows: int = _BLOCK_ROWS):
+    """Population (ddof=0) column moments of ``X`` — and, with ``row_scale``,
+    of the row-scaled matrix ``diag(row_scale) @ X`` — in one fused pass.
+
+    This is prepare's moment engine (``cnmf.py:570-580, 624-698``): it needs
+    moments of the TPM matrix (tpm_stats artifact + Fano HVG selection) AND
+    of the raw counts (gene unit-variance scaling). Both derive from the
+    same CSR buffers, so one pass computes both.
+
+    Deliberately HOST-side, exact float64: per-gene moments are O(nnz)
+    bookkeeping, not FLOP-heavy compute — ``np.bincount`` over the CSR
+    column indices beats shipping the matrix across the host->device link
+    (the round-3 profile: 24 s of a 26 s prepare was moment-pass transfers),
+    and exact f64 matches the reference's own numerics
+    (``StandardScaler``/numpy, ``cnmf.py:128-131, 570-580``) better than
+    any f32 device reduction. The FLOP-heavy stages (factorize, consensus)
+    are where the device earns its keep. Blocked accumulation bounds memory
+    at atlas scale (``block_rows`` rows of weights at a time).
+
+    Returns ``((raw_mean, raw_var), (scaled_mean, scaled_var))``; the scaled
+    pair is ``None`` when ``row_scale`` is None. Variances are population
+    (ddof=0); sample variance is ``var * n / (n - ddof)``.
+    """
+    n, g = X.shape
+    want_scaled = row_scale is not None
+    scale = np.asarray(row_scale, dtype=np.float64) if want_scaled else None
+
+    s1_raw = np.zeros((g,), dtype=np.float64)
+    s1_sc = np.zeros((g,), dtype=np.float64)
+    if sp.issparse(X):
+        X = X.tocsr()
+        nnz_per_col = np.zeros((g,), dtype=np.float64)
+
+        def block_views(block, start):
+            """f64 data and (optionally) its row-scaled view — derived per
+            block in BOTH passes rather than cached, so peak memory stays
+            O(block_rows of nnz), not O(nnz), at atlas scale."""
+            data = np.asarray(block.data, dtype=np.float64)
+            if not want_scaled:
+                return data, None
+            per_nnz = np.repeat(scale[start:start + block.shape[0]],
+                                np.diff(block.indptr))
+            return data, data * per_nnz
+
+        for i, block in enumerate(_iter_row_blocks(X, block_rows)):
+            if block.nnz == 0:
+                continue
+            data, sc = block_views(block, i * block_rows)
+            s1_raw += np.bincount(block.indices, weights=data, minlength=g)
+            nnz_per_col += np.bincount(block.indices, minlength=g)
+            if want_scaled:
+                s1_sc += np.bincount(block.indices, weights=sc, minlength=g)
+        mean_raw = s1_raw / n
+        mean_sc = s1_sc / n
+        ssq_raw = np.zeros((g,), dtype=np.float64)
+        ssq_sc = np.zeros((g,), dtype=np.float64)
+        for i, block in enumerate(_iter_row_blocks(X, block_rows)):
+            if block.nnz == 0:
+                continue
+            data, sc = block_views(block, i * block_rows)
+            idx = block.indices
+            d = data - mean_raw[idx]
+            ssq_raw += np.bincount(idx, weights=d * d, minlength=g)
+            if want_scaled:
+                ds = sc - mean_sc[idx]
+                ssq_sc += np.bincount(idx, weights=ds * ds, minlength=g)
+        # implicit zeros each contribute mean^2 to the centered sums
+        ssq_raw += (n - nnz_per_col) * mean_raw ** 2
+        if want_scaled:
+            ssq_sc += (n - nnz_per_col) * mean_sc ** 2
+    else:
+        Xd = np.asarray(X)
+        for i, block in enumerate(_iter_row_blocks(Xd, block_rows)):
+            start = i * block_rows
+            b = np.asarray(block, dtype=np.float64)
+            s1_raw += b.sum(axis=0)
+            if want_scaled:
+                s1_sc += (b * scale[start:start + b.shape[0], None]).sum(axis=0)
+        mean_raw = s1_raw / n
+        mean_sc = s1_sc / n
+        ssq_raw = np.zeros((g,), dtype=np.float64)
+        ssq_sc = np.zeros((g,), dtype=np.float64)
+        for i, block in enumerate(_iter_row_blocks(Xd, block_rows)):
+            start = i * block_rows
+            b = np.asarray(block, dtype=np.float64)
+            d = b - mean_raw[None, :]
+            ssq_raw += (d * d).sum(axis=0)
+            if want_scaled:
+                ds = (b * scale[start:start + b.shape[0], None]
+                      - mean_sc[None, :])
+                ssq_sc += (ds * ds).sum(axis=0)
+
+    var_raw = np.maximum(ssq_raw / n, 0.0)
+    raw = (mean_raw, var_raw)
+    if not want_scaled:
+        return raw, None
+    return raw, (mean_sc, np.maximum(ssq_sc / n, 0.0))
+
+
 def row_sums(X, block_rows: int = _BLOCK_ROWS) -> np.ndarray:
     """Per-row totals (counts per cell)."""
     n = X.shape[0]
@@ -133,17 +232,31 @@ def row_sums(X, block_rows: int = _BLOCK_ROWS) -> np.ndarray:
     return out
 
 
-def normalize_total(adata, target_sum: float = 1e6, inplace: bool = False):
+def cell_scale_factors(totals, target_sum: float) -> np.ndarray:
+    """Per-cell multipliers that bring each total to ``target_sum``;
+    zero-total cells get factor 1 (left at zero — ``sc.pp.normalize_total``
+    semantics, ``cnmf.py:241-247``). The ONE definition shared by
+    :func:`normalize_total` and prepare's fused moment pass, so the TPM
+    artifact and the TPM moments can never drift apart."""
+    totals = np.asarray(totals, dtype=np.float64)
+    return np.where(totals > 0,
+                    target_sum / np.where(totals > 0, totals, 1.0), 1.0)
+
+
+def normalize_total(adata, target_sum: float = 1e6, inplace: bool = False,
+                    totals=None):
     """Scale each cell to ``target_sum`` total counts.
 
     Equivalent of ``compute_tpm``'s ``sc.pp.normalize_total(tpm, 1e6)``
     (``cnmf.py:241-247``). Cells with zero total are left at zero.
-    Returns a new ``AnnDataLite`` unless ``inplace``.
+    Returns a new ``AnnDataLite`` unless ``inplace``. ``totals``: optional
+    precomputed :func:`row_sums` (skips a pass over the matrix).
     """
     from ..utils.anndata_lite import AnnDataLite
 
-    totals = row_sums(adata.X)
-    scale = np.where(totals > 0, target_sum / np.where(totals > 0, totals, 1.0), 1.0)
+    if totals is None:
+        totals = row_sums(adata.X)
+    scale = cell_scale_factors(totals, target_sum)
     if sp.issparse(adata.X):
         Xcsr = adata.X.tocsr()
         per_nnz = np.repeat(scale, np.diff(Xcsr.indptr))
@@ -160,7 +273,8 @@ def normalize_total(adata, target_sum: float = 1e6, inplace: bool = False):
     return AnnDataLite(X, adata.obs.copy(), adata.var.copy())
 
 
-def scale_columns(X, ddof: int = 1, zero_std_to_one: bool = True):
+def scale_columns(X, ddof: int = 1, zero_std_to_one: bool = True,
+                  precomputed_var=None):
     """Scale columns to unit variance WITHOUT centering.
 
     ``zero_std_to_one=True`` mirrors ``sc.pp.scale(zero_center=False)``
@@ -168,8 +282,15 @@ def scale_columns(X, ddof: int = 1, zero_std_to_one: bool = True):
     unchanged column; ``False`` mirrors the reference's dense path
     (``cnmf.py:679``) where division by a zero std produces NaN (the
     reference only warns). Returns (scaled matrix, std vector).
+
+    ``precomputed_var``: per-column variance ALREADY at the requested ddof
+    (prepare threads it from its one staged moment pass; the scaling itself
+    is then a single O(nnz) host op).
     """
-    _, var = column_mean_var(X, ddof=ddof)
+    if precomputed_var is not None:
+        var = np.asarray(precomputed_var, dtype=np.float64)
+    else:
+        _, var = column_mean_var(X, ddof=ddof)
     std = np.sqrt(var)
     div = std.copy()
     if zero_std_to_one:

@@ -43,6 +43,50 @@ def test_column_mean_var_blocked(counts_100x500):
     np.testing.assert_allclose(v1, v2, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("sparse", [True, False])
+@pytest.mark.parametrize("block_rows", [None, 17])
+def test_column_moments_staged_matches_unstaged(counts_100x500, sparse,
+                                                block_rows):
+    """The fused host-f64 moment engine (one-block AND blocked-accumulation
+    modes) must agree with column_mean_var for both the raw matrix and the
+    row-scaled (TPM) view — and with exact numpy f64."""
+    from cnmf_torch_tpu.ops.stats import column_moments_staged
+
+    X = sp.csr_matrix(counts_100x500) if sparse else counts_100x500
+    totals = counts_100x500.sum(axis=1)
+    scale = np.where(totals > 0, 1e6 / np.where(totals > 0, totals, 1.0), 1.0)
+    kw = {} if block_rows is None else {"block_rows": block_rows}
+    (rm, rv), (sm, sv) = column_moments_staged(X, row_scale=scale, **kw)
+    # exact f64: tight bars vs numpy
+    np.testing.assert_allclose(rm, counts_100x500.mean(axis=0), rtol=1e-12)
+    np.testing.assert_allclose(rv, counts_100x500.var(axis=0), rtol=1e-9,
+                               atol=1e-12)
+
+    m_ref, v_ref = column_mean_var(X, ddof=0)
+    np.testing.assert_allclose(rm, m_ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(rv, v_ref, rtol=1e-4, atol=1e-5)
+
+    tpm = counts_100x500 * scale[:, None]
+    np.testing.assert_allclose(sm, tpm.mean(axis=0), rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(sv, tpm.var(axis=0), rtol=1e-4, atol=1e-2)
+
+    (rm2, rv2), none = column_moments_staged(X, **kw)
+    assert none is None
+    np.testing.assert_allclose(rm2, m_ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(rv2, v_ref, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("sparse", [True, False])
+def test_scale_columns_precomputed_var(counts_100x500, sparse):
+    X = sp.csr_matrix(counts_100x500) if sparse else counts_100x500
+    ref, std_ref = scale_columns(X, ddof=1)
+    var1 = counts_100x500.var(axis=0, ddof=1)
+    got, std = scale_columns(X, ddof=1, precomputed_var=var1)
+    a = ref.toarray() if sparse else ref
+    b = got.toarray() if sparse else got
+    np.testing.assert_allclose(b, a, rtol=1e-4, atol=1e-6)
+
+
 def test_column_mean_var_matches_sklearn_standard_scaler(sparse_counts_100x500):
     # the reference's get_mean_var (cnmf.py:128-131) is StandardScaler-based
     from sklearn.preprocessing import StandardScaler
