@@ -75,14 +75,87 @@ def _lloyd(X, C0, max_iter: int, shift_tol):
     return labels, C, inertia
 
 
-@functools.partial(jax.jit, static_argnames=("k", "n_init", "max_iter"))
-def _kmeans_jit(X, k: int, n_init: int, max_iter: int, tol, key):
-    # sklearn scales tol by the mean per-feature variance of X
-    shift_tol = tol * jnp.mean(jnp.var(X, axis=0))
+def _kmeanspp_masked(key, X, k: int, mask):
+    """kmeans++ seeding restricted to rows with mask=1 (excluded rows have
+    zero selection probability, so they can never become centers)."""
+    n = X.shape[0]
+    key, sub = jax.random.split(key)
+    first = jax.random.choice(sub, n, p=mask / jnp.maximum(mask.sum(), 1e-30))
+    c0 = X[first]
+    min_d2 = jnp.sum((X - c0[None, :]) ** 2, axis=1)
 
-    def one(key):
-        C0 = _kmeanspp(key, X, k)
-        return _lloyd(X, C0, max_iter, shift_tol)
+    def pick(carry, sub):
+        min_d2 = carry
+        w = min_d2 * mask
+        # degenerate round (every masked row already at distance 0 from a
+        # center — the n_keep < k warn-and-degrade path): an all-zero w
+        # would let jax.random.choice return an arbitrary index, including
+        # a masked-out row; fall back to uniform over the masked rows
+        w = jnp.where(w.sum() > 1e-30, w, mask)
+        p = w / jnp.maximum(w.sum(), 1e-30)
+        idx = jax.random.choice(sub, n, p=p)
+        c = X[idx]
+        d2 = jnp.sum((X - c[None, :]) ** 2, axis=1)
+        return jnp.minimum(min_d2, d2), c
+
+    subs = jax.random.split(key, k - 1)
+    _, rest = jax.lax.scan(pick, min_d2, subs)
+    return jnp.concatenate([c0[None, :], rest], axis=0)
+
+
+def _lloyd_masked(X, C0, max_iter: int, shift_tol, mask):
+    """Lloyd iterations where only mask=1 rows contribute to center updates
+    and inertia. Labels are produced for EVERY row (callers discard the
+    masked-out ones); the clustering is exactly k-means on the masked
+    subset, at the full array's static shape."""
+    def assign(C):
+        return jnp.argmin(_sq_dists(X, C), axis=1)
+
+    def body(carry):
+        C, _, it = carry
+        labels = assign(C)
+        onehot = jax.nn.one_hot(labels, C.shape[0], dtype=X.dtype)
+        onehot = onehot * mask[:, None]
+        counts = onehot.sum(axis=0)
+        sums = onehot.T @ X
+        newC = jnp.where(counts[:, None] > 0,
+                         sums / jnp.maximum(counts, 1.0)[:, None], C)
+        shift = jnp.sum((newC - C) ** 2)
+        return (newC, shift, it + 1)
+
+    def cond(carry):
+        _, shift, it = carry
+        return (it < max_iter) & (shift > shift_tol)
+
+    C, _, _ = jax.lax.while_loop(
+        cond, body, (C0, jnp.asarray(jnp.inf, X.dtype), jnp.int32(0)))
+    labels = assign(C)
+    inertia = jnp.sum(jnp.min(_sq_dists(X, C), axis=1) * mask)
+    return labels, C, inertia
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "n_init", "max_iter", "has_mask"))
+def _kmeans_jit(X, k: int, n_init: int, max_iter: int, tol, key,
+                has_mask: bool = False, mask=None):
+    if has_mask:
+        # sklearn scales tol by the mean per-feature variance of the
+        # (masked-subset) data: equal-weight weighted population variance
+        wm = mask / jnp.maximum(mask.sum(), 1e-30)
+        mu = (X * wm[:, None]).sum(axis=0)
+        var = (wm[:, None] * (X - mu[None, :]) ** 2).sum(axis=0)
+        shift_tol = tol * jnp.mean(var)
+
+        def one(key):
+            C0 = _kmeanspp_masked(key, X, k, mask)
+            return _lloyd_masked(X, C0, max_iter, shift_tol, mask)
+    else:
+        # sklearn scales tol by the mean per-feature variance of X
+        shift_tol = tol * jnp.mean(jnp.var(X, axis=0))
+
+        def one(key):
+            C0 = _kmeanspp(key, X, k)
+            return _lloyd(X, C0, max_iter, shift_tol)
 
     labels, Cs, inertias = jax.vmap(one)(jax.random.split(key, n_init))
     best = jnp.argmin(inertias)
@@ -90,13 +163,28 @@ def _kmeans_jit(X, k: int, n_init: int, max_iter: int, tol, key):
 
 
 def kmeans(X, k: int, n_init: int = 10, max_iter: int = 300,
-           tol: float = 1e-4, seed: int = 1):
+           tol: float = 1e-4, seed: int = 1, mask=None):
     """Cluster rows of X; returns ``(labels, centers, inertia)`` as numpy.
 
     ``seed=1`` mirrors the reference's fixed ``random_state=1``
     (cnmf.py:1082) so repeated consensus runs are deterministic.
+
+    ``mask``: optional boolean/0-1 row weights. Rows with mask=0 are
+    excluded from seeding, center updates, and inertia — the clustering of
+    the masked subset at the FULL array's static shape, so a consensus
+    density-threshold sweep reuses ONE compiled program instead of
+    recompiling per surviving-row count (labels come back for every row;
+    callers subset them). Without ``mask`` the program (and its RNG stream)
+    is unchanged.
     """
     X = jnp.asarray(np.asarray(X), dtype=jnp.float32)
-    labels, C, inertia = _kmeans_jit(X, int(k), int(n_init), int(max_iter),
-                                     jnp.float32(tol), jax.random.key(seed))
+    if mask is None:
+        labels, C, inertia = _kmeans_jit(
+            X, int(k), int(n_init), int(max_iter), jnp.float32(tol),
+            jax.random.key(seed))
+    else:
+        mask = jnp.asarray(np.asarray(mask), dtype=jnp.float32)
+        labels, C, inertia = _kmeans_jit(
+            X, int(k), int(n_init), int(max_iter), jnp.float32(tol),
+            jax.random.key(seed), has_mask=True, mask=mask)
     return np.asarray(labels), np.asarray(C), float(inertia)

@@ -476,11 +476,17 @@ def fit_h(X, W, H_init=None, chunk_size: int = 5000, chunk_max_iter: int = 200,
     relative-change tolerance ``h_tol``, uniform random init when ``H_init``
     is None (clamped at zero otherwise).
 
-    Accepts numpy/scipy-sparse inputs; returns a numpy (n, k) array.
+    Accepts numpy/scipy-sparse inputs — or an already device-resident
+    ``jax.Array`` (the consensus stage stages X once and reuses it across
+    its three refits and the K sweep instead of re-crossing the host link
+    per call) — and returns a numpy (n, k) array.
     """
-    if sp.issparse(X):
-        X = X.toarray()
-    X = jnp.asarray(np.asarray(X), dtype=jnp.float32)
+    if isinstance(X, jax.Array):
+        X = X.astype(jnp.float32)
+    else:
+        if sp.issparse(X):
+            X = X.toarray()
+        X = jnp.asarray(np.asarray(X), dtype=jnp.float32)
     W = jnp.asarray(np.asarray(W), dtype=jnp.float32)
     n = X.shape[0]
     k = W.shape[0]

@@ -290,7 +290,12 @@ def scale_columns(X, ddof: int = 1, zero_std_to_one: bool = True,
     if precomputed_var is not None:
         var = np.asarray(precomputed_var, dtype=np.float64)
     else:
-        _, var = column_mean_var(X, ddof=ddof)
+        # host-f64 fused engine: exact, and no host->device round trips for
+        # what is O(nnz) bookkeeping (see column_moments_staged)
+        (_, var), _ = column_moments_staged(X)
+        n = X.shape[0]
+        if ddof and n > ddof:
+            var = var * (n / (n - ddof))
     std = np.sqrt(var)
     div = std.copy()
     if zero_std_to_one:

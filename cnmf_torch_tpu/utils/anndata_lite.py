@@ -282,6 +282,19 @@ def _read_X(node):
     raise ValueError(f"unsupported X encoding: {enc!r}")
 
 
+def peek_h5ad_shape(filename: str) -> tuple[int, int]:
+    """X's (n_obs, n_var) from the file metadata alone — no matrix read.
+    Used to pre-compile shape-keyed consensus programs before the matrix is
+    needed."""
+    import h5py
+
+    with h5py.File(filename, "r") as f:
+        node = f["X"]
+        if isinstance(node, h5py.Dataset):
+            return tuple(int(s) for s in node.shape)
+        return tuple(int(s) for s in node.attrs["shape"])
+
+
 def read_h5ad(filename: str) -> AnnDataLite:
     import h5py
 

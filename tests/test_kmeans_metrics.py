@@ -81,3 +81,32 @@ def test_silhouette_with_kmeans_labels():
     ours = silhouette_score(X, labels, k=3)
     theirs = sk_sil(X, np.asarray(labels), metric="euclidean")
     np.testing.assert_allclose(ours, theirs, rtol=1e-3, atol=1e-4)
+
+
+def test_kmeans_masked_rows_have_zero_influence():
+    """Masked k-means (the consensus density filter at static shape): rows
+    with mask=0 must not affect seeding, centers, labels of kept rows, or
+    inertia — swap the masked-out rows for different junk and everything
+    about the kept rows is identical."""
+    X, _ = _blobs(n_per=30, k=3, spread=0.5)
+    rng = np.random.default_rng(7)
+    junk_a = rng.normal(50.0, 5.0, size=(20, X.shape[1]))
+    junk_b = rng.normal(-80.0, 1.0, size=(20, X.shape[1]))
+    mask = np.concatenate([np.ones(X.shape[0]), np.zeros(20)]).astype(bool)
+
+    la, ca, ia = kmeans(np.vstack([X, junk_a]), 3, seed=1, mask=mask)
+    lb, cb, ib = kmeans(np.vstack([X, junk_b]), 3, seed=1, mask=mask)
+    np.testing.assert_array_equal(la[mask], lb[mask])
+    np.testing.assert_allclose(ca, cb, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(ia, ib, rtol=1e-5)
+
+
+def test_kmeans_masked_matches_subset_quality():
+    """The masked clustering of the kept rows must be as good as clustering
+    the subset directly (same data, same k): compare inertia."""
+    X, _ = _blobs(n_per=30, k=3, spread=0.5)
+    junk = np.full((15, X.shape[1]), 99.0)
+    mask = np.concatenate([np.ones(X.shape[0]), np.zeros(15)]).astype(bool)
+    _, _, inertia_masked = kmeans(np.vstack([X, junk]), 3, seed=1, mask=mask)
+    _, _, inertia_subset = kmeans(X, 3, seed=1)
+    assert inertia_masked <= inertia_subset * 1.05

@@ -280,3 +280,21 @@ def test_sequential_path_matches_batched(tmp_path):
         sa = load_df_from_npz(a.paths["iter_spectra"] % (3, it)).values
         sb = load_df_from_npz(b.paths["iter_spectra"] % (3, it)).values
         np.testing.assert_allclose(sa, sb, rtol=2e-3, atol=2e-4)
+
+
+def test_device_residency_cache_detects_content_change(tmp_path):
+    """The consensus device cache must not serve a stale matrix when a
+    same-shape but different-content X arrives (consensus accepts a
+    caller-supplied norm_counts)."""
+    import numpy as np
+
+    from cnmf_torch_tpu import cNMF
+
+    obj = cNMF(output_dir=str(tmp_path), name="cachetest")
+    a = np.random.default_rng(0).random((40, 30))
+    b = a * 2.0
+    da = obj._stage_dense("norm_counts", a)
+    da2 = obj._stage_dense("norm_counts", a)
+    assert da2 is da  # same content -> cache hit
+    db = obj._stage_dense("norm_counts", b)
+    np.testing.assert_allclose(np.asarray(db), b.astype(np.float32))
