@@ -153,14 +153,17 @@ def bench_north_star():
         os.path.join(workdir, "ns", "cnmf_tmp", "ns.timings.tsv"))
     shutil.rmtree(workdir)
     e2e = factorize_cold + combine_s + consensus_s
+    prepare_s = stages.get("prepare", 0.0)
     return {
         "e2e_seconds": round(e2e, 3),
+        # the wall-clock a user actually experiences, prepare included
+        "e2e_with_prepare_seconds": round(prepare_s + e2e, 3),
         "factorize_cold_seconds": round(factorize_cold, 3),
         "factorize_warm_seconds": round(factorize_warm, 3),
         "compile_overhead_seconds": round(factorize_cold - factorize_warm, 3),
         "combine_seconds": round(combine_s, 3),
         "consensus_seconds": round(consensus_s, 3),
-        "prepare_seconds": round(stages.get("prepare", 0.0), 3),
+        "prepare_seconds": round(prepare_s, 3),
         "vs_baseline": round(NORTH_STAR_BASELINE_SECONDS / e2e, 2),
     }
 

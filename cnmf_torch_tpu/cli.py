@@ -125,6 +125,12 @@ def build_parser() -> argparse.ArgumentParser:
                              "/ CNMF_PROCESS_ID before running (multi-host "
                              "pods; also implied when those env vars are "
                              "set)")
+    parser.add_argument("--per-k-programs", action="store_true",
+                        default=False,
+                        help="[factorize] Force one compiled program per K; "
+                             "by default quick multi-K scans (>=4 Ks, <=32 "
+                             "replicates per K) run as one packed K_max "
+                             "program with bit-identical spectra")
     parser.add_argument("--engine", type=str, default="subprocess",
                         choices=["subprocess", "multihost"],
                         help="[run_parallel] How factorize workers run: "
@@ -211,6 +217,8 @@ def main(argv=None):
                             str(args.rowshard_threshold)]
         if args.skip_completed_runs:
             factorize_flags.append("--skip-completed-runs")
+        if args.per_k_programs:
+            factorize_flags.append("--per-k-programs")
 
         run_pipeline(
             args.counts, args.output_dir, args.name,
@@ -251,7 +259,8 @@ def main(argv=None):
             batched=not args.sequential,
             mesh="2d" if args.mesh_2d else None,
             rowshard=args.rowshard,
-            rowshard_threshold=args.rowshard_threshold)
+            rowshard_threshold=args.rowshard_threshold,
+            packed=False if args.per_k_programs else None)
 
     elif args.command == "combine":
         cnmf_obj.combine(components=args.components)

@@ -10,6 +10,7 @@ from .replicates import (
     clear_sweep_cache,
     default_mesh,
     replicate_sweep,
+    replicate_sweep_packed,
     warm_sweep_programs,
     worker_filter,
 )
@@ -23,6 +24,7 @@ __all__ = [
     "is_coordinator",
     "mesh_2d",
     "replicate_sweep",
+    "replicate_sweep_packed",
     "replicate_sweep_2d",
     "sync_hosts",
     "warm_sweep_programs",

@@ -36,8 +36,8 @@ from ..ops.nmf import (
     split_regularization,
 )
 
-__all__ = ["replicate_sweep", "worker_filter", "default_mesh",
-           "auto_replicates_per_batch", "clear_sweep_cache",
+__all__ = ["replicate_sweep", "replicate_sweep_packed", "worker_filter",
+           "default_mesh", "auto_replicates_per_batch", "clear_sweep_cache",
            "warm_sweep_programs"]
 
 
@@ -226,7 +226,8 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
                    beta: float, tol: float, h_tol: float, chunk: int,
                    chunk_max_iter: int, n_passes: int, batch_max_iter: int,
                    l1_H: float, l2_H: float, l1_W: float, l2_W: float,
-                   mesh: Mesh | None, return_usages: bool):
+                   mesh: Mesh | None, return_usages: bool,
+                   packed: bool = False):
     """Build (once per static configuration) the jitted sweep executable
     ``(X (n,g), seeds (R,)) -> (usages | (0,), spectra (R,k,g), errs (R,))``.
 
@@ -234,6 +235,15 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
     inside ONE jit so a steady-state sweep call is a single cached XLA
     dispatch. (Building the vmap wrapper per call re-traced the whole solver
     through Python each time, which cost ~3x the actual device time.)
+
+    ``packed=True`` builds the PACKED K-sweep variant: ``k`` is K_max, the
+    program additionally takes the slice's actual component count (a traced
+    scalar), and replicates initialize at K_max via the threefry
+    flat-prefix gather (a draw of shape ``(n, k)`` equals the flat draw's
+    prefix, so the padded init reproduces the per-K init bit-exactly with
+    exact-zero padding) — zeros MU provably keeps at zero, so one
+    executable covers every K of a sweep with per-seed results
+    bit-identical to the per-K programs (tested). ``init='random'`` only.
     """
     spec = (None if mesh is None
             else NamedSharding(mesh, P(mesh.axis_names[0], None, None)))
@@ -254,17 +264,170 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
     else:
         raise ValueError(f"unknown mode {mode!r}")
 
-    def sweep(X, seeds):
-        H0, W0 = _stacked_inits(X, k, seeds, init)
-        if spec is not None:
-            H0 = jax.lax.with_sharding_constraint(H0, spec)
-            W0 = jax.lax.with_sharding_constraint(W0, spec)
-        H, W, err = jax.vmap(solve, in_axes=(None, 0, 0))(X, H0, W0)
-        # drop the usage stack inside the program when the caller doesn't
-        # want it — saves the (R, n, k) device->host transfer
-        return (H if return_usages else jnp.zeros((0,), X.dtype)), W, err
+    if packed:
+        if init != "random":
+            raise ValueError("packed K-sweeps require init='random'")
+
+        def sweep(X, seeds, k_actual):
+            # batched padded random_init: all replicates of a slice share
+            # one K, so the prefix-gather index grid is computed once and
+            # applied as a single batched take — a per-replicate vmapped
+            # gather with traced k blew XLA compile up 5x
+            x_mean = jnp.mean(X)
+            kf = k_actual.astype(jnp.float32)
+            avg = jnp.sqrt(jnp.maximum(x_mean, 1e-16) / kf)
+
+            def draws(s):
+                kh, kw = jax.random.split(jax.random.key(s))
+                return (jax.random.normal(kh, (n * k,), jnp.float32),
+                        jax.random.normal(kw, (k, g), jnp.float32))
+
+            FH, FW = jax.vmap(draws)(seeds)
+            cols = jnp.arange(k)[None, :]
+            idx = jnp.clip(jnp.arange(n)[:, None] * k_actual + cols,
+                           0, n * k - 1)
+            H0 = jnp.where(cols[None, :, :] < k_actual,
+                           avg * jnp.abs(jnp.take(FH, idx, axis=1)), 0.0)
+            W0 = jnp.where((jnp.arange(k)[:, None] < k_actual)[None],
+                           avg * jnp.abs(FW), 0.0)
+            if spec is not None:
+                H0 = jax.lax.with_sharding_constraint(H0, spec)
+                W0 = jax.lax.with_sharding_constraint(W0, spec)
+            H, W, err = jax.vmap(solve, in_axes=(None, 0, 0))(X, H0, W0)
+            return (H if return_usages
+                    else jnp.zeros((0,), X.dtype)), W, err
+    else:
+        def sweep(X, seeds):
+            H0, W0 = _stacked_inits(X, k, seeds, init)
+            if spec is not None:
+                H0 = jax.lax.with_sharding_constraint(H0, spec)
+                W0 = jax.lax.with_sharding_constraint(W0, spec)
+            H, W, err = jax.vmap(solve, in_axes=(None, 0, 0))(X, H0, W0)
+            # drop the usage stack inside the program when the caller
+            # doesn't want it — saves the (R, n, k) device->host transfer
+            return (H if return_usages else jnp.zeros((0,), X.dtype)), W, err
 
     return jax.jit(sweep)
+
+
+def replicate_sweep_packed(X, ks, seeds, beta_loss="frobenius",
+                           mode: str = "online", tol: float = 1e-4,
+                           online_chunk_size: int = 5000,
+                           online_chunk_max_iter: int = 1000,
+                           batch_max_iter: int = 500, n_passes: int = 20,
+                           alpha_W: float = 0.0, l1_ratio_W: float = 0.0,
+                           alpha_H: float = 0.0, l1_ratio_H: float = 0.0,
+                           mesh: Mesh | None = None,
+                           return_usages: bool = False,
+                           replicates_per_batch: int | None = None,
+                           online_h_tol: float = 1e-3, fetch: bool = True,
+                           on_slice=None):
+    """Run an entire multi-K sweep — ``len(seeds)`` (k, seed) tasks — as ONE
+    compiled program at ``K_max``.
+
+    The per-K path (:func:`replicate_sweep`) compiles one executable per
+    (K, slice) — the cold-compile wall of a K=5..13 production sweep. Here
+    every replicate runs at the static shape ``K_max`` with its components
+    beyond ``k`` initialized to exact zeros, which the MU update provably
+    keeps at zero (its numerator carries a factor of the zero entry), and
+    trailing zeros never perturb any reduction — so per-(seed, k) spectra
+    are BIT-IDENTICAL to the per-K programs' (pinned by
+    ``tests/test_parallel.py``) while the whole sweep costs one compile and
+    one dispatch per memory slice. ``init='random'`` only (the nndsvd
+    family's SVD base is K-truncated; use the per-K path there).
+
+    Returns ``(spectra (R, K_max, g), usages (R, n, K_max) | None,
+    errs (R,))`` in task order — callers trim row/component padding per
+    task (``spectra[r][:ks[r]]``).
+
+    ``on_slice(task_indices, spectra (r,K_max,g), errs (r,))`` — optional
+    callback invoked with fetched numpy results as each execution slice
+    completes, so callers can land per-task artifacts eagerly (crash-resume
+    keeps working mid-sweep). When given, the function returns ``None``
+    instead of accumulating the full result.
+    """
+    if not isinstance(X, jax.Array):
+        if sp.issparse(X):
+            X = X.toarray()
+        X = jnp.asarray(np.asarray(X), dtype=jnp.float32)
+    n, g = X.shape
+    beta = beta_loss_to_float(beta_loss)
+    ks = [int(v) for v in ks]
+    seeds = [int(s) & 0x7FFFFFFF for s in seeds]
+    if len(ks) != len(seeds):
+        raise ValueError("ks and seeds must have equal length")
+    R = len(seeds)
+    if R == 0:
+        return (np.zeros((0, 0, g), np.float32),
+                np.zeros((0, n, 0), np.float32) if return_usages else None,
+                np.zeros((0,), np.float32))
+    kmax = max(ks)
+
+    l1_W, l2_W = split_regularization(alpha_W, l1_ratio_W)
+    l1_H, l2_H = split_regularization(alpha_H, l1_ratio_H)
+    n_dev = 1 if mesh is None else math.prod(mesh.devices.shape)
+
+    if mesh is not None:
+        target = NamedSharding(mesh, P())
+        if X.sharding != target:
+            X = jax.device_put(X, target)
+
+    # execution slices are grouped BY K: the vmapped solver's while_loops
+    # run to the max over the batch, so mixing Ks in one slice makes every
+    # small-K replicate ride the largest K's convergence tail (measured 5x
+    # on the K=5..13 production sweep). Per-K slices keep per-K tails and
+    # batch shapes — the ONE K-agnostic executable is still shared by every
+    # K whose slice size matches (equal n_iter => one (R_slice) program).
+    by_k: dict[int, list[int]] = {}
+    for i, kv in enumerate(ks):
+        by_k.setdefault(kv, []).append(i)
+
+    order: list[int] = []
+    parts = []
+    for kv in sorted(by_k):
+        idxs = by_k[kv]
+        _, slices = _slice_specs(n, g, kmax, len(idxs), beta, mode,
+                                 online_chunk_size, replicates_per_batch,
+                                 n_dev)
+        for start, r, r_pad in slices:
+            sl_idx = idxs[start:start + r]
+            sl_s = [seeds[i] for i in sl_idx]
+            if r_pad > r:
+                sl_s = sl_s + [sl_s[i % r] for i in range(r_pad - r)]
+            prog = _sweep_program(
+                n, g, kmax, len(sl_s), "random", mode, beta, float(tol),
+                float(online_h_tol), int(min(online_chunk_size, n)),
+                int(online_chunk_max_iter), int(n_passes),
+                int(batch_max_iter), l1_H, l2_H, l1_W, l2_W, mesh,
+                bool(return_usages), packed=True)
+            H, W, err = prog(X, np.asarray(sl_s, np.uint32), np.int32(kv))
+            if on_slice is not None:
+                on_slice(sl_idx, np.asarray(W[:r]), np.asarray(err[:r]))
+                continue
+            order.extend(sl_idx)
+            parts.append((H[:r] if return_usages else None, W[:r], err[:r]))
+
+    if on_slice is not None:
+        return None
+
+    # scatter back to input task order
+    inv = np.argsort(np.asarray(order))
+    if len(parts) == 1:
+        usages_d, spectra_d, errs_d = parts[0]
+    else:
+        usages_d = (jnp.concatenate([p[0] for p in parts])
+                    if return_usages else None)
+        spectra_d = jnp.concatenate([p[1] for p in parts])
+        errs_d = jnp.concatenate([p[2] for p in parts])
+    spectra_d = spectra_d[inv]
+    errs_d = errs_d[inv]
+    if return_usages:
+        usages_d = usages_d[inv]
+    if not fetch:
+        return spectra_d, usages_d, errs_d
+    return (np.asarray(spectra_d),
+            np.asarray(usages_d) if return_usages else None,
+            np.asarray(errs_d))
 
 
 def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random",

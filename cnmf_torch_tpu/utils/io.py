@@ -36,9 +36,21 @@ __all__ = [
 ]
 
 
-def save_df_to_npz(obj: pd.DataFrame, filename: str):
-    """Byte-compatible with the reference serializer (``cnmf.py:32-33``)."""
-    np.savez_compressed(
+def save_df_to_npz(obj: pd.DataFrame, filename: str, compress: bool | None = None):
+    """Same container as the reference serializer (``cnmf.py:32-33``): an
+    npz holding ``data``/``index``/``columns`` arrays, loadable by either
+    implementation's ``load_df_from_npz`` (``np.load`` reads compressed and
+    stored members alike).
+
+    ``compress=None`` (default) compresses small artifacts like the
+    reference but STORES matrices over 2 MB: single-threaded deflate on a
+    merged-spectra matrix costs ~20x its write time for ~6% size (dense
+    f64 spectra barely compress), and combine's wall was mostly zlib.
+    """
+    if compress is None:
+        compress = obj.values.nbytes <= (2 << 20)
+    writer = np.savez_compressed if compress else np.savez
+    writer(
         filename,
         data=obj.values,
         index=obj.index.values,

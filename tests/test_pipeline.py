@@ -147,6 +147,7 @@ def test_factorize_records_provenance(e2e_run):
     obj, _ = e2e_run
     with open(obj.paths["factorize_provenance"] % 0) as f:
         record = yaml.safe_load(f)
+    # 2 Ks x 6 replicates -> auto resolves to the per-K programs
     assert record["engaged_path"] == "batched"
     assert record["effective_params"]["beta_loss"] == "frobenius"
     assert "mesh_devices" in record["effective_params"]
