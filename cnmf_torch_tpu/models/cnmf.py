@@ -39,7 +39,8 @@ from ..ops import (
     scale_columns,
     silhouette_score,
 )
-from ..ops.nmf import beta_loss_to_float, fit_h, run_nmf
+from ..ops.nmf import (beta_loss_to_float, fit_h, resolve_online_schedule,
+                       run_nmf)
 from ..parallel import replicate_sweep, worker_filter
 from ..utils.anndata_lite import AnnDataLite, read_h5ad, write_h5ad
 from ..utils.io import (
@@ -531,18 +532,28 @@ class cNMF:
             # amortize X reads) and the per-K programs' concurrent AOT
             # warming already collapses their compile wall — so production
             # sweeps keep per-K programs.
+            # the regime test uses LEDGER-wide replicate counts (per-worker
+            # shards of a 100-replicate production sweep must not flip into
+            # the slower packed path just because each worker sees few)
             packed = (_nmf_kwargs["init"] == "random" and len(by_k) >= 4
                       and max((len(t) for t in by_k.values()), default=0)
-                      <= 32)
+                      * max(1, int(total_workers)) <= 32)
         elif packed and _nmf_kwargs["init"] != "random":
             raise ValueError(
                 "packed K-sweeps require init='random' (the nndsvd family's "
                 "SVD base is K-truncated); rerun with packed=False / "
                 "--per-k-programs")
 
+        # the resolved per-loss online schedule (ops/nmf.py:
+        # resolve_online_schedule) is an execution detail the ledger YAML
+        # doesn't carry — record what will actually run
+        _h_tol_eff, _n_passes_eff = resolve_online_schedule(
+            beta_loss_to_float(_nmf_kwargs["beta_loss"]),
+            _nmf_kwargs.get("online_h_tol"), _nmf_kwargs.get("n_passes"))
         self._save_factorize_provenance(
             "batched-packed" if packed else "batched", worker_i,
             dict({k: v for k, v in _nmf_kwargs.items() if k != "n_jobs"},
+                 online_h_tol=_h_tol_eff, n_passes=_n_passes_eff,
                  mesh_devices=(1 if mesh is None
                                else int(np.prod(mesh.devices.shape)))))
 
@@ -681,6 +692,9 @@ class cNMF:
             mesh = Mesh(np.asarray(jax.devices()[:1]), ("cells",))
 
         Xd, n_orig = prepare_rowsharded(norm_counts.X, mesh)
+        _, n_passes_eff = resolve_online_schedule(
+            beta_loss_to_float(nmf_kwargs["beta_loss"]), 0.05,
+            nmf_kwargs.get("n_passes"))
         print("[Worker %d]. Row-sharded factorize: %d cells over %d devices, "
               "%d tasks." % (worker_i, n_orig,
                              int(np.prod(mesh.devices.shape)), len(jobs)))
@@ -691,7 +705,7 @@ class cNMF:
             {"beta_loss": nmf_kwargs["beta_loss"],
              "init": nmf_kwargs.get("init", "random"),
              "tol": nmf_kwargs.get("tol", 1e-4),
-             "n_passes": nmf_kwargs.get("n_passes", 20),
+             "n_passes": n_passes_eff,
              "chunk_max_iter": nmf_kwargs.get("online_chunk_max_iter", _DEFAULT_CHUNK_MAX_ITER),
              "alpha_W": nmf_kwargs.get("alpha_W", 0.0),
              "alpha_H": nmf_kwargs.get("alpha_H", 0.0),
@@ -706,7 +720,7 @@ class cNMF:
                 init=nmf_kwargs.get("init", "random"),
                 seed=int(p["nmf_seed"]),
                 tol=nmf_kwargs.get("tol", 1e-4),
-                n_passes=nmf_kwargs.get("n_passes", 20),
+                n_passes=n_passes_eff,
                 chunk_max_iter=nmf_kwargs.get("online_chunk_max_iter", _DEFAULT_CHUNK_MAX_ITER),
                 alpha_W=nmf_kwargs.get("alpha_W", 0.0),
                 l1_ratio_W=nmf_kwargs.get("l1_ratio_W", 0.0),
@@ -733,6 +747,9 @@ class cNMF:
         from ..parallel.multihost import replicate_sweep_2d, stage_x_2d
 
         Xd = stage_x_2d(norm_counts.X, mesh)
+        _, n_passes_eff = resolve_online_schedule(
+            beta_loss_to_float(nmf_kwargs["beta_loss"]), 0.05,
+            nmf_kwargs.get("n_passes"))
         n_orig = int(norm_counts.X.shape[0])
         r_dim, c_dim = mesh.devices.shape
         print("[Worker %d]. 2-D factorize: %d cells x %d replicate shards "
@@ -745,7 +762,7 @@ class cNMF:
                 {"beta_loss": nmf_kwargs["beta_loss"],
                  "init": nmf_kwargs.get("init", "random"),
                  "tol": nmf_kwargs.get("tol", 1e-4),
-                 "n_passes": nmf_kwargs.get("n_passes", 20),
+                 "n_passes": n_passes_eff,
                  "chunk_max_iter": nmf_kwargs.get(
                      "online_chunk_max_iter", _DEFAULT_CHUNK_MAX_ITER),
                  "alpha_W": nmf_kwargs.get("alpha_W", 0.0),
@@ -770,7 +787,7 @@ class cNMF:
                 beta_loss=nmf_kwargs["beta_loss"],
                 init=nmf_kwargs.get("init", "random"),
                 tol=nmf_kwargs.get("tol", 1e-4),
-                n_passes=nmf_kwargs.get("n_passes", 20),
+                n_passes=n_passes_eff,
                 chunk_max_iter=nmf_kwargs.get("online_chunk_max_iter", _DEFAULT_CHUNK_MAX_ITER),
                 alpha_W=nmf_kwargs.get("alpha_W", 0.0),
                 l1_ratio_W=nmf_kwargs.get("l1_ratio_W", 0.0),
@@ -906,6 +923,7 @@ class cNMF:
         statistics / streamed row blocks
         (:func:`~cnmf_torch_tpu.parallel.rowshard.refit_w_rowsharded`)."""
         if X.shape[0] >= self.rowshard_threshold:
+            from ..parallel import default_mesh
             from ..parallel.rowshard import refit_w_rowsharded
 
             with open(self.paths["nmf_run_parameters"]) as f:
@@ -915,7 +933,10 @@ class cNMF:
                 beta=beta_loss_to_float(kwargs["beta_loss"]),
                 h_tol=0.05,
                 max_iter=int(kwargs["online_chunk_max_iter"]),
-                l1_reg_W=float(kwargs["l1_ratio_W"]))
+                l1_reg_W=float(kwargs["l1_ratio_W"]),
+                # row-shard the beta != 2 staged refit over all chips (the
+                # beta=2 path is k-sized statistics; mesh is unused there)
+                mesh=default_mesh(axis_name="cells"))
         return self.refit_usage(X.T, np.asarray(usage).T).T
 
     def _warm_consensus_programs(self, R, k, n_hv, g_hv, n_neighbors,

@@ -45,6 +45,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from ..ops.nmf import (
+    resolve_online_schedule,
     _nndsvd_from_svd,
     beta_loss_to_float,
     gram_svd_base,
@@ -261,7 +262,8 @@ def _sweep2d_program(n: int, g: int, k: int, R: int, init: str, beta: float,
 def replicate_sweep_2d(X, seeds, k: int, mesh: Mesh, beta_loss="frobenius",
                        init: str = "random",
                        tol: float = 1e-4, h_tol: float = 0.05,
-                       n_passes: int = 20, chunk_max_iter: int = 1000,
+                       n_passes: int | None = None,
+                       chunk_max_iter: int = 1000,
                        alpha_W: float = 0.0, l1_ratio_W: float = 0.0,
                        alpha_H: float = 0.0, l1_ratio_H: float = 0.0,
                        replicates_per_batch: int | None = None,
@@ -287,6 +289,7 @@ def replicate_sweep_2d(X, seeds, k: int, mesh: Mesh, beta_loss="frobenius",
     stitched without cross-host resharding).
     """
     beta = beta_loss_to_float(beta_loss)
+    _, n_passes = resolve_online_schedule(beta, h_tol, n_passes)
     if beta not in (2.0, 1.0, 0.0):
         raise ValueError(
             f"replicate_sweep_2d supports beta in {{2, 1, 0}}, got {beta}")

@@ -33,6 +33,7 @@ from ..ops.nmf import (
     nmf_fit_online,
     nndsvd_init,
     random_init,
+    resolve_online_schedule,
     split_regularization,
 )
 
@@ -121,12 +122,13 @@ def warm_sweep_programs(n: int, g: int, k_to_count: dict,
                         mode: str = "online", tol: float = 1e-4,
                         online_chunk_size: int = 5000,
                         online_chunk_max_iter: int = 1000,
-                        batch_max_iter: int = 500, n_passes: int = 20,
+                        batch_max_iter: int = 500,
+                        n_passes: int | None = None,
                         alpha_W: float = 0.0, l1_ratio_W: float = 0.0,
                         alpha_H: float = 0.0, l1_ratio_H: float = 0.0,
                         mesh: Mesh | None = None, return_usages: bool = False,
                         replicates_per_batch: int | None = None,
-                        online_h_tol: float = 1e-3,
+                        online_h_tol: float | None = None,
                         max_workers: int | None = None) -> int:
     """Compile every sweep executable a K-sweep will need, CONCURRENTLY.
 
@@ -147,6 +149,8 @@ def warm_sweep_programs(n: int, g: int, k_to_count: dict,
     import concurrent.futures
 
     beta = beta_loss_to_float(beta_loss)
+    online_h_tol, n_passes = resolve_online_schedule(beta, online_h_tol,
+                                                     n_passes)
     l1_W, l2_W = split_regularization(alpha_W, l1_ratio_W)
     l1_H, l2_H = split_regularization(alpha_H, l1_ratio_H)
     n_dev = 1 if mesh is None else math.prod(mesh.devices.shape)
@@ -314,13 +318,15 @@ def replicate_sweep_packed(X, ks, seeds, beta_loss="frobenius",
                            mode: str = "online", tol: float = 1e-4,
                            online_chunk_size: int = 5000,
                            online_chunk_max_iter: int = 1000,
-                           batch_max_iter: int = 500, n_passes: int = 20,
+                           batch_max_iter: int = 500,
+                           n_passes: int | None = None,
                            alpha_W: float = 0.0, l1_ratio_W: float = 0.0,
                            alpha_H: float = 0.0, l1_ratio_H: float = 0.0,
                            mesh: Mesh | None = None,
                            return_usages: bool = False,
                            replicates_per_batch: int | None = None,
-                           online_h_tol: float = 1e-3, fetch: bool = True,
+                           online_h_tol: float | None = None,
+                           fetch: bool = True,
                            on_slice=None):
     """Run an entire multi-K sweep — ``len(seeds)`` (k, seed) tasks — as ONE
     compiled program at ``K_max``.
@@ -352,6 +358,8 @@ def replicate_sweep_packed(X, ks, seeds, beta_loss="frobenius",
         X = jnp.asarray(np.asarray(X), dtype=jnp.float32)
     n, g = X.shape
     beta = beta_loss_to_float(beta_loss)
+    online_h_tol, n_passes = resolve_online_schedule(beta, online_h_tol,
+                                                     n_passes)
     ks = [int(v) for v in ks]
     seeds = [int(s) & 0x7FFFFFFF for s in seeds]
     if len(ks) != len(seeds):
@@ -434,12 +442,13 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
                     mode: str = "online", tol: float = 1e-4,
                     online_chunk_size: int = 5000,
                     online_chunk_max_iter: int = 1000,
-                    batch_max_iter: int = 500, n_passes: int = 20,
+                    batch_max_iter: int = 500,
+                    n_passes: int | None = None,
                     alpha_W: float = 0.0, l1_ratio_W: float = 0.0,
                     alpha_H: float = 0.0, l1_ratio_H: float = 0.0,
                     mesh: Mesh | None = None, return_usages: bool = False,
                     replicates_per_batch: int | None = None,
-                    online_h_tol: float = 1e-3, fetch: bool = True):
+                    online_h_tol: float | None = None, fetch: bool = True):
     """Run ``len(seeds)`` NMF replicates at one K as a batched XLA program.
 
     Returns ``(spectra (R, k, g), usages (R, n, k) | None, errs (R,))`` in
@@ -466,6 +475,8 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
     n, g = X.shape
     k = int(k)
     beta = beta_loss_to_float(beta_loss)
+    online_h_tol, n_passes = resolve_online_schedule(beta, online_h_tol,
+                                                     n_passes)
     seeds = [int(s) & 0x7FFFFFFF for s in seeds]
     R = len(seeds)
     if R == 0:

@@ -521,3 +521,39 @@ def test_packed_factorize_consensus_matches_per_k(tmp_path):
         assert list(a.index) == list(b.index)
         np.testing.assert_allclose(a.values, b.values, rtol=1e-4, atol=1e-6,
                                    err_msg=f"k={k}")
+
+
+@pytest.mark.parametrize("beta", [1.0, 0.0])
+def test_refit_w_staged_matches_streamed(mesh, beta):
+    """The staged (HBM-resident, one-dispatch) beta != 2 spectra refit must
+    agree with the re-streaming fallback: same MU fixed-point iteration,
+    same stopping rule, only the residency of X differs."""
+    from cnmf_torch_tpu.parallel.rowshard import refit_w_rowsharded
+
+    X = sp.csr_matrix(_lowrank(n=104, g=40, k=3, seed=13) + 0.01)
+    rng = np.random.default_rng(9)
+    H = rng.gamma(1.0, 1.0, size=(104, 3)).astype(np.float32)
+    W_streamed = refit_w_rowsharded(X, H, beta=beta, h_tol=1e-4,
+                                    max_iter=200, row_block=32, stage=False)
+    W_staged = refit_w_rowsharded(X, H, beta=beta, h_tol=1e-4,
+                                  max_iter=200, row_block=32, stage=True,
+                                  mesh=mesh)
+    assert np.allclose(W_staged, W_streamed, rtol=2e-4, atol=1e-6)
+
+
+def test_refit_w_staged_accepts_device_resident_x(mesh):
+    """Direct API callers may hold X device-resident already (the pipeline
+    itself always crosses the rowshard threshold with a host matrix); the
+    staged refit must consume a jax.Array without a host round trip."""
+    from cnmf_torch_tpu.parallel.rowshard import refit_w_rowsharded
+
+    Xh = _lowrank(n=64, g=24, k=3, seed=3) + 0.01
+    rng = np.random.default_rng(4)
+    H = rng.gamma(1.0, 1.0, size=(64, 3)).astype(np.float32)
+    import jax.numpy as jnp
+
+    W_dev = refit_w_rowsharded(jnp.asarray(Xh), H, beta=1.0, h_tol=1e-4,
+                               max_iter=150, stage="auto")
+    W_host = refit_w_rowsharded(Xh, H, beta=1.0, h_tol=1e-4, max_iter=150,
+                                stage=False)
+    assert np.allclose(W_dev, W_host, rtol=2e-4, atol=1e-6)
