@@ -547,13 +547,14 @@ class cNMF:
         # the resolved per-loss online schedule (ops/nmf.py:
         # resolve_online_schedule) is an execution detail the ledger YAML
         # doesn't carry — record what will actually run
-        _h_tol_eff, _n_passes_eff = resolve_online_schedule(
+        _h_tol_eff, _n_passes_eff, _h_tol_start = resolve_online_schedule(
             beta_loss_to_float(_nmf_kwargs["beta_loss"]),
             _nmf_kwargs.get("online_h_tol"), _nmf_kwargs.get("n_passes"))
         self._save_factorize_provenance(
             "batched-packed" if packed else "batched", worker_i,
             dict({k: v for k, v in _nmf_kwargs.items() if k != "n_jobs"},
                  online_h_tol=_h_tol_eff, n_passes=_n_passes_eff,
+                 online_h_tol_start=_h_tol_start,
                  mesh_devices=(1 if mesh is None
                                else int(np.prod(mesh.devices.shape)))))
 
@@ -692,7 +693,7 @@ class cNMF:
             mesh = Mesh(np.asarray(jax.devices()[:1]), ("cells",))
 
         Xd, n_orig = prepare_rowsharded(norm_counts.X, mesh)
-        _, n_passes_eff = resolve_online_schedule(
+        _, n_passes_eff, _ = resolve_online_schedule(
             beta_loss_to_float(nmf_kwargs["beta_loss"]), 0.05,
             nmf_kwargs.get("n_passes"))
         print("[Worker %d]. Row-sharded factorize: %d cells over %d devices, "
@@ -747,7 +748,7 @@ class cNMF:
         from ..parallel.multihost import replicate_sweep_2d, stage_x_2d
 
         Xd = stage_x_2d(norm_counts.X, mesh)
-        _, n_passes_eff = resolve_online_schedule(
+        _, n_passes_eff, _ = resolve_online_schedule(
             beta_loss_to_float(nmf_kwargs["beta_loss"]), 0.05,
             nmf_kwargs.get("n_passes"))
         n_orig = int(norm_counts.X.shape[0])

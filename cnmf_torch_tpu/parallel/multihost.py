@@ -289,7 +289,7 @@ def replicate_sweep_2d(X, seeds, k: int, mesh: Mesh, beta_loss="frobenius",
     stitched without cross-host resharding).
     """
     beta = beta_loss_to_float(beta_loss)
-    _, n_passes = resolve_online_schedule(beta, h_tol, n_passes)
+    _, n_passes, _ = resolve_online_schedule(beta, h_tol, n_passes)
     if beta not in (2.0, 1.0, 0.0):
         raise ValueError(
             f"replicate_sweep_2d supports beta in {{2, 1, 0}}, got {beta}")

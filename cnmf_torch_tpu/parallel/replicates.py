@@ -29,7 +29,9 @@ import functools
 from ..ops.nmf import (
     _chunk_rows,
     beta_loss_to_float,
+    bundle_width,
     nmf_fit_batch,
+    nmf_fit_batch_bundled,
     nmf_fit_online,
     nndsvd_init,
     random_init,
@@ -149,8 +151,8 @@ def warm_sweep_programs(n: int, g: int, k_to_count: dict,
     import concurrent.futures
 
     beta = beta_loss_to_float(beta_loss)
-    online_h_tol, n_passes = resolve_online_schedule(beta, online_h_tol,
-                                                     n_passes)
+    online_h_tol, n_passes, h_tol_start = resolve_online_schedule(
+        beta, online_h_tol, n_passes)
     l1_W, l2_W = split_regularization(alpha_W, l1_ratio_W)
     l1_H, l2_H = split_regularization(alpha_H, l1_ratio_H)
     n_dev = 1 if mesh is None else math.prod(mesh.devices.shape)
@@ -174,7 +176,8 @@ def warm_sweep_programs(n: int, g: int, k_to_count: dict,
             n, g, k, r_pad, init, mode, beta, float(tol),
             float(online_h_tol), int(min(online_chunk_size, n)),
             int(online_chunk_max_iter), int(n_passes), int(batch_max_iter),
-            l1_H, l2_H, l1_W, l2_W, mesh, bool(return_usages))
+            l1_H, l2_H, l1_W, l2_W, mesh, bool(return_usages),
+            h_tol_start=h_tol_start)
         xs = jax.ShapeDtypeStruct((n, g), jnp.float32, sharding=x_sharding)
         ss = jax.ShapeDtypeStruct((r_pad,), jnp.uint32)
         prog.lower(xs, ss).compile()
@@ -231,7 +234,7 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
                    chunk_max_iter: int, n_passes: int, batch_max_iter: int,
                    l1_H: float, l2_H: float, l1_W: float, l2_W: float,
                    mesh: Mesh | None, return_usages: bool,
-                   packed: bool = False):
+                   packed: bool = False, h_tol_start: float | None = None):
     """Build (once per static configuration) the jitted sweep executable
     ``(X (n,g), seeds (R,)) -> (usages | (0,), spectra (R,k,g), errs (R,))``.
 
@@ -252,6 +255,14 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
     spec = (None if mesh is None
             else NamedSharding(mesh, P(mesh.axis_names[0], None, None)))
 
+    # beta=2 batch sweeps run the bundle-packed solver over the whole
+    # replicate stack (ops/nmf.py: nmf_fit_batch_bundled) — bit-identical
+    # to the vmapped per-replicate solver with ~2x the MXU utilization at
+    # consensus-sweep ks. Other (mode, beta) combinations vmap the
+    # per-replicate solver.
+    stacked_solver = (mode == "batch" and beta == 2.0
+                      and bundle_width(k) > 1)
+
     if mode == "batch":
         def solve(X, h0, w0):
             return nmf_fit_batch(
@@ -263,7 +274,8 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
             Hc, W, err = nmf_fit_online(
                 Xc, Hc, w0, beta=beta, tol=tol, h_tol=h_tol,
                 chunk_max_iter=chunk_max_iter, n_passes=n_passes,
-                l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W)
+                l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W,
+                h_tol_start=h_tol_start)
             return Hc.reshape(-1, k)[:n], W, err
     else:
         raise ValueError(f"unknown mode {mode!r}")
@@ -297,7 +309,15 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
             if spec is not None:
                 H0 = jax.lax.with_sharding_constraint(H0, spec)
                 W0 = jax.lax.with_sharding_constraint(W0, spec)
-            H, W, err = jax.vmap(solve, in_axes=(None, 0, 0))(X, H0, W0)
+            if stacked_solver:
+                # zero-padded components survive the bundled updates too:
+                # their factor rows are exact zeros, so every masked-Gram
+                # and numerator contribution they touch is exactly zero
+                H, W, err = nmf_fit_batch_bundled(
+                    X, H0, W0, tol=tol, max_iter=batch_max_iter,
+                    l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W)
+            else:
+                H, W, err = jax.vmap(solve, in_axes=(None, 0, 0))(X, H0, W0)
             return (H if return_usages
                     else jnp.zeros((0,), X.dtype)), W, err
     else:
@@ -306,7 +326,12 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
             if spec is not None:
                 H0 = jax.lax.with_sharding_constraint(H0, spec)
                 W0 = jax.lax.with_sharding_constraint(W0, spec)
-            H, W, err = jax.vmap(solve, in_axes=(None, 0, 0))(X, H0, W0)
+            if stacked_solver:
+                H, W, err = nmf_fit_batch_bundled(
+                    X, H0, W0, tol=tol, max_iter=batch_max_iter,
+                    l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W)
+            else:
+                H, W, err = jax.vmap(solve, in_axes=(None, 0, 0))(X, H0, W0)
             # drop the usage stack inside the program when the caller
             # doesn't want it — saves the (R, n, k) device->host transfer
             return (H if return_usages else jnp.zeros((0,), X.dtype)), W, err
@@ -358,8 +383,8 @@ def replicate_sweep_packed(X, ks, seeds, beta_loss="frobenius",
         X = jnp.asarray(np.asarray(X), dtype=jnp.float32)
     n, g = X.shape
     beta = beta_loss_to_float(beta_loss)
-    online_h_tol, n_passes = resolve_online_schedule(beta, online_h_tol,
-                                                     n_passes)
+    online_h_tol, n_passes, h_tol_start = resolve_online_schedule(
+        beta, online_h_tol, n_passes)
     ks = [int(v) for v in ks]
     seeds = [int(s) & 0x7FFFFFFF for s in seeds]
     if len(ks) != len(seeds):
@@ -407,7 +432,7 @@ def replicate_sweep_packed(X, ks, seeds, beta_loss="frobenius",
                 float(online_h_tol), int(min(online_chunk_size, n)),
                 int(online_chunk_max_iter), int(n_passes),
                 int(batch_max_iter), l1_H, l2_H, l1_W, l2_W, mesh,
-                bool(return_usages), packed=True)
+                bool(return_usages), packed=True, h_tol_start=h_tol_start)
             H, W, err = prog(X, np.asarray(sl_s, np.uint32), np.int32(kv))
             if on_slice is not None:
                 on_slice(sl_idx, np.asarray(W[:r]), np.asarray(err[:r]))
@@ -475,8 +500,8 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
     n, g = X.shape
     k = int(k)
     beta = beta_loss_to_float(beta_loss)
-    online_h_tol, n_passes = resolve_online_schedule(beta, online_h_tol,
-                                                     n_passes)
+    online_h_tol, n_passes, h_tol_start = resolve_online_schedule(
+        beta, online_h_tol, n_passes)
     seeds = [int(s) & 0x7FFFFFFF for s in seeds]
     R = len(seeds)
     if R == 0:
@@ -519,7 +544,8 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
             n, g, k, len(sl), init, mode, beta, float(tol),
             float(online_h_tol), int(min(online_chunk_size, n)),
             int(online_chunk_max_iter), int(n_passes), int(batch_max_iter),
-            l1_H, l2_H, l1_W, l2_W, mesh, bool(return_usages))
+            l1_H, l2_H, l1_W, l2_W, mesh, bool(return_usages),
+            h_tol_start=h_tol_start)
         # async dispatch: every slice is enqueued before any result is read
         H, W, err = prog(X, np.asarray(sl, dtype=np.uint32))
         parts.append((H[:r] if return_usages else None, W[:r], err[:r]))

@@ -298,7 +298,7 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
     # crossing the rowshard threshold never changes the convergence
     # schedule; measured at 300k x 2k KL on v5e: 60 vs 20 passes costs +14%
     # wall-clock (the objective-tol stop fires early) for a better optimum
-    _, n_passes = resolve_online_schedule(beta, h_tol, n_passes)
+    _, n_passes, _ = resolve_online_schedule(beta, h_tol, n_passes)
     if beta not in (2.0, 1.0, 0.0):
         # the generic-beta update exists only on the single-chip path
         # (ops.nmf._update_W); the sharded pass implements the three named
@@ -503,6 +503,8 @@ def refit_w_rowsharded(X, H, beta=2.0, h_tol: float = 0.05,
     axis = mesh.axis_names[0]
     n_dev = int(np.prod(mesh.devices.shape))
 
+    if isinstance(stage, str) and stage != "auto":
+        raise ValueError(f"stage must be True, False, or 'auto'; got {stage!r}")
     if stage == "auto":
         budget = (stage_budget_bytes if stage_budget_bytes is not None
                   else _staged_refit_budget_bytes())
