@@ -273,3 +273,53 @@ def test_run_parallel_launcher(tmp_path, engine, workers, extra):
         assert prov["engaged_path"] == "mesh2d", out
     else:
         assert prov["engaged_path"] == "batched", out
+
+
+def test_run_parallel_dead_worker_tolerance(tmp_path):
+    """Kill one of two subprocess factorize workers mid-run and assert the
+    launcher completes end-to-end on the survivor's replicates — the
+    reference's dead-worker contract (combine(skip_missing_files=True),
+    cnmf.py:904-909 / README.md:117) at the CLI level."""
+    import pandas as pd
+
+    from cnmf_torch_tpu.utils.io import load_df_from_npz, save_df_to_npz
+
+    rng = np.random.default_rng(2)
+    df = pd.DataFrame(rng.binomial(40, 0.02, size=(60, 100)).astype(float),
+                      index=[f"c{i}" for i in range(60)],
+                      columns=[f"g{j}" for j in range(100)])
+    counts_fn = str(tmp_path / "counts.df.npz")
+    save_df_to_npz(df, counts_fn)
+
+    # poison sitecustomize: any worker whose argv carries the targeted
+    # --worker-index dies instantly (simulating a preempted/crashed node);
+    # every other process (parent included) continues on the CPU backend
+    poison = tmp_path / "poison"
+    poison.mkdir()
+    (poison / "sitecustomize.py").write_text(
+        "import os, sys\n"
+        "kill = os.environ.get('CNMF_TEST_KILL_WORKER')\n"
+        "argv = sys.argv\n"
+        "if kill is not None and '--worker-index' in argv:\n"
+        "    if argv[argv.index('--worker-index') + 1] == kill:\n"
+        "        os._exit(17)\n")
+
+    env = dict(os.environ, CNMF_TEST_KILL_WORKER="1",
+               PYTHONPATH=os.pathsep.join(
+                   [str(poison), os.environ.get("PYTHONPATH", "")]),
+               JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "cnmf_torch_tpu", "run_parallel",
+           "--output-dir", str(tmp_path), "--name", "deadw",
+           "--counts", counts_fn, "-k", "3", "--n-iter", "4",
+           "--total-workers", "2", "--seed", "4", "--numgenes", "50",
+           "--engine", "subprocess"]
+    p = _spawn(cmd, env)
+    (out,) = _wait_all([p])
+    assert p.returncode == 0, out
+
+    base = tmp_path / "deadw"
+    # worker 1 owned the odd ledger rows; only worker 0's replicates merged
+    merged = load_df_from_npz(
+        str(base / "cnmf_tmp" / "deadw.spectra.k_3.merged.df.npz"))
+    assert merged.shape[0] == 2 * 3  # 2 surviving replicates x k rows
+    assert (base / "deadw.k_selection.png").exists(), out
