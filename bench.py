@@ -374,12 +374,53 @@ def bench_rowshard():
                                    n_orig=n_orig)
     solve_s = time.perf_counter() - t0
     assert np.isfinite(err)
+
+    # atlas-scale beta!=2 spectra refit, STAGED: X is already HBM-resident
+    # (reuse the solver's staged array) and the whole MU loop is one XLA
+    # dispatch (rowshard._refit_w_staged_jit). Two dispatches differing
+    # only in max_iter cancel the constant costs, so the reported rate is
+    # the on-device per-iteration HBM pass — independent of the host link
+    # (round 3 re-streamed X per iteration: ~22 s/iter at this shape on
+    # the tunnel)
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from cnmf_torch_tpu.parallel.rowshard import _refit_w_staged_jit
+
+    rng_h = np.random.default_rng(3)
+    k = 9
+    n_pad = int(Xd.shape[0])
+    blk = n_pad // (n_pad // min(65536, n_pad))
+    while n_pad % blk:
+        blk -= 1
+    Hd = jax.device_put(
+        jnp.asarray(rng_h.gamma(1.0, 1.0, size=(n_pad, k)).astype(
+            np.float32)), NamedSharding(mesh, P("cells", None)))
+    Wd = jax.device_put(
+        jnp.asarray(rng_h.random((k, g), np.float32) + 0.1),
+        NamedSharding(mesh, P()))
+    refit_iters = 20
+
+    def refit(iters):
+        # h_tol=0 disables the early stop -> exactly max_iter MU iterations
+        t0 = time.perf_counter()
+        W = _refit_w_staged_jit(Xd, Hd, Wd, mesh, "cells", 1.0, iters,
+                                jnp.float32(0.0), int(blk), 0.0, 0.0)
+        assert np.isfinite(_device_sync(W))  # true device barrier
+        return time.perf_counter() - t0
+
+    refit(1)                      # compile short
+    refit(1 + refit_iters)        # compile long
+    t1 = min(refit(1) for _ in range(2))
+    t2 = min(refit(1 + refit_iters) for _ in range(2))
+    refit_s = max(t2 - t1, 1e-9)
     return {
         "cells": n, "genes": g, "csr_gb": round(nbytes_sparse / 1e9, 2),
         "stream_seconds": round(stream_s, 3),
         "stream_dense_gb_per_s": round(dense_gb / stream_s, 2),
         "solve_seconds_3pass_k9": round(solve_s, 3),
         "cells_per_second": int(n * n_passes / solve_s),
+        "staged_kl_refit_seconds_per_mu_iter": round(refit_s / refit_iters, 3),
     }
 
 

@@ -367,11 +367,13 @@ def _refit_w_staged_jit(X, H, W0, mesh, axis, beta, max_iter, h_tol, blk,
                         l1_W, l2_W):
     """Whole-refit-in-one-dispatch W solve against an HBM-RESIDENT sharded X.
 
-    Each MU iteration is a ``lax.scan`` over (blk x genes) row blocks of the
-    local shard — the (rows x genes) WH intermediate never exceeds one
-    block — with the numerator/denominator ``psum``'d across shards. The
-    whole while_loop runs on device: per-iteration cost is one HBM pass
-    over X, independent of the host link entirely."""
+    Each MU iteration is a ``fori_loop`` of dynamic (blk x genes) row
+    slices of the local shard — the WH intermediate never exceeds one
+    block, and slicing (unlike a blocked reshape) never changes the
+    physical layout, so XLA does not materialize a second full-size copy
+    of the resident shard. Numerator/denominator are ``psum``'d across
+    shards; the whole while_loop runs on device: per-iteration cost is one
+    HBM pass over X, independent of the host link entirely."""
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(P(axis, None), P(axis, None), P()), out_specs=P(),
@@ -379,8 +381,14 @@ def _refit_w_staged_jit(X, H, W0, mesh, axis, beta, max_iter, h_tol, blk,
     def run(X_local, H_local, W):
         rows, g = X_local.shape
         k = H_local.shape[1]
-        Xb = X_local.reshape(rows // blk, blk, g)
-        Hb = H_local.reshape(rows // blk, blk, k)
+        if rows % blk:
+            # the reshape this fori_loop replaced failed loudly on
+            # indivisible shards; keep that guard — silently skipping the
+            # tail rows would corrupt the W statistics
+            raise ValueError(
+                f"shard rows {rows} not divisible by block {blk}; pad rows "
+                "to a blk * n_dev multiple (refit_w_rowsharded does)")
+        nblk = rows // blk
 
         # the KL denominator (column sums of the FIXED H) is loop-invariant:
         # compute its psum once, not one ICI collective per MU iteration
@@ -390,21 +398,26 @@ def _refit_w_staged_jit(X, H, W0, mesh, axis, beta, max_iter, h_tol, blk,
             if beta == 1.0 else None)
 
         def stats(W):
-            def blk_stats(acc, xh):
-                x, h = xh
+            # dynamic row slices, NOT a (nblk, blk, g) reshape: the reshape
+            # changes the physical layout, so XLA materializes a second
+            # full-size copy of the HBM-resident shard — an instant OOM at
+            # atlas scale (8 GB + 8 GB on a 16 GB chip)
+            def blk_stats(b, acc):
+                x = jax.lax.dynamic_slice_in_dim(X_local, b * blk, blk)
+                h = jax.lax.dynamic_slice_in_dim(H_local, b * blk, blk)
                 WH = jnp.maximum(h @ W, EPS)
                 if beta == 1.0:
-                    return acc + h.T @ (x / WH), None
+                    return acc + h.T @ (x / WH)
                 # beta == 0.0 (itakura-saito): numer and denom stacked
                 return acc + jnp.stack((h.T @ (x / (WH * WH)),
-                                        h.T @ (1.0 / WH))), None
+                                        h.T @ (1.0 / WH)))
 
             shape = (k, g) if beta == 1.0 else (2, k, g)
             # init derived from the shard (not a literal) so its varying
             # manual axes match the body's under shard_map — same trick as
             # ops.nmf._chunk_h_solve's rel0
-            acc0 = jnp.zeros(shape, jnp.float32) + 0.0 * Xb[0, 0, 0]
-            acc, _ = jax.lax.scan(blk_stats, acc0, (Xb, Hb))
+            acc0 = jnp.zeros(shape, jnp.float32) + 0.0 * X_local[0, 0]
+            acc = jax.lax.fori_loop(0, nblk, blk_stats, acc0)
             acc = jax.lax.psum(acc, axis)
             if beta == 1.0:
                 return acc, kl_denom
