@@ -487,7 +487,8 @@ class cNMF:
                     columns=norm_counts.var.index)
                 save_df_to_npz(
                     spectra,
-                    self.paths["iter_spectra"] % (p["n_components"], p["iter"]))
+                    self.paths["iter_spectra"] % (p["n_components"], p["iter"]),
+                    compress=False)
             return
 
         if mesh is None:
@@ -576,7 +577,11 @@ class cNMF:
                     df = pd.DataFrame(spectra[j][:k],
                                       index=np.arange(1, k + 1),
                                       columns=norm_counts.var.index)
-                    save_df_to_npz(df, self.paths["iter_spectra"] % (k, it))
+                    # stored, not deflated: 900 per-replicate writes cost
+                    # ~3.2 s of a 12.6 s warm factorize in zlib alone, for
+                    # transient files combine deletes under --clean
+                    save_df_to_npz(df, self.paths["iter_spectra"] % (k, it),
+                                   compress=False)
 
             replicate_sweep_packed(
                 X, [t[0] for t in tasks], [t[2] for t in tasks],
@@ -635,7 +640,8 @@ class cNMF:
                                       index=np.arange(1, k + 1),
                                       columns=norm_counts.var.index)
                     save_df_to_npz(df,
-                                   self.paths["iter_spectra"] % (k, it))
+                                   self.paths["iter_spectra"] % (k, it),
+                                   compress=False)
 
         for k, tasks in sorted(by_k.items()):
             iters = [t[0] for t in tasks]
@@ -730,7 +736,8 @@ class cNMF:
                 n_orig=n_orig)
             df = pd.DataFrame(spectra, index=np.arange(1, k + 1),
                               columns=norm_counts.var.index)
-            save_df_to_npz(df, self.paths["iter_spectra"] % (k, p["iter"]))
+            save_df_to_npz(df, self.paths["iter_spectra"] % (k, p["iter"]),
+                           compress=False)
 
     def _factorize_2d(self, jobs, run_params, norm_counts, nmf_kwargs,
                       mesh, worker_i, replicates_per_batch=None):
@@ -800,7 +807,8 @@ class cNMF:
                     df = pd.DataFrame(spectra[r],
                                       index=np.arange(1, k + 1),
                                       columns=norm_counts.var.index)
-                    save_df_to_npz(df, self.paths["iter_spectra"] % (k, it))
+                    save_df_to_npz(df, self.paths["iter_spectra"] % (k, it),
+                                   compress=False)
         sync_hosts("factorize_2d")
 
     # ------------------------------------------------------------------
