@@ -1180,18 +1180,41 @@ class cNMF:
         if refit_usage:
             # final usage refit on std-scaled HVG TPM (cnmf.py:1135-1149)
             hvgs = open(self.paths["nmf_genes_list"]).read().split("\n")
-            norm_tpm = tpm[:, hvgs].copy()
-            if sp.issparse(norm_tpm.X):
-                norm_tpm.X, _ = scale_columns(norm_tpm.X, ddof=1,
-                                              zero_std_to_one=True)
-            else:
-                norm_tpm.X, _ = scale_columns(norm_tpm.X, ddof=1,
-                                              zero_std_to_one=False)
             spectra_tpm_rf = spectra_tpm.loc[:, hvgs]
             spectra_tpm_rf = spectra_tpm_rf.div(
                 tpm_stats.loc[hvgs, "__std"], axis=1)
+            import jax
+
+            if isinstance(tpm_resident, jax.Array):
+                # the TPM is already HBM-resident: slice + scale its HVG
+                # columns ON DEVICE (ops/stats.scale_hvg_columns_device) —
+                # host-scaling and re-uploading the dense result cost ~2 s
+                # per consensus call on a tunneled chip. The ddof=1 std is
+                # derived from the tpm_stats artifact (same f64 moment
+                # engine over the same matrix, ddof=0) instead of a fresh
+                # O(nnz) pass + HVG submatrix copy.
+                from ..ops.stats import scale_hvg_columns_device
+
+                n_rows = int(tpm_resident.shape[0])
+                bessel = (n_rows / (n_rows - 1.0)) if n_rows > 1 else 1.0
+                div = np.sqrt(
+                    tpm_stats.loc[hvgs, "__std"].values.astype(np.float64)
+                    ** 2 * bessel)
+                if sp.issparse(tpm.X):
+                    div[div == 0] = 1.0
+                refit_X = scale_hvg_columns_device(
+                    tpm_resident, tpm.var.index.get_indexer(hvgs), div)
+            else:
+                norm_tpm = tpm[:, hvgs].copy()
+                if sp.issparse(norm_tpm.X):
+                    norm_tpm.X, _ = scale_columns(norm_tpm.X, ddof=1,
+                                                  zero_std_to_one=True)
+                else:
+                    norm_tpm.X, _ = scale_columns(norm_tpm.X, ddof=1,
+                                                  zero_std_to_one=False)
+                refit_X = norm_tpm.X
             rf_usages = self.refit_usage(
-                norm_tpm.X, spectra_tpm_rf.values.astype(np.float32))
+                refit_X, spectra_tpm_rf.values.astype(np.float32))
             rf_usages = pd.DataFrame(rf_usages, index=norm_counts.obs.index,
                                      columns=spectra_tpm_rf.index)
 

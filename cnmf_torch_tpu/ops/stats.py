@@ -23,7 +23,9 @@ import numpy as np
 import scipy.sparse as sp
 
 __all__ = ["cell_scale_factors", "column_mean_var", "column_moments_staged",
-           "normalize_total", "scale_columns", "row_sums"]
+           "normalize_total", "scale_columns", "row_sums",
+    "scale_hvg_columns_device",
+]
 
 # Row-block size for streaming sparse buffers host->device. Large enough to
 # amortize transfer, small enough to bound device memory at atlas scale.
@@ -309,3 +311,18 @@ def scale_columns(X, ddof: int = 1, zero_std_to_one: bool = True,
         with np.errstate(divide="ignore", invalid="ignore"):
             out = np.asarray(X) / div[None, :]
     return out, std
+
+
+def scale_hvg_columns_device(X_resident, hvg_idx, div):
+    """Slice HVG columns out of a DEVICE-resident dense matrix and divide
+    by a host-computed per-column scale — all on device. The consensus
+    final usage refit needs the std-scaled HVG TPM (``cnmf.py:1135-1149``);
+    scaling on host and re-uploading the dense result cost ~2 s per
+    consensus call on a tunneled TPU, while this ships only the (g_hvg,)
+    scale vector. ``div`` follows scale_columns' conventions (zero stds
+    already mapped to 1 for the sparse-input branch; left at 0 — NaN/inf
+    on divide — for the dense branch, mirroring the reference's dense
+    path which only warns)."""
+    idx = jnp.asarray(np.asarray(hvg_idx), jnp.int32)
+    d = jnp.asarray(np.asarray(div), jnp.float32)
+    return jnp.take(X_resident, idx, axis=1) / d[None, :]
