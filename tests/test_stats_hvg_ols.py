@@ -204,3 +204,33 @@ def test_ols_matches_reference(counts_100x500, sparse, normalize_y, precision):
     else:
         # fp32 path: conditioning amplifies rounding; still close
         np.testing.assert_allclose(beta, expected, rtol=0.05, atol=0.01)
+
+
+def test_scale_hvg_columns_device_matches_host():
+    """The consensus final-refit's on-device HVG slice+scale must equal the
+    host scale_columns path it replaced (models/cnmf.py final usage refit):
+    same ddof-1 std convention, same zero-std handling per input kind."""
+    import jax.numpy as jnp
+
+    from cnmf_torch_tpu.ops.stats import (scale_columns,
+                                          scale_hvg_columns_device)
+
+    rng = np.random.default_rng(17)
+    X = rng.gamma(1.0, 1.0, size=(60, 30)).astype(np.float32)
+    X[:, 5] = 0.0  # a zero-variance column
+    hvg_idx = np.array([2, 5, 7, 11, 19, 23])
+
+    # sparse-input convention: zero std -> divide by 1
+    host_scaled, _ = scale_columns(sp.csr_matrix(X[:, hvg_idx]), ddof=1,
+                                   zero_std_to_one=True)
+    # derive div exactly the way the production site does
+    # (models/cnmf.py final usage refit): the tpm_stats artifact's ddof=0
+    # std, Bessel-corrected to ddof=1 — this pins the reconstruction
+    # identity, not just the device division
+    n_rows = X.shape[0]
+    std0 = X.std(axis=0, ddof=0).astype(np.float64)[hvg_idx]
+    div = np.sqrt(std0 ** 2 * (n_rows / (n_rows - 1.0)))
+    div[div == 0] = 1.0
+    dev = np.asarray(scale_hvg_columns_device(jnp.asarray(X), hvg_idx, div))
+    np.testing.assert_allclose(dev, host_scaled.toarray(), rtol=2e-6,
+                               atol=1e-7)
