@@ -80,6 +80,9 @@ def seurat_v3_hvg(X, n_top_genes: int = 2000) -> pd.DataFrame:
     [means, variances, variances_norm, highly_variable_rank, highly_variable]
     aligned to the input column order."""
     n, g = X.shape
+    # sparse moments route through the host-f64 fused engine inside
+    # column_mean_var (measured ~6 s of this scorer's 9.8 s on the islets
+    # preprocess went to per-block device round trips before that routing)
     mean, var = column_mean_var(X, ddof=1)
 
     not_const = var > 0

@@ -6,16 +6,17 @@ These replace the reference's native-dependency statistics surface:
 TPM scaling (``cnmf.py:241-247``), and ``sc.pp.scale(zero_center=False)`` /
 dense ``X /= X.std(ddof=1)`` unit-variance gene scaling (``cnmf.py:674-679``).
 
-Sparse matrices are never densified for moment computation: CSR ``data`` /
-column-``indices`` buffers are streamed to the device in row blocks and
-reduced with ``segment_sum`` — an O(nnz) pass that maps onto the TPU's
-vector unit, with accumulation across blocks so memory stays bounded for
-atlas-scale (1M-cell) inputs.
+Sparse matrices are never densified for moment computation — and their
+moments deliberately stay on HOST in exact float64 (the fused
+``column_moments_staged`` engine): per-gene moments are O(nnz) bookkeeping
+where ``np.bincount`` over CSR buffers beats shipping the matrix across the
+host->device link, blocked so memory stays bounded for atlas-scale
+(1M-cell) inputs. Dense inputs reduce on device in fp32 blocks with f64
+cross-block accumulation.
 """
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -30,18 +31,6 @@ __all__ = ["cell_scale_factors", "column_mean_var", "column_moments_staged",
 # Row-block size for streaming sparse buffers host->device. Large enough to
 # amortize transfer, small enough to bound device memory at atlas scale.
 _BLOCK_ROWS = 262_144
-
-@functools.partial(jax.jit, static_argnames=("n_cols",))
-def _sparse_block_sums(data, col_idx, n_cols):
-    s1 = jax.ops.segment_sum(data, col_idx, num_segments=n_cols)
-    cnt = jax.ops.segment_sum(jnp.ones_like(data), col_idx, num_segments=n_cols)
-    return s1, cnt
-
-
-@functools.partial(jax.jit, static_argnames=("n_cols",))
-def _sparse_block_centered_sq(data, col_idx, mean, n_cols):
-    d = data - mean[col_idx]
-    return jax.ops.segment_sum(d * d, col_idx, num_segments=n_cols)
 
 
 @jax.jit
@@ -67,48 +56,41 @@ def column_mean_var(X, ddof: int = 0, block_rows: int = _BLOCK_ROWS):
     (``ddof=0``) as produced by ``StandardScaler(with_mean=False)``.
     ``ddof=1`` gives the sample variance used by gene scaling.
 
-    Two-pass (mean, then centered squares): the naive E[x^2] - E[x]^2 form
-    cancels catastrophically in fp32 at TPM scale (column means of 1e4 turn
-    a true variance of 100 into 0-112). Cross-block accumulation is float64
-    on host; per-block reductions stay fp32 on device.
+    Sparse inputs compute entirely host-side in exact f64 (the fused
+    ``column_moments_staged`` engine — see the module docstring). Dense
+    inputs use a two-pass device reduction (mean, then centered squares):
+    the naive E[x^2] - E[x]^2 form cancels catastrophically in fp32 at TPM
+    scale (column means of 1e4 turn a true variance of 100 into 0-112);
+    cross-block accumulation is float64 on host, per-block reductions fp32
+    on device.
     """
     n, g = X.shape
-    s1 = np.zeros((g,), dtype=np.float64)
     if sp.issparse(X):
-        X = X.tocsr()
-        nnz_per_col = np.zeros((g,), dtype=np.float64)
-        for block in _iter_row_blocks(X, block_rows):
-            if block.nnz == 0:
-                continue
-            b1, bc = _sparse_block_sums(
-                jnp.asarray(block.data, dtype=jnp.float32),
-                jnp.asarray(block.indices), g)
-            s1 += np.asarray(b1, dtype=np.float64)
-            nnz_per_col += np.asarray(bc, dtype=np.float64)
-        mean = s1 / n
-        mean_d = jnp.asarray(mean, dtype=jnp.float32)
-        ssq = np.zeros((g,), dtype=np.float64)
-        for block in _iter_row_blocks(X, block_rows):
-            if block.nnz == 0:
-                continue
-            bs = _sparse_block_centered_sq(
-                jnp.asarray(block.data, dtype=jnp.float32),
-                jnp.asarray(block.indices), mean_d, g)
-            ssq += np.asarray(bs, dtype=np.float64)
-        # implicit zeros each contribute mean^2 to the centered sum
-        ssq += (n - nnz_per_col) * mean ** 2
-    else:
-        Xd = np.asarray(X)
-        for block in _iter_row_blocks(Xd, block_rows):
-            s1 += np.asarray(_dense_block_sum(jnp.asarray(block, dtype=jnp.float32)),
-                             dtype=np.float64)
-        mean = s1 / n
-        mean_d = jnp.asarray(mean, dtype=jnp.float32)
-        ssq = np.zeros((g,), dtype=np.float64)
-        for block in _iter_row_blocks(Xd, block_rows):
-            ssq += np.asarray(
-                _dense_block_centered_sq(jnp.asarray(block, dtype=jnp.float32), mean_d),
-                dtype=np.float64)
+        # sparse inputs route through the host-f64 fused engine: per-gene
+        # moments are O(nnz) bookkeeping where np.bincount beats shipping
+        # CSR blocks over the host->device link and back (the same call
+        # that took prepare's moment pass from 24 s to ~1 s; swapping the
+        # per-block device round trips here saved ~6 s of the islets
+        # preprocess)
+        (mean, var), _ = column_moments_staged(X, block_rows=block_rows)
+        if ddof:
+            # unconditional, like the dense path below: n <= ddof yields
+            # inf/nan with a runtime warning rather than silently returning
+            # the population variance
+            var = var * (n / (n - ddof))
+        return mean, var
+    s1 = np.zeros((g,), dtype=np.float64)
+    Xd = np.asarray(X)
+    for block in _iter_row_blocks(Xd, block_rows):
+        s1 += np.asarray(_dense_block_sum(jnp.asarray(block, dtype=jnp.float32)),
+                         dtype=np.float64)
+    mean = s1 / n
+    mean_d = jnp.asarray(mean, dtype=jnp.float32)
+    ssq = np.zeros((g,), dtype=np.float64)
+    for block in _iter_row_blocks(Xd, block_rows):
+        ssq += np.asarray(
+            _dense_block_centered_sq(jnp.asarray(block, dtype=jnp.float32), mean_d),
+            dtype=np.float64)
     var = np.maximum(ssq / n, 0.0)
     if ddof:
         var = var * (n / (n - ddof))
