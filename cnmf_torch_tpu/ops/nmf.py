@@ -943,8 +943,19 @@ def run_nmf(X, n_components: int, init: str = "random",
 
     Returns ``(H usages (n,k), W spectra (k,g), err)``. ``n_jobs`` and
     ``use_gpu`` are accepted for contract compatibility and ignored — device
-    placement is JAX's job here.
+    placement is JAX's job here. ``fp_precision`` follows the nmf-torch
+    surface: ``'float'`` (fp32, the only value the reference ever passes,
+    cnmf.py:757-771) or ``'double'`` — honored for ``mode='batch'`` by
+    running the whole solve in float64 under x64 (the online solver's scan
+    carries are fp32 and double is out of its contract).
     """
+    if fp_precision not in ("float", "double"):
+        raise ValueError(
+            f"fp_precision={fp_precision!r}: expected 'float' or 'double'")
+    if fp_precision == "double" and mode != "batch":
+        raise NotImplementedError(
+            "fp_precision='double' is implemented for mode='batch'; the "
+            "online solver is fp32 by contract")
     if algo not in ("mu", "halsvar"):
         raise NotImplementedError(
             f"algo={algo!r}: 'mu' (all beta losses, batch+online) and "
@@ -964,14 +975,27 @@ def run_nmf(X, n_components: int, init: str = "random",
         beta, online_h_tol, n_passes)
     if sp.issparse(X):
         X = X.toarray()
-    X = jnp.asarray(np.asarray(X), dtype=jnp.float32)
-    n, g = X.shape
     k = int(n_components)
-
     l1_W, l2_W = split_regularization(alpha_W, l1_ratio_W)
     l1_H, l2_H = split_regularization(alpha_H, l1_ratio_H)
-
     key = jax.random.key(int(random_state) & 0x7FFFFFFF)
+
+    if fp_precision == "double":
+        # the batch kernels are dtype-generic (their constants are weakly
+        # typed Python floats); tracing them on f64 operands under x64
+        # yields a genuinely double-precision solve on device
+        with jax.enable_x64():
+            Xd = jnp.asarray(np.asarray(X), dtype=jnp.float64)
+            H0, W0 = init_factors(Xd, k, init, key)
+            H0, W0 = H0.astype(jnp.float64), W0.astype(jnp.float64)
+            fit = (nmf_fit_batch_hals if algo == "halsvar"
+                   else functools.partial(nmf_fit_batch, beta=beta))
+            H, W, err = fit(Xd, H0, W0, tol=float(tol),
+                            max_iter=int(batch_max_iter),
+                            l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W)
+            return np.asarray(H), np.asarray(W), float(err)
+    X = jnp.asarray(np.asarray(X), dtype=jnp.float32)
+    n, g = X.shape
     H0, W0 = init_factors(X, k, init, key)
 
     if mode == "batch":

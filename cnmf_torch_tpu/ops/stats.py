@@ -74,10 +74,11 @@ def column_mean_var(X, ddof: int = 0, block_rows: int = _BLOCK_ROWS):
         # preprocess)
         (mean, var), _ = column_moments_staged(X, block_rows=block_rows)
         if ddof:
-            # unconditional, like the dense path below: n <= ddof yields
-            # inf/nan with a runtime warning rather than silently returning
-            # the population variance
-            var = var * (n / (n - ddof))
+            # unconditional, like the dense path below: the Bessel factor
+            # is computed in float64 so n <= ddof yields inf/nan with a
+            # numpy runtime warning rather than a ZeroDivisionError (n and
+            # ddof are Python ints; int/int would raise at n == ddof)
+            var = var * (np.float64(n) / (n - ddof))
         return mean, var
     s1 = np.zeros((g,), dtype=np.float64)
     Xd = np.asarray(X)
@@ -93,7 +94,7 @@ def column_mean_var(X, ddof: int = 0, block_rows: int = _BLOCK_ROWS):
             dtype=np.float64)
     var = np.maximum(ssq / n, 0.0)
     if ddof:
-        var = var * (n / (n - ddof))
+        var = var * (np.float64(n) / (n - ddof))
     return mean, var
 
 
@@ -305,6 +306,13 @@ def scale_hvg_columns_device(X_resident, hvg_idx, div):
     already mapped to 1 for the sparse-input branch; left at 0 — NaN/inf
     on divide — for the dense branch, mirroring the reference's dense
     path which only warns)."""
-    idx = jnp.asarray(np.asarray(hvg_idx), jnp.int32)
+    idx_h = np.asarray(hvg_idx)
+    if idx_h.size and idx_h.min() < 0:
+        # get_indexer marks missing names as -1; jnp.take would clamp that
+        # to column 0 and silently scale the wrong gene, whereas the host
+        # fallback (tpm[:, hvgs]) raises KeyError — fail as loudly here
+        raise KeyError(
+            f"{int((idx_h < 0).sum())} HVG name(s) missing from tpm.var")
+    idx = jnp.asarray(idx_h, jnp.int32)
     d = jnp.asarray(np.asarray(div), jnp.float32)
     return jnp.take(X_resident, idx, axis=1) / d[None, :]

@@ -61,11 +61,41 @@ def default_mesh(axis_name: str = "replicates") -> Mesh | None:
     return Mesh(np.asarray(devices), (axis_name,))
 
 
+_FALLBACK_BUDGET_ELEMS = 1 << 28  # 1 GiB of fp32 live state (v5e-tuned)
+
+
+def _device_budget_elems() -> int:
+    """fp32 element budget derived from the accelerator's actual free HBM:
+    30% of (bytes_limit - bytes_in_use), leaving ~70% headroom for the
+    resident X, XLA scratch/double-buffering, and the returned stacks.
+    Falls back to the 1 GiB constant when the runtime exposes no memory
+    stats (CPU, and the axon-tunneled TPU, whose memory_stats() is empty)
+    — so on a >=16 GB part with real stats the budget scales up instead of
+    undersubscribing at the v5e-tuned constant.
+    ``CNMF_TPU_BUDGET_ELEMS`` overrides both."""
+    import os
+
+    env = os.environ.get("CNMF_TPU_BUDGET_ELEMS")
+    if env:
+        return max(int(env), 1)
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+    except Exception:
+        stats = {}
+    limit = stats.get("bytes_limit")
+    if limit:
+        free = max(int(limit) - int(stats.get("bytes_in_use", 0)), 0)
+        derived = (free * 3 // 10) // 4
+        return max(derived, _FALLBACK_BUDGET_ELEMS)
+    return _FALLBACK_BUDGET_ELEMS
+
+
 def auto_replicates_per_batch(n: int, g: int, k: int, beta: float = 2.0,
                               chunk: int | None = None, n_dev: int = 1,
-                              budget_elems: int = 1 << 28) -> int:
+                              budget_elems: int | None = None) -> int:
     """How many vmapped replicates fit one device slice under the fp32
-    element budget (~1 GiB of live state by default).
+    element budget (device-derived via :func:`_device_budget_elems` when
+    ``budget_elems`` is None).
 
     Each replicate carries its factor state (3x (n*k + k*g) for the
     current/next/temporary H and W, plus the returned usage stack). For
@@ -77,6 +107,8 @@ def auto_replicates_per_batch(n: int, g: int, k: int, beta: float = 2.0,
     sweep admit ~4 GB of live intermediates per buffer and crash the TPU
     worker (round-2 bench, BENCH_r02.json).
     """
+    if budget_elems is None:
+        budget_elems = _device_budget_elems()
     per_rep = 3 * (n * k + k * g) + n * k
     if beta != 2.0:
         c = n if chunk is None else min(int(chunk), n)
@@ -259,9 +291,14 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
     # replicate stack (ops/nmf.py: nmf_fit_batch_bundled) — bit-identical
     # to the vmapped per-replicate solver with ~2x the MXU utilization at
     # consensus-sweep ks. Other (mode, beta) combinations vmap the
-    # per-replicate solver.
+    # per-replicate solver. Single-device only: bundle_stacks' reshape
+    # folds the replicate axis into the packed lane axis, so on a >1-device
+    # mesh GSPMD would have to reshard every iteration where the vmapped
+    # solver keeps replicates device-local.
     stacked_solver = (mode == "batch" and beta == 2.0
-                      and bundle_width(k) > 1)
+                      and bundle_width(k) > 1
+                      and (mesh is None
+                           or int(np.prod(mesh.devices.shape)) == 1))
 
     if mode == "batch":
         def solve(X, h0, w0):

@@ -537,9 +537,18 @@ def refit_w_rowsharded(X, H, beta=2.0, h_tol: float = 0.05,
             # pipeline always reaches here with a host matrix: its staged
             # consensus matrices are capped below rowshard_threshold)
             pad = (-n) % (blk * n_dev)
-            Xd = jax.device_put(
-                jnp.pad(X.astype(jnp.float32), ((0, pad), (0, 0))),
-                NamedSharding(mesh, P(axis, None)))
+            target = NamedSharding(mesh, P(axis, None))
+            if (pad == 0 and X.dtype == jnp.float32
+                    and X.sharding.is_equivalent_to(target, X.ndim)):
+                # already laid out for the scan: pad+device_put here would
+                # materialize a full-size second copy of a device-resident
+                # matrix (near-HBM-sized inputs OOMed where the budgeted
+                # streaming path would not)
+                Xd = X
+            else:
+                Xd = jax.device_put(
+                    jnp.pad(X.astype(jnp.float32), ((0, pad), (0, 0))),
+                    target)
         else:
             Xd, _ = stream_rows_to_mesh(
                 X if sp.issparse(X) else np.asarray(X, np.float32),

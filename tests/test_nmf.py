@@ -300,3 +300,34 @@ def test_halsvar_solver():
         run_nmf(X, 4, algo="halsvar", mode="online")
     with pytest.raises(NotImplementedError):
         run_nmf(X, 4, algo="bpp")
+
+
+def test_run_nmf_fp_precision_contract():
+    """nmf-torch's ``fp_precision`` kwarg (cnmf.py:757-771 surface): 'float'
+    is the default fp32 path, 'double' runs the batch solve genuinely in
+    float64 under x64, and anything else is rejected loudly instead of
+    silently ignored."""
+    X, _, _ = _synthetic(n=80, g=50, k=4, noise=0.02)
+    H, W, err = run_nmf(X, 4, mode="batch", fp_precision="double",
+                        batch_max_iter=150, random_state=7)
+    assert H.dtype == np.float64 and W.dtype == np.float64
+    Hf, Wf, err_f = run_nmf(X, 4, mode="batch", fp_precision="float",
+                            batch_max_iter=150, random_state=7)
+    assert Hf.dtype == np.float32
+    # same seed/schedule: the double solve tracks the float one closely but
+    # is a genuinely different precision (exact equality would mean the
+    # kwarg is still ignored)
+    assert abs(err - err_f) / err_f < 1e-3
+    assert err != err_f
+    # x64 mode must not leak out of the call
+    assert jnp.asarray(1.0).dtype == jnp.float32
+
+    Hd, Wd, _ = run_nmf(X, 4, mode="batch", algo="halsvar",
+                        fp_precision="double", batch_max_iter=100,
+                        random_state=7)
+    assert Hd.dtype == np.float64 and Wd.dtype == np.float64
+
+    with pytest.raises(NotImplementedError):
+        run_nmf(X, 4, mode="online", fp_precision="double")
+    with pytest.raises(ValueError):
+        run_nmf(X, 4, fp_precision="half")

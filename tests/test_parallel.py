@@ -580,3 +580,43 @@ def test_refit_w_staged_accepts_device_resident_x(mesh):
     W_host = refit_w_rowsharded(Xh, H, beta=1.0, h_tol=1e-4, max_iter=150,
                                 stage=False)
     assert np.allclose(W_dev, W_host, rtol=2e-4, atol=1e-6)
+
+
+def test_budget_derives_from_device_memory_stats(monkeypatch):
+    """The slice budget scales with the device's actual free HBM (VERDICT
+    r4 item 5): a part reporting 32 GB free must admit more replicates per
+    slice than the v5e-tuned 1 GiB fallback, stats-less runtimes (CPU, the
+    tunneled TPU) must keep the fallback exactly, and the env override
+    wins over both."""
+    from cnmf_torch_tpu.parallel import auto_replicates_per_batch
+    from cnmf_torch_tpu.parallel import replicates as reps
+
+    class FakeDev:
+        def __init__(self, stats):
+            self._stats = stats
+
+        def memory_stats(self):
+            return self._stats
+
+    # pin the environment: the baseline must be the fallback constant even
+    # on hosts whose real device reports large free HBM or where the env
+    # override is exported
+    monkeypatch.delenv("CNMF_TPU_BUDGET_ELEMS", raising=False)
+    monkeypatch.setattr(reps.jax, "devices", lambda: [FakeDev(None)])
+    fallback = auto_replicates_per_batch(10000, 2000, 9, beta=1.0,
+                                         chunk=5000)
+    big = {"bytes_limit": 32 << 30, "bytes_in_use": 1 << 30}
+    monkeypatch.setattr(reps.jax, "devices", lambda: [FakeDev(big)])
+    scaled = auto_replicates_per_batch(10000, 2000, 9, beta=1.0, chunk=5000)
+    assert scaled > fallback
+    # 30% of free, floored at the fallback
+    free = (32 << 30) - (1 << 30)
+    assert reps._device_budget_elems() == (free * 3 // 10) // 4
+
+    monkeypatch.setattr(reps.jax, "devices", lambda: [FakeDev({})])
+    assert reps._device_budget_elems() == reps._FALLBACK_BUDGET_ELEMS
+    monkeypatch.setattr(reps.jax, "devices", lambda: [FakeDev(None)])
+    assert reps._device_budget_elems() == reps._FALLBACK_BUDGET_ELEMS
+
+    monkeypatch.setenv("CNMF_TPU_BUDGET_ELEMS", str(1 << 20))
+    assert reps._device_budget_elems() == 1 << 20
