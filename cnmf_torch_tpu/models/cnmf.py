@@ -880,7 +880,7 @@ class cNMF:
     # refits
     # ------------------------------------------------------------------
 
-    def refit_usage(self, X, spectra, usage=None):
+    def refit_usage(self, X, spectra, usage=None, k_pad=None):
         """Fixed-spectra usage refit via the jitted MU H-solver
         (``cnmf.py:923-976`` -> :func:`cnmf_torch_tpu.ops.nmf.fit_h`).
         The H-subproblem is convex, so the fixed-key random init gives a
@@ -909,6 +909,9 @@ class cNMF:
         if isinstance(spectra, pd.DataFrame):
             spectra = spectra.values
         if X.shape[0] >= self.rowshard_threshold and usage is None:
+            # k_pad (the packed K-selection entry) applies to the in-core
+            # fit_h path only: the row-sharded solver compiles per-K, so
+            # atlas-scale K-selection keeps per-K refit executables
             from ..parallel import default_mesh, fit_h_rowsharded
 
             mesh = default_mesh(axis_name="cells")
@@ -930,7 +933,8 @@ class cNMF:
             h_tol=0.05,
             l1_reg_H=float(kwargs["l1_ratio_H"]),
             l2_reg_H=0.0,
-            beta=beta)
+            beta=beta,
+            k_pad=k_pad)
 
     def refit_spectra(self, X, usage):
         """Transpose trick (``cnmf.py:979-994``) below the rowshard
@@ -1049,6 +1053,59 @@ class cNMF:
         with concurrent.futures.ThreadPoolExecutor(min(8, len(jobs))) as ex:
             list(ex.map(run_one, jobs))
 
+    def _warm_kselection_packed(self, R_max, K_max, n_hv, g_hv, cf):
+        """Warm the packed K-selection program set (kmeans / silhouette /
+        usage-refit at the sweep's shared padded shapes) concurrently —
+        the packed analog of :meth:`_warm_consensus_programs`, three
+        executables instead of three per K."""
+        sig = ("kpacked", int(R_max), int(K_max), int(n_hv), int(g_hv))
+        if sig in self._warmed:
+            return
+        self._warmed.add(sig)
+
+        import jax.numpy as jnp
+
+        with open(self.paths["nmf_run_parameters"]) as f:
+            kw = yaml.load(f, Loader=yaml.FullLoader)
+        beta = beta_loss_to_float(kw["beta_loss"])
+        cmi = int(kw.get("online_chunk_max_iter", _DEFAULT_CHUNK_MAX_ITER))
+        csz = int(kw.get("online_chunk_size", 5000))
+        l1H = float(kw.get("l1_ratio_H", 0.0))
+
+        ones_Rg = np.ones((int(R_max), int(g_hv)), np.float32)
+
+        def warm_kmeans():
+            kmeans(ones_Rg, int(K_max), n_init=10, seed=1,
+                   n_rows=int(R_max), k_pad=int(K_max))
+
+        def warm_sil():
+            silhouette_score(ones_Rg, np.zeros((int(R_max),), np.int32),
+                             n_rows=int(R_max), k_pad=int(K_max))
+
+        def warm_refit():
+            # kk < K_max exercises the padded-init gather ops too
+            kk = max(1, int(K_max) - 1)
+            fit_h(jnp.ones((int(n_hv), int(g_hv)), jnp.float32),
+                  np.ones((kk, int(g_hv)), np.float32), chunk_size=csz,
+                  chunk_max_iter=cmi, h_tol=0.05, l1_reg_H=l1H,
+                  l2_reg_H=0.0, beta=beta, k_pad=int(K_max))
+
+        jobs = [warm_kmeans, warm_sil]
+        if n_hv < self.rowshard_threshold:
+            # above the threshold refit_usage takes fit_h_rowsharded, which
+            # compiles per-K (k_pad unsupported there) — warming this
+            # executable would only pin a useless (n, g) dummy in HBM
+            jobs.append(warm_refit)
+
+        def run_one(job):
+            try:
+                job()
+            except Exception:
+                pass
+
+        with cf.ThreadPoolExecutor(len(jobs)) as ex:
+            list(ex.map(run_one, jobs))
+
     # ------------------------------------------------------------------
     # consensus
     # ------------------------------------------------------------------
@@ -1059,13 +1116,25 @@ class cNMF:
                   build_ref=True, skip_density_and_return_after_stats=False,
                   close_clustergram_fig=False, refit_usage=True,
                   normalize_tpm_spectra=False, norm_counts=None,
-                  ols_batch_size=65536):
+                  ols_batch_size=65536, _packed_dims=None):
         """Consensus spectra/usages from the merged replicate matrix
         (``cnmf.py:997-1256``): L2-normalize, KNN local-density outlier
         filter (cached), k-means(k, 10 inits, fixed key), cluster medians,
         usage refits, TPM- and z-score-unit spectra, artifacts + clustergram.
+
+        ``_packed_dims`` ((R_max, K_max), stats-only runs): route the
+        k-means / silhouette / usage-refit dispatches through the packed
+        K-selection programs compiled once at the sweep's padded shapes —
+        ``k_selection_plot`` passes this so its 9 Ks share 3 executables
+        instead of paying ~3 first-dispatch uploads each (see
+        ops/kmeans.py:_kmeans_packed_jit for the padding parity argument).
         """
         merged_spectra = load_df_from_npz(self.paths["merged_spectra"] % k)
+        if _packed_dims is not None and not (
+                skip_density_and_return_after_stats
+                and merged_spectra.shape[0] <= _packed_dims[0]
+                and int(k) <= _packed_dims[1]):
+            _packed_dims = None  # partial-run ledger over-estimate: fall back
         if norm_counts is None:
             norm_counts = read_h5ad(self.paths["normalized_counts"])
 
@@ -1076,7 +1145,10 @@ class cNMF:
         n_neighbors = int(local_neighborhood_size
                           * merged_spectra.shape[0] / k)
 
-        if os.environ.get("CNMF_WARM_CONSENSUS", "1") != "0":
+        if (os.environ.get("CNMF_WARM_CONSENSUS", "1") != "0"
+                and _packed_dims is None):
+            # packed stats runs warm their (shared) program set in
+            # k_selection_plot instead of a per-K set here
             self._warm_consensus_programs(
                 merged_spectra.shape[0], int(k), norm_counts.X.shape[0],
                 norm_counts.X.shape[1], n_neighbors,
@@ -1131,9 +1203,21 @@ class cNMF:
         # matrix's static shape, so every density threshold in a tuning
         # sweep reuses one compiled program (no per-surviving-count
         # recompiles); the unfiltered paths keep the unmasked program
-        labels_all, _centers, _inertia = kmeans(l2_spectra.values, k,
-                                                n_init=10, seed=1,
-                                                mask=kmeans_mask)
+        l2_padded = None
+        labels_padded = None
+        if _packed_dims is not None:
+            R_actual = l2_spectra.shape[0]
+            l2_padded = np.zeros((_packed_dims[0], l2_spectra.shape[1]),
+                                 np.float32)
+            l2_padded[:R_actual] = l2_spectra.values
+            labels_padded, _centers, _inertia = kmeans(
+                l2_padded, int(k), n_init=10, seed=1, n_rows=R_actual,
+                k_pad=_packed_dims[1])
+            labels_all = labels_padded[:R_actual]
+        else:
+            labels_all, _centers, _inertia = kmeans(l2_spectra.values, k,
+                                                    n_init=10, seed=1,
+                                                    mask=kmeans_mask)
         if kmeans_mask is not None:
             l2_spectra = l2_spectra.loc[density_filter, :]
             labels0 = labels_all[kmeans_mask]
@@ -1150,12 +1234,19 @@ class cNMF:
         median_spectra = (median_spectra.T / median_spectra.sum(axis=1)).T
 
         X_resident = self._stage_dense("norm_counts", norm_counts.X)
-        rf_usages = self.refit_usage(X_resident, median_spectra)
+        rf_usages = self.refit_usage(
+            X_resident, median_spectra,
+            k_pad=None if _packed_dims is None else _packed_dims[1])
         rf_usages = pd.DataFrame(rf_usages, index=norm_counts.obs.index,
                                  columns=median_spectra.index)
 
         if skip_density_and_return_after_stats:
-            silhouette = silhouette_score(l2_spectra.values, labels0, k)
+            if _packed_dims is not None:
+                silhouette = silhouette_score(
+                    l2_padded, labels_padded, n_rows=l2_spectra.shape[0],
+                    k_pad=_packed_dims[1])
+            else:
+                silhouette = silhouette_score(l2_spectra.values, labels0, k)
             prediction_error = _frobenius_prediction_error(
                 norm_counts.X, rf_usages.values, median_spectra.values)
             consensus_stats = pd.DataFrame(
@@ -1307,41 +1398,39 @@ class cNMF:
         norm_counts = read_h5ad(self.paths["normalized_counts"])
         ks_sorted = sorted(set(run_params.n_components))
 
+        # every K's stats pass dispatches through ONE K_max/R_max-padded
+        # program set (packed kmeans / silhouette / usage refit — padding
+        # parity argued at their definitions), so a 9-K sweep uploads 3
+        # executables instead of ~27; the ledger gives each K's merged-
+        # spectra row count (over-estimates on dead-worker runs fall back
+        # per-K inside consensus)
+        R_by_k = {int(k): int((run_params.n_components == k).sum()) * int(k)
+                  for k in ks_sorted}
+        packed_dims = (max(R_by_k.values()), int(max(ks_sorted)))
+
         if os.environ.get("CNMF_WARM_CONSENSUS", "1") != "0":
-            # warm EVERY K's stats-only consensus programs concurrently up
-            # front: each per-K program otherwise pays its first-dispatch
-            # upload inside the serial loop below (measured 46.7 s cold vs
-            # 10.9 s warm for a K=5..13 sweep on a tunneled chip). X stages
-            # once, serially, before the pool — _stage_dense is not
-            # thread-safe against 9 simultaneous cache misses.
+            # warm the packed program set concurrently up front: each
+            # executable's first dispatch pays a ~2 s program-upload round
+            # trip on a tunneled chip regardless of compile caching. X
+            # stages once, serially — _stage_dense is not thread-safe
+            # against simultaneous cache misses.
             import concurrent.futures
 
             self._stage_dense("norm_counts", norm_counts.X)
-
-            def _warm_k(k):
-                # ledger-derived merged-spectra rows; on partial (dead
-                # worker) runs this can over-estimate, costing only a warm
-                # miss for that K
-                R_k = int((run_params.n_components == k).sum()) * int(k)
-                # norm_counts=None: residency is guaranteed by the serial
-                # pre-stage above; passing it would add a redundant
-                # O(nnz) content-fingerprint scan per thread
-                self._warm_consensus_programs(
-                    R_k, int(k), norm_counts.X.shape[0],
-                    norm_counts.X.shape[1], int(0.30 * R_k / int(k)), True,
-                    norm_counts=None)
-
-            with concurrent.futures.ThreadPoolExecutor(
-                    min(8, len(ks_sorted))) as ex:
-                list(ex.map(_warm_k, ks_sorted))
-            self._warm_dummies.clear()  # release the shared dummy buffers
+            self._warm_kselection_packed(
+                packed_dims[0], packed_dims[1], norm_counts.X.shape[0],
+                norm_counts.X.shape[1], concurrent.futures)
 
         stats = []
         for k in ks_sorted:
             stats.append(self.consensus(
                 int(k), skip_density_and_return_after_stats=True,
                 show_clustering=False, close_clustergram_fig=True,
-                norm_counts=norm_counts).stats)
+                norm_counts=norm_counts, _packed_dims=packed_dims).stats)
+        # a per-K fallback (ledger over-estimate) routes through
+        # _warm_consensus_programs, whose shared dummy buffers are
+        # dataset-sized device arrays — release them
+        self._warm_dummies.clear()
         stats = pd.DataFrame(stats)
         stats.reset_index(drop=True, inplace=True)
         save_df_to_npz(stats, self.paths["k_selection_stats"])

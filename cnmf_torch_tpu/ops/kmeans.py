@@ -134,6 +134,94 @@ def _lloyd_masked(X, C0, max_iter: int, shift_tol, mask):
     return labels, C, inertia
 
 
+def _kmeanspp_packed(key, X, k_pad: int, k_actual, n_rows, row_mask):
+    """kmeans++ seeding at K_max-padded static shape, reproducing the
+    per-K unmasked stream: rows beyond ``n_rows`` are zero padding (masked
+    out of the selection weights), picks beyond ``k_actual`` draw but are
+    discarded to zero centers. Threefry prefix properties make the first
+    ``k_actual`` picks bit-compatible with ``_kmeanspp`` on the unpadded
+    array: ``split(key, K_max-1)[:k-1] == split(key, k-1)`` and
+    ``randint``/``choice`` are invariant to traced bounds and zero-padded
+    probability tails (pinned by test)."""
+    n = X.shape[0]
+    key, sub = jax.random.split(key)
+    first = jax.random.randint(sub, (), 0, n_rows)
+    c0 = X[first]
+    min_d2 = jnp.sum((X - c0[None, :]) ** 2, axis=1)
+
+    def pick(carry, sub_j):
+        min_d2 = carry
+        sub, j = sub_j
+        w = min_d2 * row_mask
+        w = jnp.where(w.sum() > 1e-30, w, row_mask)
+        p = w / jnp.maximum(w.sum(), 1e-30)
+        idx = jax.random.choice(sub, n, p=p)
+        c = X[idx]
+        d2 = jnp.sum((X - c[None, :]) ** 2, axis=1)
+        take = j < (k_actual - 1)
+        return (jnp.where(take, jnp.minimum(min_d2, d2), min_d2),
+                jnp.where(take, c, jnp.zeros_like(c)))
+
+    subs = jax.random.split(key, k_pad - 1)
+    _, rest = jax.lax.scan(pick, min_d2, (subs, jnp.arange(k_pad - 1)))
+    return jnp.concatenate([c0[None, :], rest], axis=0)
+
+
+def _lloyd_packed(X, C0, max_iter: int, shift_tol, row_mask, col_mask):
+    """Lloyd at padded static shape: padding rows contribute nothing to
+    center updates or inertia; padding clusters (``col_mask=0``) never win
+    an assignment and their (zero) centers never move, so the shift
+    criterion accumulates exact +0.0 from them."""
+    def assign(C):
+        d2 = _sq_dists(X, C)
+        return jnp.argmin(jnp.where(col_mask[None, :], d2, jnp.inf), axis=1)
+
+    def body(carry):
+        C, _, it = carry
+        labels = assign(C)
+        onehot = jax.nn.one_hot(labels, C.shape[0], dtype=X.dtype)
+        onehot = onehot * row_mask[:, None]
+        counts = onehot.sum(axis=0)
+        sums = onehot.T @ X
+        newC = jnp.where(counts[:, None] > 0,
+                         sums / jnp.maximum(counts, 1.0)[:, None], C)
+        shift = jnp.sum((newC - C) ** 2)
+        return (newC, shift, it + 1)
+
+    def cond(carry):
+        _, shift, it = carry
+        return (it < max_iter) & (shift > shift_tol)
+
+    C, _, _ = jax.lax.while_loop(
+        cond, body, (C0, jnp.asarray(jnp.inf, X.dtype), jnp.int32(0)))
+    labels = assign(C)
+    d2 = _sq_dists(X, C)
+    inertia = jnp.sum(
+        jnp.min(jnp.where(col_mask[None, :], d2, jnp.inf), axis=1) * row_mask)
+    return labels, C, inertia
+
+
+@functools.partial(jax.jit, static_argnames=("k_pad", "n_init", "max_iter"))
+def _kmeans_packed_jit(X, k_actual, n_rows, k_pad: int, n_init: int,
+                       max_iter: int, tol, key):
+    row_mask = (jnp.arange(X.shape[0]) < n_rows).astype(X.dtype)
+    col_mask = jnp.arange(k_pad) < k_actual
+    # sklearn's tol scaling over the REAL rows only (weighted population
+    # variance; matches jnp.var on the unpadded array up to summation order)
+    wm = row_mask / jnp.maximum(row_mask.sum(), 1e-30)
+    mu = (X * wm[:, None]).sum(axis=0)
+    var = (wm[:, None] * (X - mu[None, :]) ** 2).sum(axis=0)
+    shift_tol = tol * jnp.mean(var)
+
+    def one(key):
+        C0 = _kmeanspp_packed(key, X, k_pad, k_actual, n_rows, row_mask)
+        return _lloyd_packed(X, C0, max_iter, shift_tol, row_mask, col_mask)
+
+    labels, Cs, inertias = jax.vmap(one)(jax.random.split(key, n_init))
+    best = jnp.argmin(inertias)
+    return labels[best], Cs[best], inertias[best]
+
+
 @functools.partial(jax.jit,
                    static_argnames=("k", "n_init", "max_iter", "has_mask"))
 def _kmeans_jit(X, k: int, n_init: int, max_iter: int, tol, key,
@@ -163,7 +251,8 @@ def _kmeans_jit(X, k: int, n_init: int, max_iter: int, tol, key,
 
 
 def kmeans(X, k: int, n_init: int = 10, max_iter: int = 300,
-           tol: float = 1e-4, seed: int = 1, mask=None):
+           tol: float = 1e-4, seed: int = 1, mask=None,
+           n_rows: int | None = None, k_pad: int | None = None):
     """Cluster rows of X; returns ``(labels, centers, inertia)`` as numpy.
 
     ``seed=1`` mirrors the reference's fixed ``random_state=1``
@@ -173,12 +262,32 @@ def kmeans(X, k: int, n_init: int = 10, max_iter: int = 300,
     excluded from seeding, center updates, and inertia — the clustering of
     the masked subset at the FULL array's static shape, so a consensus
     density-threshold sweep reuses ONE compiled program instead of
-    recompiling per surviving-row count (labels come back for every row;
+    recompiling per surviving-count (labels come back for every row;
     callers subset them). Without ``mask`` the program (and its RNG stream)
     is unchanged.
+
+    ``n_rows``/``k_pad`` (the packed K-selection entry, both required
+    together, exclusive with ``mask``): X arrives zero-row-padded to a
+    shared R_max and the program is compiled at cluster width ``k_pad``;
+    only the first ``n_rows`` rows and ``k`` clusters are real. One
+    compiled program then serves EVERY K of a selection sweep (k and
+    n_rows are traced scalars), reproducing each per-K program's RNG
+    stream via the threefry prefix properties. Labels come back for all
+    padded rows; callers slice ``[:n_rows]``.
     """
     X = jnp.asarray(np.asarray(X), dtype=jnp.float32)
-    if mask is None:
+    if (n_rows is None) != (k_pad is None):
+        raise ValueError("n_rows and k_pad must be passed together")
+    if k_pad is not None:
+        if mask is not None:
+            raise ValueError("mask is not supported with the packed entry")
+        if not (0 < k <= k_pad and 0 < n_rows <= X.shape[0]):
+            raise ValueError(f"invalid packed dims k={k} k_pad={k_pad} "
+                             f"n_rows={n_rows} R_max={X.shape[0]}")
+        labels, C, inertia = _kmeans_packed_jit(
+            X, jnp.int32(k), jnp.int32(n_rows), int(k_pad), int(n_init),
+            int(max_iter), jnp.float32(tol), jax.random.key(seed))
+    elif mask is None:
         labels, C, inertia = _kmeans_jit(
             X, int(k), int(n_init), int(max_iter), jnp.float32(tol),
             jax.random.key(seed))

@@ -77,9 +77,54 @@ def _silhouette_from_dists(D, labels, k: int):
     return jnp.mean(s)
 
 
-def silhouette_score(X, labels, k: int | None = None, D=None) -> float:
-    """Mean silhouette coefficient, euclidean metric (cnmf.py:1097)."""
+@functools.partial(jax.jit, static_argnames=("k_pad",))
+def _silhouette_packed(X, labels, k_pad: int, n_rows):
+    """Silhouette at K_max/R_max-padded static shape: rows beyond
+    ``n_rows`` (zero padding) are excluded from cluster sums, counts, and
+    the final mean; clusters with no real members (including every index
+    >= the sweep's actual k) are excluded from the b_i minimum exactly as
+    empty clusters already are. Real-pair distances are computed on the
+    same g-length contractions as the unpadded program, so per-K values
+    match the per-K executable to fp-summation order."""
+    n = X.shape[0]
+    row_mask = (jnp.arange(n) < n_rows)
+    row_maskf = row_mask.astype(X.dtype)
+    D = _pairwise_euclidean(X)
+    onehot = jax.nn.one_hot(labels, k_pad, dtype=D.dtype) * row_maskf[:, None]
+    counts = onehot.sum(axis=0)
+    sums = D @ onehot
+
+    own_count = counts[labels]
+    own_sum = jnp.take_along_axis(sums, labels[:, None], axis=1)[:, 0]
+    a = own_sum / jnp.maximum(own_count - 1.0, 1.0)
+
+    mean_other = sums / jnp.maximum(counts[None, :], 1.0)
+    mask = (jax.nn.one_hot(labels, k_pad, dtype=bool)) | (counts[None, :] == 0)
+    b = jnp.min(jnp.where(mask, jnp.inf, mean_other), axis=1)
+
+    s = (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30)
+    s = jnp.where(own_count <= 1.0, 0.0, s)
+    return jnp.sum(s * row_maskf) / jnp.maximum(n_rows.astype(D.dtype), 1.0)
+
+
+def silhouette_score(X, labels, k: int | None = None, D=None,
+                     n_rows: int | None = None,
+                     k_pad: int | None = None) -> float:
+    """Mean silhouette coefficient, euclidean metric (cnmf.py:1097).
+
+    ``n_rows``/``k_pad`` (together): the packed K-selection entry — X and
+    labels arrive padded to a shared (R_max,) shape and one compiled
+    program serves every K of a sweep (see :func:`~..ops.kmeans.kmeans`).
+    """
     labels = jnp.asarray(np.asarray(labels), jnp.int32)
+    if (n_rows is None) != (k_pad is None):
+        raise ValueError("n_rows and k_pad must be passed together")
+    if k_pad is not None:
+        if D is not None:
+            raise ValueError("precomputed D is not supported when packed")
+        X = jnp.asarray(np.asarray(X), jnp.float32)
+        return float(_silhouette_packed(X, labels, int(k_pad),
+                                        jnp.int32(n_rows)))
     if k is None:
         k = int(np.max(np.asarray(labels))) + 1
     if D is None:

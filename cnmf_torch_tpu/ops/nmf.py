@@ -315,6 +315,24 @@ def nmf_fit_batch(X, H0, W0, beta: float = 2.0, tol: float = 1e-4,
 # HALS solver (beta=2) — nmf-torch's second solver family ('halsvar')
 # ---------------------------------------------------------------------------
 
+def _hals_sweep(M, G, C, l1, l2):
+    """One HALS sweep over the k columns of M against Gram G and target C:
+    ``M[:, j] <- max((C[:, j] - M G[:, j] + G[j, j] M[:, j] - l1) /
+    (G[j, j] + l2), 0)`` — the closed-form ridge column solve with the
+    other components fixed (numer excludes component j's own contribution,
+    so L2 shrinks toward zero). The ONE definition behind every HALS
+    update: H directly ((n, k) against WW^T and XW^T), and W via transpose
+    ((g, k) against H^T H and (H^T X)^T) — G is symmetric."""
+    k = M.shape[1]
+
+    def upd(j, M):
+        numer = C[:, j] - M @ G[:, j] + G[j, j] * M[:, j] - l1
+        denom = G[j, j] + l2 + EPS
+        return M.at[:, j].set(jnp.maximum(numer / denom, 0.0))
+
+    return jax.lax.fori_loop(0, k, upd, M)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("max_iter", "l1_H", "l2_H", "l1_W", "l2_W"),
@@ -344,30 +362,10 @@ def nmf_fit_batch_hals(X, H0, W0, tol: float = 1e-4, max_iter: int = 200,
     k = H0.shape[1]
 
     def sweep_H(H, W):
-        XWt = X @ W.T
-        WWt = W @ W.T
-
-        def upd(j, H):
-            # closed-form ridge column solve with the other components
-            # fixed: numer excludes component j's own contribution, so L2
-            # shrinks toward zero (an incremental '+ grad/denom' form would
-            # shrink toward the previous iterate instead)
-            numer = XWt[:, j] - H @ WWt[:, j] + WWt[j, j] * H[:, j] - l1_H
-            denom = WWt[j, j] + l2_H + EPS
-            return H.at[:, j].set(jnp.maximum(numer / denom, 0.0))
-
-        return jax.lax.fori_loop(0, k, upd, H)
+        return _hals_sweep(H, W @ W.T, X @ W.T, l1_H, l2_H)
 
     def sweep_W(H, W):
-        HtX = H.T @ X
-        HtH = H.T @ H
-
-        def upd(j, W):
-            numer = HtX[j, :] - HtH[j, :] @ W + HtH[j, j] * W[j, :] - l1_W
-            denom = HtH[j, j] + l2_W + EPS
-            return W.at[j, :].set(jnp.maximum(numer / denom, 0.0))
-
-        return jax.lax.fori_loop(0, k, upd, W)
+        return _hals_sweep(W.T, H.T @ H, (H.T @ X).T, l1_W, l2_W).T
 
     err0 = beta_divergence(X, H0, W0, beta=2.0)
 
@@ -553,6 +551,46 @@ def _solve_w_from_stats(W, A, B, l1_W, l2_W, max_iter, tol):
     return W
 
 
+def _solve_w_from_stats_hals(W, A, B, l1_W, l2_W, max_iter, tol):
+    """HALS analog of :func:`_solve_w_from_stats`: row sweeps of W from the
+    accumulated pass statistics A = H^T X, B = H^T H alone, stopping on the
+    same relative-change criterion."""
+    def w_body(carry):
+        W, _, it = carry
+        W_new = _hals_sweep(W.T, B, A.T, l1_W, l2_W).T
+        rel = jnp.linalg.norm(W_new - W) / (jnp.linalg.norm(W) + EPS)
+        return (W_new, rel, it + 1)
+
+    def w_cond(carry):
+        _, rel, it = carry
+        return (it < max_iter) & (rel >= tol)
+
+    rel0 = jnp.inf + 0.0 * jnp.sum(W)
+    W, _, _ = jax.lax.while_loop(w_cond, w_body, (W, rel0, jnp.int32(0)))
+    return W
+
+
+def _chunk_h_hals_solve(x, h, W, WWT, l1, l2, max_iter, h_tol):
+    """HALS analog of :func:`_chunk_h_solve` (Frobenius only): column
+    sweeps of one chunk's usage block with W fixed, until the block's
+    relative Frobenius change drops below ``h_tol`` or ``max_iter``."""
+    XWt = x @ W.T
+
+    def body(carry):
+        h, _, it = carry
+        h_new = _hals_sweep(h, WWT, XWt, l1, l2)
+        rel = jnp.linalg.norm(h_new - h) / (jnp.linalg.norm(h) + EPS)
+        return (h_new, rel, it + 1)
+
+    def cond(carry):
+        _, rel, it = carry
+        return (it < max_iter) & (rel >= h_tol)
+
+    rel0 = jnp.inf + 0.0 * jnp.sum(h)
+    h, _, _ = jax.lax.while_loop(cond, body, (h, rel0, jnp.int32(0)))
+    return h
+
+
 def _chunk_h_solve(x, h, W, WWT, beta, l1, l2, max_iter, h_tol):
     """Inner MU loop on one chunk's usage block with W fixed.
 
@@ -595,13 +633,13 @@ def _chunk_h_solve(x, h, W, WWT, beta, l1, l2, max_iter, h_tol):
 @functools.partial(
     jax.jit,
     static_argnames=("beta", "chunk_max_iter", "n_passes", "l1_H", "l2_H",
-                     "l1_W", "l2_W", "h_tol_start"),
+                     "l1_W", "l2_W", "h_tol_start", "algo"),
 )
 def nmf_fit_online(Xc, Hc0, W0, beta: float = 2.0, tol: float = 1e-4,
                    h_tol: float = 1e-3, chunk_max_iter: int = 1000,
                    n_passes: int = 20, l1_H: float = 0.0, l2_H: float = 0.0,
                    l1_W: float = 0.0, l2_W: float = 0.0,
-                   h_tol_start: float | None = None):
+                   h_tol_start: float | None = None, algo: str = "mu"):
     """Streamed MU over pre-chunked inputs.
 
     ``Xc``: (n_chunks, chunk, genes) row-chunked data (zero-padded rows are
@@ -614,7 +652,17 @@ def nmf_fit_online(Xc, Hc0, W0, beta: float = 2.0, tol: float = 1e-4,
     statistics. Passes stop on relative objective decrease < ``tol``
     (mirrors the ledger's online contract, cnmf.py:765-767, with the pass
     loop playing nmf-torch's ``max_pass`` role). Returns ``(Hc, W, err)``.
+
+    ``algo='halsvar'`` (beta=2 only — nmf-torch's online HALS family)
+    swaps the inner chunk-usage solver and the per-pass W solve for HALS
+    column/row sweeps over the SAME accumulated (A, B) statistics; the
+    pass loop, coarse-to-fine tolerance schedule, and stopping rule are
+    shared with the MU path.
     """
+    if algo not in ("mu", "halsvar"):
+        raise ValueError(f"unknown online algo {algo!r}")
+    if algo == "halsvar" and beta != 2.0:
+        raise ValueError("algo='halsvar' optimizes the Frobenius objective")
     k = W0.shape[0]
     g = W0.shape[1]
 
@@ -641,8 +689,12 @@ def nmf_fit_online(Xc, Hc0, W0, beta: float = 2.0, tol: float = 1e-4,
             def scan_chunk(acc, xc_hc):
                 A, B, err_acc = acc
                 x, h = xc_hc
-                h = _chunk_h_solve(x, h, W, WWT, beta, l1_H, l2_H,
-                                   chunk_max_iter, h_tol_p)
+                if algo == "halsvar":
+                    h = _chunk_h_hals_solve(x, h, W, WWT, l1_H, l2_H,
+                                            chunk_max_iter, h_tol_p)
+                else:
+                    h = _chunk_h_solve(x, h, W, WWT, beta, l1_H, l2_H,
+                                       chunk_max_iter, h_tol_p)
                 A = A + h.T @ x
                 B = B + h.T @ h
                 err_c = beta_divergence(x, h, W, beta=2.0)
@@ -651,8 +703,9 @@ def nmf_fit_online(Xc, Hc0, W0, beta: float = 2.0, tol: float = 1e-4,
             acc0 = (jnp.zeros((k, g), Xc.dtype), jnp.zeros((k, k), Xc.dtype),
                     jnp.float32(0.0))
             (A, B, err), Hc = jax.lax.scan(scan_chunk, acc0, (Xc, Hc))
-            W = _solve_w_from_stats(W, A, B, l1_W, l2_W, chunk_max_iter,
-                                    h_tol_p)
+            w_solve = (_solve_w_from_stats_hals if algo == "halsvar"
+                       else _solve_w_from_stats)
+            W = w_solve(W, A, B, l1_W, l2_W, chunk_max_iter, h_tol_p)
         else:
             # true online flavor for the non-quadratic losses: each chunk's
             # usage block is solved with W frozen, then W takes one
@@ -760,7 +813,7 @@ def _chunk_rows(X, H, chunk_size):
 
 def fit_h(X, W, H_init=None, chunk_size: int = 5000, chunk_max_iter: int = 200,
           h_tol: float = 0.05, l1_reg_H: float = 0.0, l2_reg_H: float = 0.0,
-          beta: float = 2.0, key=None) -> np.ndarray:
+          beta: float = 2.0, key=None, k_pad: int | None = None) -> np.ndarray:
     """Fit usages H for fixed spectra W — the ``fit_H_online`` equivalent
     (cnmf.py:260-388): one pass over row chunks, inner MU loop per chunk with
     relative-change tolerance ``h_tol``, uniform random init when ``H_init``
@@ -770,6 +823,15 @@ def fit_h(X, W, H_init=None, chunk_size: int = 5000, chunk_max_iter: int = 200,
     ``jax.Array`` (the consensus stage stages X once and reuses it across
     its three refits and the K sweep instead of re-crossing the host link
     per call) — and returns a numpy (n, k) array.
+
+    ``k_pad``: compile the solve at component width ``k_pad`` with W
+    zero-row-padded, so one executable serves every K of a selection
+    sweep. Exact-zero padding is absorbing under MU (padded usage columns
+    start at exact 0 via the threefry flat-prefix gather and never leave
+    it; padded W rows contribute exact +0.0 to every real column's
+    numerator/denominator), so the first k columns reproduce the per-K
+    program to fp-tiling order. The returned array is sliced back to
+    (n, k).
     """
     if isinstance(X, jax.Array):
         X = X.astype(jnp.float32)
@@ -780,19 +842,43 @@ def fit_h(X, W, H_init=None, chunk_size: int = 5000, chunk_max_iter: int = 200,
     W = jnp.asarray(np.asarray(W), dtype=jnp.float32)
     n = X.shape[0]
     k = W.shape[0]
+    k_solve = k
+    if k_pad is not None:
+        if k_pad < k:
+            raise ValueError(f"k_pad={k_pad} < k={k}")
+        k_solve = int(k_pad)
+        W = jnp.pad(W, ((0, k_solve - k), (0, 0)))
     if H_init is None:
         if key is None:
             key = jax.random.key(0)
-        H = jax.random.uniform(key, (n, k), dtype=jnp.float32)
+        if k_solve != k:
+            # per-K parity: uniform(key, (n, k)) is the row-major prefix of
+            # the flat (n*k_pad,) stream, so gathering flat[i*k + j] for
+            # j < k (0.0 beyond) reproduces the unpadded init bit-exactly
+            # in the real columns with exact-zero padding
+            flat = jax.random.uniform(key, (n * k_solve,), dtype=jnp.float32)
+            cols = np.arange(k_solve)[None, :]
+            idx = np.arange(n)[:, None] * k + cols
+            valid = jnp.asarray(cols < k)
+            H = jnp.where(valid,
+                          jnp.take(flat, jnp.asarray(np.where(cols < k, idx,
+                                                              0))),
+                          0.0)
+        else:
+            H = jax.random.uniform(key, (n, k), dtype=jnp.float32)
     else:
         H = jnp.maximum(jnp.asarray(np.asarray(H_init), dtype=jnp.float32), 0.0)
+        if k_solve != H.shape[1]:
+            H = jnp.pad(H, ((0, 0), (0, k_solve - H.shape[1])))
     chunk_size = int(min(chunk_size, n))
     Xc, Hc, pad = _chunk_rows(X, H, chunk_size)
     Hc = _fit_h_chunked(Xc, Hc, W, float(beta), int(chunk_max_iter),
                         float(h_tol), float(l1_reg_H), float(l2_reg_H))
-    H = Hc.reshape(-1, k)
+    H = Hc.reshape(-1, k_solve)
     if pad:
         H = H[:n]
+    if k_solve != k:
+        H = H[:, :k]
     return np.asarray(H)
 
 
@@ -959,18 +1045,12 @@ def run_nmf(X, n_components: int, init: str = "random",
     if algo not in ("mu", "halsvar"):
         raise NotImplementedError(
             f"algo={algo!r}: 'mu' (all beta losses, batch+online) and "
-            "'halsvar' (frobenius, batch) are implemented")
+            "'halsvar' (frobenius, batch+online) are implemented")
     beta = beta_loss_to_float(beta_loss)
-    if algo == "halsvar":
-        if beta != 2.0:
-            raise ValueError(
-                "algo='halsvar' optimizes the Frobenius objective; use "
-                "algo='mu' for kullback-leibler / itakura-saito")
-        if mode != "batch":
-            raise NotImplementedError(
-                "algo='halsvar' is implemented in batch mode; the online "
-                "pipeline contract always requests algo='mu' "
-                "(reference cnmf.py:764)")
+    if algo == "halsvar" and beta != 2.0:
+        raise ValueError(
+            "algo='halsvar' optimizes the Frobenius objective; use "
+            "algo='mu' for kullback-leibler / itakura-saito")
     online_h_tol, n_passes, h_tol_start = resolve_online_schedule(
         beta, online_h_tol, n_passes)
     if sp.issparse(X):
@@ -1015,7 +1095,7 @@ def run_nmf(X, n_components: int, init: str = "random",
             Xc, Hc, W0, beta=beta, tol=float(tol), h_tol=float(online_h_tol),
             chunk_max_iter=int(online_chunk_max_iter), n_passes=int(n_passes),
             l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W,
-            h_tol_start=h_tol_start)
+            h_tol_start=h_tol_start, algo=algo)
         H = Hc.reshape(-1, k)[:n]
     else:
         raise ValueError(f"unknown mode {mode!r}")

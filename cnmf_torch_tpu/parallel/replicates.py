@@ -85,8 +85,11 @@ def _device_budget_elems() -> int:
     limit = stats.get("bytes_limit")
     if limit:
         free = max(int(limit) - int(stats.get("bytes_in_use", 0)), 0)
-        derived = (free * 3 // 10) // 4
-        return max(derived, _FALLBACK_BUDGET_ELEMS)
+        # trust the derivation in BOTH directions: flooring at the 1 GiB
+        # constant on a nearly-full device would re-admit the OOM class
+        # this budget exists to prevent; 16 MiB keeps degenerate stats
+        # from zeroing the slice size (callers still floor at n_dev reps)
+        return max((free * 3 // 10) // 4, 1 << 22)
     return _FALLBACK_BUDGET_ELEMS
 
 

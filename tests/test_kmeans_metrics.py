@@ -110,3 +110,58 @@ def test_kmeans_masked_matches_subset_quality():
     _, _, inertia_masked = kmeans(np.vstack([X, junk]), 3, seed=1, mask=mask)
     _, _, inertia_subset = kmeans(X, 3, seed=1)
     assert inertia_masked <= inertia_subset * 1.05
+
+
+def test_kmeans_packed_matches_per_k_program():
+    """The packed K-selection kmeans (K_max/R_max-padded, traced k and
+    n_rows) must reproduce the per-K unmasked program's labels exactly:
+    the threefry prefix properties make the kmeans++ streams identical,
+    and zero-padded rows/clusters contribute exact zeros everywhere."""
+    import pytest
+
+    for k, seed in [(3, 1), (5, 1), (3, 7)]:
+        X, _ = _blobs(n_per=25, k=k, spread=0.3, seed=seed)
+        R = X.shape[0]
+        R_max, K_max = R + 37, 8
+        Xp = np.zeros((R_max, X.shape[1]), np.float32)
+        Xp[:R] = X
+        l_ref, c_ref, i_ref = kmeans(X, k, seed=seed)
+        l_pk, c_pk, i_pk = kmeans(Xp, k, seed=seed, n_rows=R, k_pad=K_max)
+        np.testing.assert_array_equal(l_ref, l_pk[:R])
+        np.testing.assert_allclose(c_ref, c_pk[:k], rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(i_ref, i_pk, rtol=1e-5)
+        # padded clusters never receive members and keep zero centers
+        assert (l_pk[:R] < k).all()
+        np.testing.assert_array_equal(c_pk[k:], 0.0)
+
+    # k == K_max and R == R_max degenerate to the unpadded clustering
+    X, _ = _blobs(n_per=20, k=4, spread=0.3)
+    l_ref, _, _ = kmeans(X, 4, seed=1)
+    l_pk, _, _ = kmeans(X.astype(np.float32), 4, seed=1,
+                        n_rows=X.shape[0], k_pad=4)
+    np.testing.assert_array_equal(l_ref, l_pk)
+
+    with pytest.raises(ValueError):
+        kmeans(X, 4, k_pad=8)  # n_rows missing
+    with pytest.raises(ValueError):
+        kmeans(X, 4, n_rows=10, k_pad=2)  # k > k_pad
+    with pytest.raises(ValueError):
+        kmeans(X, 4, n_rows=10, k_pad=8, mask=np.ones(X.shape[0]))
+
+
+def test_silhouette_packed_matches_per_k_program():
+    from cnmf_torch_tpu.ops import silhouette_score
+
+    X, labels = _blobs(n_per=30, k=4, spread=0.4)
+    R = X.shape[0]
+    want = silhouette_score(X, labels, 4)
+    Xp = np.zeros((R + 50, X.shape[1]), np.float32)
+    Xp[:R] = X
+    lp = np.zeros((R + 50,), np.int32)
+    lp[:R] = labels
+    got = silhouette_score(Xp, lp, n_rows=R, k_pad=9)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # padded rows' (arbitrary) labels must not influence the score
+    lp[R:] = 3
+    got2 = silhouette_score(Xp, lp, n_rows=R, k_pad=9)
+    np.testing.assert_allclose(got2, want, rtol=1e-5)

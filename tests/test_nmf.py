@@ -296,10 +296,39 @@ def test_halsvar_solver():
     with pytest.raises(ValueError):
         run_nmf(X, 4, algo="halsvar", beta_loss="kullback-leibler",
                 mode="batch")
-    with pytest.raises(NotImplementedError):
-        run_nmf(X, 4, algo="halsvar", mode="online")
+    with pytest.raises(ValueError):
+        run_nmf(X, 4, algo="halsvar", beta_loss="kullback-leibler",
+                mode="online")
     with pytest.raises(NotImplementedError):
         run_nmf(X, 4, algo="bpp")
+
+
+def test_halsvar_online_matches_batch_objective():
+    """Online-mode HALS (VERDICT r4 item 7, completing nmf-torch's solver
+    matrix minus NNLS-BPP): per-chunk HALS usage sweeps with accumulated
+    (A, B) statistics must reach the batch HALS objective on a fixture
+    small enough for both to converge, and must beat/match online MU."""
+    X, _, _ = _synthetic(n=160, g=60, k=4, noise=0.02)
+    X = X / X.std(axis=0, ddof=1)  # prepare()'s varnorm scaling
+    # one online W update per pass vs 400 batch sweeps: compare at an
+    # explicit pass budget generous enough for both online solvers
+    kw = dict(online_chunk_size=64, tol=1e-7, n_passes=120, random_state=5)
+    H, W, err = run_nmf(X, n_components=4, algo="halsvar", mode="online",
+                        **kw)
+    assert (H >= 0).all() and (W >= 0).all()
+    assert H.shape == (160, 4) and W.shape == (4, 60)
+    _, _, err_batch = run_nmf(X, n_components=4, algo="halsvar",
+                              mode="batch", tol=1e-6, batch_max_iter=400,
+                              random_state=5)
+    assert np.isfinite(err) and err <= err_batch * 1.10
+    _, _, err_mu = run_nmf(X, n_components=4, algo="mu", mode="online", **kw)
+    assert err <= err_mu * 1.05
+
+    # determinism across calls
+    H2, W2, err2 = run_nmf(X, n_components=4, algo="halsvar", mode="online",
+                           **kw)
+    np.testing.assert_array_equal(H, H2)
+    assert err == err2
 
 
 def test_run_nmf_fp_precision_contract():
@@ -331,3 +360,24 @@ def test_run_nmf_fp_precision_contract():
         run_nmf(X, 4, mode="online", fp_precision="double")
     with pytest.raises(ValueError):
         run_nmf(X, 4, fp_precision="half")
+
+
+def test_fit_h_k_pad_matches_unpadded():
+    """fit_h's packed entry (k_pad): zero-padded W rows and the flat-prefix
+    uniform init must reproduce the per-K solve in the real columns and
+    return exact-zero padded columns internally (sliced off)."""
+    X, _, _ = _synthetic(n=150, g=70, k=5, noise=0.02)
+    W = np.random.default_rng(3).gamma(1.0, 1.0, size=(5, 70)).astype(
+        np.float32) + 0.05
+    for beta in (2.0, 1.0):
+        want = fit_h(X, W, chunk_size=64, chunk_max_iter=200, beta=beta)
+        got = fit_h(X, W, chunk_size=64, chunk_max_iter=200, beta=beta,
+                    k_pad=9)
+        assert got.shape == want.shape == (150, 5)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-6)
+    # k_pad == k is the identity configuration
+    same = fit_h(X, W, chunk_size=64, chunk_max_iter=200, k_pad=5)
+    np.testing.assert_array_equal(same, fit_h(X, W, chunk_size=64,
+                                              chunk_max_iter=200))
+    with pytest.raises(ValueError):
+        fit_h(X, W, k_pad=3)

@@ -609,9 +609,15 @@ def test_budget_derives_from_device_memory_stats(monkeypatch):
     monkeypatch.setattr(reps.jax, "devices", lambda: [FakeDev(big)])
     scaled = auto_replicates_per_batch(10000, 2000, 9, beta=1.0, chunk=5000)
     assert scaled > fallback
-    # 30% of free, floored at the fallback
+    # 30% of free
     free = (32 << 30) - (1 << 30)
     assert reps._device_budget_elems() == (free * 3 // 10) // 4
+    # a nearly-full device must shrink BELOW the fallback constant (the
+    # old floor re-admitted the round-2 OOM class on contended HBM)
+    tight = {"bytes_limit": 16 << 30, "bytes_in_use": 15 << 30}
+    monkeypatch.setattr(reps.jax, "devices", lambda: [FakeDev(tight)])
+    assert reps._device_budget_elems() == ((1 << 30) * 3 // 10) // 4
+    assert reps._device_budget_elems() < reps._FALLBACK_BUDGET_ELEMS
 
     monkeypatch.setattr(reps.jax, "devices", lambda: [FakeDev({})])
     assert reps._device_budget_elems() == reps._FALLBACK_BUDGET_ELEMS
