@@ -98,13 +98,20 @@ def synthetic_counts_df(n, g, k_true=14, seed=3):
                         columns=[f"g{j}" for j in range(g)])
 
 
-def read_stage_seconds(timings_tsv):
-    stages = {}
+def iter_stage_rows(timings_tsv):
+    """Yield (stage_name, wall_seconds) rows from a StageTimer ledger, in
+    file order — the ONE parser of the timings-TSV format in this file."""
     with open(timings_tsv) as f:
         next(f)
         for line in f:
             name, secs = line.split("\t")[:2]
-            stages[name] = stages.get(name, 0.0) + float(secs)
+            yield name, float(secs)
+
+
+def read_stage_seconds(timings_tsv):
+    stages = {}
+    for name, secs in iter_stage_rows(timings_tsv):
+        stages[name] = stages.get(name, 0.0) + secs
     return stages
 
 
@@ -114,7 +121,14 @@ def read_stage_seconds(timings_tsv):
 
 def bench_north_star():
     """PBMC-10k-shaped e2e: prepare -> factorize(K=5..13 x 100) -> combine
-    -> consensus(k=9). Returns the headline seconds + stage breakdown."""
+    -> consensus(k=9), run TWICE in-process. The first pass is the cold
+    number (includes whatever compiles/uploads actually happened); the
+    second is the warm steady state — the figure the README quotes, now
+    emitted by the driver's own capture instead of measured out-of-band
+    (VERDICT r4 item 1). The consensus sub-stage ledger
+    (consensus.kmeans/refits/ols/writes, models/cnmf.py) is split into
+    cold/warm breakdowns so device-program cost, host OLS, and file
+    writes are separately attributable."""
     from cnmf_torch_tpu import cNMF
     from cnmf_torch_tpu.utils import save_df_to_npz
 
@@ -125,46 +139,63 @@ def bench_north_star():
     obj = cNMF(output_dir=workdir, name="ns")
     obj.prepare(counts_fn, components=list(range(5, 14)), n_iter=100,
                 seed=14, num_highvar_genes=2000, batch_size=5000)
+    tsv = os.path.join(workdir, "ns", "cnmf_tmp", "ns.timings.tsv")
 
-    t0 = time.perf_counter()
-    obj.factorize()
-    factorize_cold = time.perf_counter() - t0
+    def one_pass():
+        t0 = time.perf_counter()
+        obj.factorize()
+        fact = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        obj.combine()
+        comb = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        try:
+            obj.consensus(k=9, density_threshold=0.5, show_clustering=False)
+        except RuntimeError:
+            # synthetic replicate spectra can be more dispersed than real
+            # PBMC ones; keep the full consensus pipeline in the measurement
+            obj.consensus(k=9, density_threshold=2.0, show_clustering=False)
+        cons = time.perf_counter() - t0
+        return fact, comb, cons
 
-    t0 = time.perf_counter()
-    obj.combine()
-    combine_s = time.perf_counter() - t0
+    def consensus_substages():
+        return [(name, secs) for name, secs in iter_stage_rows(tsv)
+                if name.startswith("consensus.")]
 
-    t0 = time.perf_counter()
-    try:
-        obj.consensus(k=9, density_threshold=0.5, show_clustering=False)
-    except RuntimeError:
-        # synthetic replicate spectra can be more dispersed than real PBMC
-        # ones; keep the full consensus pipeline in the measurement
-        obj.consensus(k=9, density_threshold=2.0, show_clustering=False)
-    consensus_s = time.perf_counter() - t0
+    factorize_cold, combine_cold, consensus_cold = one_pass()
+    sub_cold = consensus_substages()
+    factorize_warm, combine_warm, consensus_warm = one_pass()
+    sub_warm = consensus_substages()[len(sub_cold):]
 
-    # warm factorize: every (shape, config) program is now compiled, so this
-    # is the steady-state solver rate; cold - warm ~= XLA compile overhead
-    t0 = time.perf_counter()
-    obj.factorize()
-    factorize_warm = time.perf_counter() - t0
+    def agg(rows):
+        out: dict = {}
+        for name, secs in rows:
+            key = name.split(".", 1)[1]
+            out[key] = round(out.get(key, 0.0) + secs, 3)
+        return out
 
-    stages = read_stage_seconds(
-        os.path.join(workdir, "ns", "cnmf_tmp", "ns.timings.tsv"))
+    stages = read_stage_seconds(tsv)
     shutil.rmtree(workdir)
-    e2e = factorize_cold + combine_s + consensus_s
+    e2e = factorize_cold + combine_cold + consensus_cold
+    warm_e2e = factorize_warm + combine_warm + consensus_warm
     prepare_s = stages.get("prepare", 0.0)
     return {
         "e2e_seconds": round(e2e, 3),
         # the wall-clock a user actually experiences, prepare included
         "e2e_with_prepare_seconds": round(prepare_s + e2e, 3),
+        "warm_e2e_seconds": round(warm_e2e, 3),
         "factorize_cold_seconds": round(factorize_cold, 3),
         "factorize_warm_seconds": round(factorize_warm, 3),
         "compile_overhead_seconds": round(factorize_cold - factorize_warm, 3),
-        "combine_seconds": round(combine_s, 3),
-        "consensus_seconds": round(consensus_s, 3),
+        "combine_seconds": round(combine_cold, 3),
+        "combine_warm_seconds": round(combine_warm, 3),
+        "consensus_seconds": round(consensus_cold, 3),
+        "consensus_warm_seconds": round(consensus_warm, 3),
+        "consensus_breakdown_cold": agg(sub_cold),
+        "consensus_breakdown_warm": agg(sub_warm),
         "prepare_seconds": round(prepare_s, 3),
         "vs_baseline": round(NORTH_STAR_BASELINE_SECONDS / e2e, 2),
+        "vs_baseline_warm": round(NORTH_STAR_BASELINE_SECONDS / warm_e2e, 2),
     }
 
 
@@ -576,8 +607,12 @@ def main():
         "metric": "pbmc10k_factorize_consensus_e2e",
         "value": value,
         "unit": ("seconds (factorize K=5..13 x 100 online-MU runs of "
-                 "10000x2000 incl. compiles, + combine + consensus k=9)"),
+                 "10000x2000 incl. compiles, + combine + consensus k=9; "
+                 "warm_e2e_seconds/vs_baseline_warm are the steady-state "
+                 "second pass of the same stages)"),
         "vs_baseline": vs,
+        "warm_e2e_seconds": ns.get("warm_e2e_seconds"),
+        "vs_baseline_warm": ns.get("vs_baseline_warm"),
         "tiers": results,
         "mfu_frobenius_k9": mfu.get("frobenius_k9", {}).get("mfu"),
         "achieved_tflops_frobenius_k9":

@@ -1035,9 +1035,24 @@ class cNMF:
                                                   transposed=True))
                 if (n_t < self.rowshard_threshold
                         and n_t * g_t * 4 <= self._DEV_CACHE_BUDGET_BYTES):
-                    # pre-read + stage only what _stage_dense will accept
-                    jobs.append(lambda: self._stage_dense(
-                        "tpm", read_h5ad(self.paths["tpm"]).X))
+                    def stage_tpm_and_warm_scale():
+                        # pre-read + stage only what _stage_dense accepts,
+                        # then warm the final-refit HVG column-scale
+                        # program against the staged array (its ~2 s
+                        # first-dispatch upload otherwise lands inside
+                        # the serial final_refit stage)
+                        import jax
+
+                        arr = self._stage_dense(
+                            "tpm", read_h5ad(self.paths["tpm"]).X)
+                        if isinstance(arr, jax.Array):
+                            from ..ops.stats import scale_hvg_columns_device
+
+                            scale_hvg_columns_device(
+                                arr, np.zeros(g_hv, np.int64),
+                                np.ones(g_hv))
+
+                    jobs.append(stage_tpm_and_warm_scale)
             except Exception:
                 pass
         if norm_counts is not None:
@@ -1149,10 +1164,12 @@ class cNMF:
                 and _packed_dims is None):
             # packed stats runs warm their (shared) program set in
             # k_selection_plot instead of a per-K set here
-            self._warm_consensus_programs(
-                merged_spectra.shape[0], int(k), norm_counts.X.shape[0],
-                norm_counts.X.shape[1], n_neighbors,
-                skip_density_and_return_after_stats, norm_counts=norm_counts)
+            with self._timer.stage("consensus.warm"):
+                self._warm_consensus_programs(
+                    merged_spectra.shape[0], int(k), norm_counts.X.shape[0],
+                    norm_counts.X.shape[1], n_neighbors,
+                    skip_density_and_return_after_stats,
+                    norm_counts=norm_counts)
 
         # L2-normalize rows (cnmf.py:1056)
         l2_spectra = (merged_spectra.T
@@ -1167,8 +1184,9 @@ class cNMF:
                 local_density = load_df_from_npz(
                     self.paths["local_density_cache"] % k)
             else:
-                dens, topics_dist = knn_local_density(l2_spectra.values,
-                                                      n_neighbors)
+                with self._timer.stage("consensus.density"):
+                    dens, topics_dist = knn_local_density(l2_spectra.values,
+                                                          n_neighbors)
                 local_density = pd.DataFrame(
                     dens, columns=["local_density"], index=l2_spectra.index)
                 save_df_to_npz(local_density,
@@ -1205,19 +1223,20 @@ class cNMF:
         # recompiles); the unfiltered paths keep the unmasked program
         l2_padded = None
         labels_padded = None
-        if _packed_dims is not None:
-            R_actual = l2_spectra.shape[0]
-            l2_padded = np.zeros((_packed_dims[0], l2_spectra.shape[1]),
-                                 np.float32)
-            l2_padded[:R_actual] = l2_spectra.values
-            labels_padded, _centers, _inertia = kmeans(
-                l2_padded, int(k), n_init=10, seed=1, n_rows=R_actual,
-                k_pad=_packed_dims[1])
-            labels_all = labels_padded[:R_actual]
-        else:
-            labels_all, _centers, _inertia = kmeans(l2_spectra.values, k,
-                                                    n_init=10, seed=1,
-                                                    mask=kmeans_mask)
+        with self._timer.stage("consensus.kmeans"):
+            if _packed_dims is not None:
+                R_actual = l2_spectra.shape[0]
+                l2_padded = np.zeros((_packed_dims[0], l2_spectra.shape[1]),
+                                     np.float32)
+                l2_padded[:R_actual] = l2_spectra.values
+                labels_padded, _centers, _inertia = kmeans(
+                    l2_padded, int(k), n_init=10, seed=1, n_rows=R_actual,
+                    k_pad=_packed_dims[1])
+                labels_all = labels_padded[:R_actual]
+            else:
+                labels_all, _centers, _inertia = kmeans(l2_spectra.values, k,
+                                                        n_init=10, seed=1,
+                                                        mask=kmeans_mask)
         if kmeans_mask is not None:
             l2_spectra = l2_spectra.loc[density_filter, :]
             labels0 = labels_all[kmeans_mask]
@@ -1233,10 +1252,11 @@ class cNMF:
         median_spectra = l2_spectra.groupby(kmeans_cluster_labels).median()
         median_spectra = (median_spectra.T / median_spectra.sum(axis=1)).T
 
-        X_resident = self._stage_dense("norm_counts", norm_counts.X)
-        rf_usages = self.refit_usage(
-            X_resident, median_spectra,
-            k_pad=None if _packed_dims is None else _packed_dims[1])
+        with self._timer.stage("consensus.refit_usage"):
+            X_resident = self._stage_dense("norm_counts", norm_counts.X)
+            rf_usages = self.refit_usage(
+                X_resident, median_spectra,
+                k_pad=None if _packed_dims is None else _packed_dims[1])
         rf_usages = pd.DataFrame(rf_usages, index=norm_counts.obs.index,
                                  columns=median_spectra.index)
 
@@ -1268,11 +1288,12 @@ class cNMF:
 
         # TPM-unit spectra via the transposed refit (cnmf.py:1124-1129);
         # the staged TPM transposes on-device instead of a host CSC densify
-        tpm = read_h5ad(self.paths["tpm"])
-        tpm_stats = load_df_from_npz(self.paths["tpm_stats"])
-        tpm_resident = self._stage_dense("tpm", tpm.X)
-        spectra_tpm = self.refit_spectra(
-            tpm_resident, norm_usages.values.astype(np.float32))
+        with self._timer.stage("consensus.refit_spectra"):
+            tpm = read_h5ad(self.paths["tpm"])
+            tpm_stats = load_df_from_npz(self.paths["tpm_stats"])
+            tpm_resident = self._stage_dense("tpm", tpm.X)
+            spectra_tpm = self.refit_spectra(
+                tpm_resident, norm_usages.values.astype(np.float32))
         spectra_tpm = pd.DataFrame(spectra_tpm, index=rf_usages.columns,
                                    columns=tpm.var.index)
         if normalize_tpm_spectra:
@@ -1281,68 +1302,72 @@ class cNMF:
 
         # z-score spectra: OLS of z-scored TPM against usages (cnmf.py:1132);
         # sparse TPM densifies one ols_batch_size row block at a time
-        usage_coef = ols_all_cols(rf_usages.values, tpm.X, normalize_y=True,
-                                  batch_size=int(ols_batch_size))
+        with self._timer.stage("consensus.ols"):
+            usage_coef = ols_all_cols(rf_usages.values, tpm.X,
+                                      normalize_y=True,
+                                      batch_size=int(ols_batch_size))
         usage_coef = pd.DataFrame(usage_coef, index=rf_usages.columns,
                                   columns=tpm.var.index)
 
         if refit_usage:
-            # final usage refit on std-scaled HVG TPM (cnmf.py:1135-1149)
-            hvgs = open(self.paths["nmf_genes_list"]).read().split("\n")
-            spectra_tpm_rf = spectra_tpm.loc[:, hvgs]
-            spectra_tpm_rf = spectra_tpm_rf.div(
-                tpm_stats.loc[hvgs, "__std"], axis=1)
-            import jax
+            with self._timer.stage("consensus.final_refit"):
+                # final usage refit on std-scaled HVG TPM (cnmf.py:1135-1149)
+                hvgs = open(self.paths["nmf_genes_list"]).read().split("\n")
+                spectra_tpm_rf = spectra_tpm.loc[:, hvgs]
+                spectra_tpm_rf = spectra_tpm_rf.div(
+                    tpm_stats.loc[hvgs, "__std"], axis=1)
+                import jax
 
-            if isinstance(tpm_resident, jax.Array):
-                # the TPM is already HBM-resident: slice + scale its HVG
-                # columns ON DEVICE (ops/stats.scale_hvg_columns_device) —
-                # host-scaling and re-uploading the dense result cost ~2 s
-                # per consensus call on a tunneled chip. The ddof=1 std is
-                # derived from the tpm_stats artifact (same f64 moment
-                # engine over the same matrix, ddof=0) instead of a fresh
-                # O(nnz) pass + HVG submatrix copy.
-                from ..ops.stats import scale_hvg_columns_device
+                if isinstance(tpm_resident, jax.Array):
+                    # the TPM is already HBM-resident: slice + scale its HVG
+                    # columns ON DEVICE (ops/stats.scale_hvg_columns_device) —
+                    # host-scaling and re-uploading the dense result cost ~2 s
+                    # per consensus call on a tunneled chip. The ddof=1 std is
+                    # derived from the tpm_stats artifact (same f64 moment
+                    # engine over the same matrix, ddof=0) instead of a fresh
+                    # O(nnz) pass + HVG submatrix copy.
+                    from ..ops.stats import scale_hvg_columns_device
 
-                n_rows = int(tpm_resident.shape[0])
-                bessel = (n_rows / (n_rows - 1.0)) if n_rows > 1 else 1.0
-                div = np.sqrt(
-                    tpm_stats.loc[hvgs, "__std"].values.astype(np.float64)
-                    ** 2 * bessel)
-                if sp.issparse(tpm.X):
-                    div[div == 0] = 1.0
-                refit_X = scale_hvg_columns_device(
-                    tpm_resident, tpm.var.index.get_indexer(hvgs), div)
-            else:
-                norm_tpm = tpm[:, hvgs].copy()
-                if sp.issparse(norm_tpm.X):
-                    norm_tpm.X, _ = scale_columns(norm_tpm.X, ddof=1,
-                                                  zero_std_to_one=True)
+                    n_rows = int(tpm_resident.shape[0])
+                    bessel = (n_rows / (n_rows - 1.0)) if n_rows > 1 else 1.0
+                    div = np.sqrt(
+                        tpm_stats.loc[hvgs, "__std"].values.astype(np.float64)
+                        ** 2 * bessel)
+                    if sp.issparse(tpm.X):
+                        div[div == 0] = 1.0
+                    refit_X = scale_hvg_columns_device(
+                        tpm_resident, tpm.var.index.get_indexer(hvgs), div)
                 else:
-                    norm_tpm.X, _ = scale_columns(norm_tpm.X, ddof=1,
-                                                  zero_std_to_one=False)
-                refit_X = norm_tpm.X
-            rf_usages = self.refit_usage(
-                refit_X, spectra_tpm_rf.values.astype(np.float32))
-            rf_usages = pd.DataFrame(rf_usages, index=norm_counts.obs.index,
-                                     columns=spectra_tpm_rf.index)
+                    norm_tpm = tpm[:, hvgs].copy()
+                    if sp.issparse(norm_tpm.X):
+                        norm_tpm.X, _ = scale_columns(norm_tpm.X, ddof=1,
+                                                      zero_std_to_one=True)
+                    else:
+                        norm_tpm.X, _ = scale_columns(norm_tpm.X, ddof=1,
+                                                      zero_std_to_one=False)
+                    refit_X = norm_tpm.X
+                rf_usages = self.refit_usage(
+                    refit_X, spectra_tpm_rf.values.astype(np.float32))
+                rf_usages = pd.DataFrame(rf_usages, index=norm_counts.obs.index,
+                                         columns=spectra_tpm_rf.index)
 
-        save_df_to_npz(median_spectra, self.paths["consensus_spectra"]
-                       % (k, density_threshold_repl))
-        save_df_to_npz(rf_usages, self.paths["consensus_usages"]
-                       % (k, density_threshold_repl))
-        save_df_to_text(median_spectra, self.paths["consensus_spectra__txt"]
-                        % (k, density_threshold_repl))
-        save_df_to_text(rf_usages, self.paths["consensus_usages__txt"]
-                        % (k, density_threshold_repl))
-        save_df_to_npz(spectra_tpm, self.paths["gene_spectra_tpm"]
-                       % (k, density_threshold_repl))
-        save_df_to_text(spectra_tpm, self.paths["gene_spectra_tpm__txt"]
-                        % (k, density_threshold_repl))
-        save_df_to_npz(usage_coef, self.paths["gene_spectra_score"]
-                       % (k, density_threshold_repl))
-        save_df_to_text(usage_coef, self.paths["gene_spectra_score__txt"]
-                        % (k, density_threshold_repl))
+        with self._timer.stage("consensus.writes"):
+            save_df_to_npz(median_spectra, self.paths["consensus_spectra"]
+                           % (k, density_threshold_repl))
+            save_df_to_npz(rf_usages, self.paths["consensus_usages"]
+                           % (k, density_threshold_repl))
+            save_df_to_text(median_spectra, self.paths["consensus_spectra__txt"]
+                            % (k, density_threshold_repl))
+            save_df_to_text(rf_usages, self.paths["consensus_usages__txt"]
+                            % (k, density_threshold_repl))
+            save_df_to_npz(spectra_tpm, self.paths["gene_spectra_tpm"]
+                           % (k, density_threshold_repl))
+            save_df_to_text(spectra_tpm, self.paths["gene_spectra_tpm__txt"]
+                            % (k, density_threshold_repl))
+            save_df_to_npz(usage_coef, self.paths["gene_spectra_score"]
+                           % (k, density_threshold_repl))
+            save_df_to_text(usage_coef, self.paths["gene_spectra_score__txt"]
+                            % (k, density_threshold_repl))
 
         if show_clustering:
             from .plots import clustergram
@@ -1361,21 +1386,35 @@ class cNMF:
                 close_fig=close_clustergram_fig)
 
         if build_ref:
-            self.build_reference(k, density_threshold)
+            with self._timer.stage("consensus.build_ref"):
+                self.build_reference(k, density_threshold,
+                                     spectra_tpm=spectra_tpm)
         return None
 
     # ------------------------------------------------------------------
     # downstream artifacts
     # ------------------------------------------------------------------
 
-    def build_reference(self, k, density_threshold=0.5, target_sum=1e6):
+    def build_reference(self, k, density_threshold=0.5, target_sum=1e6,
+                        spectra_tpm=None):
         """starCAT-compatible reference spectra (``cnmf.py:1259-1290``):
         TPM spectra renormalized to ``target_sum`` per program, divided by
-        per-gene TPM std, subset to HVGs, rows labeled ``GEP%d``."""
+        per-gene TPM std, subset to HVGs, rows labeled ``GEP%d``.
+
+        ``spectra_tpm``: the in-memory TPM-spectra DataFrame, passed by
+        ``consensus`` so a same-process build skips re-parsing the txt
+        artifact it just wrote (~0.6 s of a ~2.5 s warm consensus at
+        north-star shape); standalone calls load it from disk. The txt
+        round-trip quantizes values (to_csv default precision), so the
+        in-memory path is MORE exact; golden artifact tests hold either
+        way."""
         dt_repl = str(density_threshold).replace(".", "_")
-        spectra_tpm = pd.read_csv(
-            self.paths["gene_spectra_tpm__txt"] % (k, dt_repl),
-            index_col=0, sep="\t")
+        if spectra_tpm is None:
+            spectra_tpm = pd.read_csv(
+                self.paths["gene_spectra_tpm__txt"] % (k, dt_repl),
+                index_col=0, sep="\t")
+        else:
+            spectra_tpm = spectra_tpm.copy()
         hvgs = open(self.paths["nmf_genes_list"]).read().split("\n")
         tpm_stats = load_df_from_npz(self.paths["tpm_stats"])
         tpm_stats.index = spectra_tpm.columns

@@ -254,6 +254,20 @@ def moe_correct_ridge(Z_orig, R, Phi_moe, lamb) -> np.ndarray:
         lamb))
 
 
+def harmony_program_shapes(n: int, nclust: int | None = None,
+                           block_size: float = 0.05):
+    """``(K, n_blocks, n_pad)`` for an n-cell harmony run — the ONE
+    derivation of the cluster count and block split, shared by
+    :func:`run_harmony` and the ``Preprocess`` program warmer so the
+    warmer can never compile for shapes production won't dispatch."""
+    if nclust is None:
+        nclust = int(min(np.round(n / 30.0), 100))
+    K = max(int(nclust), 2)
+    n_blocks = max(1, int(np.ceil(1.0 / block_size)))
+    blk_len = int(np.ceil(n / n_blocks))
+    return K, n_blocks, n_blocks * blk_len
+
+
 def run_harmony(data_mat, meta_data: pd.DataFrame, vars_use, theta=2.0,
                 lamb=1.0, sigma: float = 0.1, nclust: int | None = None,
                 max_iter_harmony: int = 10, max_iter_kmeans: int = 20,
@@ -268,9 +282,8 @@ def run_harmony(data_mat, meta_data: pd.DataFrame, vars_use, theta=2.0,
     d, n = Z.shape
     phi = _one_hot_design(meta_data, vars_use)        # B x n
     B = phi.shape[0]
-    if nclust is None:
-        nclust = int(min(np.round(n / 30.0), 100))
-    K = max(int(nclust), 2)
+    K, _n_blocks_shared, _n_pad_shared = harmony_program_shapes(
+        n, nclust, block_size)
 
     theta_vec = np.full(B, float(theta), dtype=np.float32)
     lamb_diag = np.concatenate([[0.0], np.full(B, float(lamb))]).astype(np.float32)
@@ -292,9 +305,7 @@ def run_harmony(data_mat, meta_data: pd.DataFrame, vars_use, theta=2.0,
     O = jnp.matmul(R, phi_d.T, precision=_HI)
 
     rng = np.random.default_rng(random_state)
-    n_blocks = max(1, int(np.ceil(1.0 / block_size)))
-    blk_len = int(np.ceil(n / n_blocks))
-    n_pad = n_blocks * blk_len
+    n_blocks, n_pad = _n_blocks_shared, _n_pad_shared
     objectives: list[float] = []
     Z_corr = jnp.asarray(Z)
     lamb_mat = jnp.diag(jnp.asarray(lamb_diag))

@@ -1,14 +1,22 @@
-"""Principal component analysis on device.
+"""Principal component analysis: device matmuls, host eigensolve.
 
 Replaces the reference's ``sc.pp.pca`` call in the batch-correction path
 (``/root/reference/src/cnmf/preprocess.py:250-338``). The factorization is
 computed from the smaller gram matrix (g x g or n x n, whichever is
-smaller) with one MXU matmul + ``eigh`` rather than ``jnp.linalg.svd`` of
-the rectangular matrix: TPU's iterative SVD on an 8.5k x 2k input takes
-minutes, the gram path milliseconds (squared condition number is harmless
-for the leading components PCA keeps). Signs are fixed to scanpy/sklearn's
-``svd_flip`` convention (largest-|loading| positive per component) so
-downstream Harmony runs see the same basis orientation.
+smaller): TPU's iterative SVD on an 8.5k x 2k input takes minutes, the gram
+path is one MXU matmul (squared condition number is harmless for the
+leading components PCA keeps).
+
+The eigensolve itself runs on HOST LAPACK in float64, not ``jnp.eigh``:
+the device eigh program for a 2000 x 2000 operand is a ~30 s XLA compile
+whose persistent-cache entry still costs ~10-30 s per process to
+deserialize + upload through a tunneled link (measured, round 5), while
+host ``dsyevd`` at that shape is a flat ~1.9 s with no compile at all —
+and is more accurate than the f32 device solve. Only the O(n g min(n,g))
+matmuls (gram, projection) run on device; their programs compile in ~1 s.
+Signs are fixed to scanpy/sklearn's ``svd_flip`` convention
+(largest-|loading| positive per component) so downstream Harmony runs see
+the same basis orientation.
 """
 
 from __future__ import annotations
@@ -25,38 +33,31 @@ __all__ = ["pca"]
 _HI = jax.lax.Precision.HIGHEST
 
 
-@functools.partial(jax.jit, static_argnames=("n_comps", "zero_center"))
-def _pca_jit(X, n_comps: int, zero_center: bool):
-    n, g = X.shape
+@functools.partial(jax.jit, static_argnames=("zero_center", "small_side"))
+def _pca_gram(X, zero_center: bool, small_side: str):
+    """Gram matrix of the (optionally centered) data over its smaller side:
+    ``small_side='g'`` -> (g, g) X^T X, ``'n'`` -> (n, n) X X^T."""
     if zero_center:
         X = X - jnp.mean(X, axis=0, keepdims=True)
-    if g <= n:
-        G = jnp.matmul(X.T, X, precision=_HI)              # (g, g)
-        evals, evecs = jnp.linalg.eigh(G)                  # ascending
-        S = jnp.sqrt(jnp.clip(evals[::-1][:n_comps], 0.0))
-        V = evecs[:, ::-1][:, :n_comps]                    # (g, k)
-        Vt = V.T
-        X_pca = jnp.matmul(X, V, precision=_HI)            # = U * S
-    else:
-        G = jnp.matmul(X, X.T, precision=_HI)              # (n, n)
-        evals, evecs = jnp.linalg.eigh(G)
-        S = jnp.sqrt(jnp.clip(evals[::-1][:n_comps], 0.0))
-        U = evecs[:, ::-1][:, :n_comps]                    # (n, k)
-        # rank-overflow guard (cf. ops/nmf.py:gram_svd_base): S ~ 0 columns
-        # would divide fp32 noise by EPS
-        ok = S > 1e-6 * jnp.maximum(S[0], 1e-30)
-        Vt = jnp.where(ok[:, None],
-                       jnp.matmul(U.T, X, precision=_HI)
-                       / jnp.maximum(S, 1e-30)[:, None], 0.0)
-        X_pca = U * S[None, :]
-    # svd_flip: orient each component so its largest-|value| loading is
-    # positive (removes the sign ambiguity; matches sklearn/scanpy)
-    max_idx = jnp.argmax(jnp.abs(Vt), axis=1)
-    signs = jnp.sign(Vt[jnp.arange(n_comps), max_idx])
-    Vt = Vt * signs[:, None]
-    X_pca = X_pca * signs[None, :]
-    explained_var = (S ** 2) / jnp.maximum(n - 1, 1)
-    return X_pca, Vt, explained_var
+    if small_side == "g":
+        return jnp.matmul(X.T, X, precision=_HI)
+    return jnp.matmul(X, X.T, precision=_HI)
+
+
+@functools.partial(jax.jit, static_argnames=("zero_center",))
+def _pca_project(X, V, zero_center: bool):
+    """(n, k) scores: (X - mean) @ V."""
+    if zero_center:
+        X = X - jnp.mean(X, axis=0, keepdims=True)
+    return jnp.matmul(X, V, precision=_HI)
+
+
+@functools.partial(jax.jit, static_argnames=("zero_center",))
+def _pca_components(X, U_over_S, zero_center: bool):
+    """(k, g) loadings for the n < g branch: (U / S)^T @ (X - mean)."""
+    if zero_center:
+        X = X - jnp.mean(X, axis=0, keepdims=True)
+    return jnp.matmul(U_over_S.T, X, precision=_HI)
 
 
 def pca(X, n_comps: int = 50, zero_center: bool = True):
@@ -65,8 +66,44 @@ def pca(X, n_comps: int = 50, zero_center: bool = True):
     if sp.issparse(X):
         X = X.toarray()
     X = np.asarray(X, dtype=np.float32)
+    n, g = X.shape
     n_comps = int(min(n_comps, min(X.shape) - 1 if zero_center else min(X.shape)))
-    X_pca, Vt, ev = _pca_jit(jnp.asarray(X), n_comps, bool(zero_center))
+    Xd = jnp.asarray(X)
+
+    small_side = "g" if g <= n else "n"
+    G = np.asarray(_pca_gram(Xd, bool(zero_center), small_side),
+                   dtype=np.float64)
+    evals, evecs = np.linalg.eigh(G)                       # ascending
+    S = np.sqrt(np.clip(evals[::-1][:n_comps], 0.0, None))
+
+    if small_side == "g":
+        V = np.ascontiguousarray(evecs[:, ::-1][:, :n_comps])   # (g, k)
+        Vt = V.T
+        X_pca = np.asarray(_pca_project(Xd, jnp.asarray(V, jnp.float32),
+                                        bool(zero_center)),
+                           dtype=np.float64)               # = U * S
+    else:
+        U = np.ascontiguousarray(evecs[:, ::-1][:, :n_comps])   # (n, k)
+        # rank-overflow guard (cf. ops/nmf.py:gram_svd_base): S ~ 0 columns
+        # would divide fp32 noise by EPS
+        ok = S > 1e-6 * max(S[0] if S.size else 0.0, 1e-30)
+        U_over_S = np.where(ok[None, :], U / np.maximum(S, 1e-30)[None, :],
+                            0.0)
+        Vt = np.asarray(_pca_components(
+            Xd, jnp.asarray(U_over_S, jnp.float32), bool(zero_center)),
+            dtype=np.float64)
+        Vt = np.where(ok[:, None], Vt, 0.0)
+        X_pca = U * S[None, :]
+
+    # svd_flip: orient each component so its largest-|value| loading is
+    # positive (removes the sign ambiguity; matches sklearn/scanpy)
+    max_idx = np.argmax(np.abs(Vt), axis=1)
+    signs = np.sign(Vt[np.arange(n_comps), max_idx])
+    signs[signs == 0] = 1.0
+    Vt = Vt * signs[:, None]
+    X_pca = X_pca * signs[None, :]
+    explained_var = (S ** 2) / max(n - 1, 1)
+
     if zero_center:
         total_var = float(np.var(X, axis=0, ddof=1).sum())
     else:
@@ -75,5 +112,5 @@ def pca(X, n_comps: int = 50, zero_center: bool = True):
         # past 1 for data with a large mean offset
         total_var = float((np.asarray(X, np.float64) ** 2).sum()
                           / max(X.shape[0] - 1, 1))
-    ratio = np.asarray(ev, dtype=np.float64) / max(total_var, 1e-30)
-    return np.asarray(X_pca), np.asarray(Vt), ratio
+    ratio = np.asarray(explained_var, dtype=np.float64) / max(total_var, 1e-30)
+    return np.asarray(X_pca, np.float32), np.asarray(Vt, np.float32), ratio

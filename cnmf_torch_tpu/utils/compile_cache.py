@@ -6,8 +6,10 @@ compiles dominate wall-clock (measured: a 10000x2000 sweep program is ~14 s
 to compile, ~1.3 s to reload from the persistent cache through the same
 backend). JAX ships a content-addressed on-disk cache for exactly this;
 libraries shouldn't force global config, so this is enabled only from OUR
-process entry points (CLI, bench), and never overrides a user's explicit
-``JAX_COMPILATION_CACHE_DIR`` / ``jax.config`` setting.
+pipeline entry points — the CLI, bench, and the Preprocess compute entry
+(``normalize_batchcorrect``, opt out with ``CNMF_TPU_COMPILE_CACHE=0``) —
+and never overrides a user's explicit ``JAX_COMPILATION_CACHE_DIR`` /
+``jax.config`` setting.
 """
 
 from __future__ import annotations
