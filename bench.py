@@ -307,19 +307,28 @@ def bench_mfu():
                                                 0.0, 0.0, 0.0, 0.0)
                 return jax.lax.fori_loop(0, iters, body, (Hb, Wb))
         else:
+            # the PRODUCTION beta!=2 chain: online KL sweeps run with bf16
+            # X/WH/ratio intermediates (ops/nmf.py:resolve_bf16_ratio)
+            from cnmf_torch_tpu.ops.nmf import resolve_bf16_ratio
+
+            bf16 = resolve_bf16_ratio(beta, "online")
+
             @functools.partial(jax.jit, static_argnames=("iters",))
             def batched(H, W, X, iters):
                 def solo(h, w):
                     def body(_, hw):
                         h, w = hw
-                        h = _update_H(X, h, w, beta, 0.0, 0.0)
-                        w = _update_W(X, h, w, beta, 0.0, 0.0)
+                        h = _update_H(X, h, w, beta, 0.0, 0.0,
+                                      bf16_ratio=bf16)
+                        w = _update_W(X, h, w, beta, 0.0, 0.0,
+                                      bf16_ratio=bf16)
                         return h, w
                     return jax.lax.fori_loop(0, iters, body, (h, w))
                 return jax.vmap(solo)(H, W)
 
         rng = np.random.default_rng(0)
-        X = jnp.asarray(rng.random((n, g), np.float32) + 0.1)
+        x_dtype = (jnp.bfloat16 if beta != 2.0 and bf16 else jnp.float32)
+        X = jnp.asarray(rng.random((n, g), np.float32) + 0.1, x_dtype)
         H = jnp.asarray(rng.random((R, n, k), np.float32) + 0.1)
         W = jnp.asarray(rng.random((R, k, g), np.float32) + 0.1)
         _device_sync(batched(H, W, X, iters))      # compile short
@@ -350,7 +359,9 @@ def bench_mfu():
             # flop model counts USEFUL per-replicate work only — the
             # bundled kernel's masked-Gram padding flops are overhead, so
             # its MFU is conservative
-            "kernel": "bundled" if bundled else "vmapped",
+            "kernel": ("bundled" if bundled else
+                       "vmapped-bf16" if beta != 2.0 and bf16 else
+                       "vmapped"),
         }
         if peak_flops:
             # the vmapped replicate batch is what makes a skinny-k MU

@@ -348,13 +348,9 @@ class Preprocess:
             else:
                 n_hvg_exp = 0
             if n_hvg_exp:
-                # B without materializing the (B x n) design matrix
-                # run_harmony builds later: get_dummies over a categorical
-                # yields one column per category level
-                hv = ([harmony_vars] if isinstance(harmony_vars, str)
-                      else list(harmony_vars))
-                B = sum(_adata.obs[v].astype("category").cat.categories.size
-                        for v in hv)
+                from ..ops.harmony import design_width
+
+                B = design_width(_adata.obs, harmony_vars)
                 self._warm_harmony_programs(_adata.shape[0], n_hvg_exp, B,
                                             theta=theta)
         try:

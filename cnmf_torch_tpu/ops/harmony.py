@@ -61,6 +61,17 @@ def _one_hot_design(meta_data: pd.DataFrame, vars_use) -> np.ndarray:
     return np.concatenate(blocks, axis=0)
 
 
+def design_width(meta_data: pd.DataFrame, vars_use) -> int:
+    """B — the row count :func:`_one_hot_design` will produce — without
+    materializing the (B x n) matrix. Kept next to the encoder so the
+    ``Preprocess`` program warmer's shape derivation can never drift from
+    production's."""
+    if isinstance(vars_use, str):
+        vars_use = [vars_use]
+    return sum(meta_data[v].astype("category").cat.categories.size
+               for v in vars_use)
+
+
 @jax.jit
 def _normalize_cols(M):
     return M / jnp.maximum(jnp.linalg.norm(M, axis=0, keepdims=True), 1e-12)

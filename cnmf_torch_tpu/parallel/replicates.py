@@ -35,6 +35,7 @@ from ..ops.nmf import (
     nmf_fit_online,
     nndsvd_init,
     random_init,
+    resolve_bf16_ratio,
     resolve_online_schedule,
     split_regularization,
 )
@@ -212,7 +213,8 @@ def warm_sweep_programs(n: int, g: int, k_to_count: dict,
             float(online_h_tol), int(min(online_chunk_size, n)),
             int(online_chunk_max_iter), int(n_passes), int(batch_max_iter),
             l1_H, l2_H, l1_W, l2_W, mesh, bool(return_usages),
-            h_tol_start=h_tol_start)
+            h_tol_start=h_tol_start,
+            bf16_ratio=resolve_bf16_ratio(beta, mode))
         xs = jax.ShapeDtypeStruct((n, g), jnp.float32, sharding=x_sharding)
         ss = jax.ShapeDtypeStruct((r_pad,), jnp.uint32)
         prog.lower(xs, ss).compile()
@@ -269,7 +271,8 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
                    chunk_max_iter: int, n_passes: int, batch_max_iter: int,
                    l1_H: float, l2_H: float, l1_W: float, l2_W: float,
                    mesh: Mesh | None, return_usages: bool,
-                   packed: bool = False, h_tol_start: float | None = None):
+                   packed: bool = False, h_tol_start: float | None = None,
+                   bf16_ratio: bool = False):
     """Build (once per static configuration) the jitted sweep executable
     ``(X (n,g), seeds (R,)) -> (usages | (0,), spectra (R,k,g), errs (R,))``.
 
@@ -315,7 +318,7 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
                 Xc, Hc, w0, beta=beta, tol=tol, h_tol=h_tol,
                 chunk_max_iter=chunk_max_iter, n_passes=n_passes,
                 l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W,
-                h_tol_start=h_tol_start)
+                h_tol_start=h_tol_start, bf16_ratio=bf16_ratio)
             return Hc.reshape(-1, k)[:n], W, err
     else:
         raise ValueError(f"unknown mode {mode!r}")
@@ -472,7 +475,8 @@ def replicate_sweep_packed(X, ks, seeds, beta_loss="frobenius",
                 float(online_h_tol), int(min(online_chunk_size, n)),
                 int(online_chunk_max_iter), int(n_passes),
                 int(batch_max_iter), l1_H, l2_H, l1_W, l2_W, mesh,
-                bool(return_usages), packed=True, h_tol_start=h_tol_start)
+                bool(return_usages), packed=True, h_tol_start=h_tol_start,
+                bf16_ratio=resolve_bf16_ratio(beta, mode))
             H, W, err = prog(X, np.asarray(sl_s, np.uint32), np.int32(kv))
             if on_slice is not None:
                 on_slice(sl_idx, np.asarray(W[:r]), np.asarray(err[:r]))
@@ -585,7 +589,8 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
             float(online_h_tol), int(min(online_chunk_size, n)),
             int(online_chunk_max_iter), int(n_passes), int(batch_max_iter),
             l1_H, l2_H, l1_W, l2_W, mesh, bool(return_usages),
-            h_tol_start=h_tol_start)
+            h_tol_start=h_tol_start,
+            bf16_ratio=resolve_bf16_ratio(beta, mode))
         # async dispatch: every slice is enqueued before any result is read
         H, W, err = prog(X, np.asarray(sl, dtype=np.uint32))
         parts.append((H[:r] if return_usages else None, W[:r], err[:r]))

@@ -41,3 +41,20 @@ def test_pbmc_tutorial_smoke(tmp_path):
     best = pbmc_tutorial.main(str(tmp_path), n_cells=600, n_genes=900,
                               n_iter=6, ks=[9, 10, 11])
     assert (best[:8] > 0.8).all()
+
+
+def test_seurat_vignette_smoke(tmp_path):
+    """R/Seurat export walkthrough (the reference's R_vignette.Rmd flow):
+    the 10x trio + baked-paths R script generate, and the script's own
+    input-coherence asserts run inside main()."""
+    import seurat_vignette
+
+    r_path = seurat_vignette.main(str(tmp_path), n_cells=300, n_genes=400,
+                                  n_iter=6, k=4)
+    assert r_path.endswith(".seurat_import.R")
+    text = open(r_path).read()
+    # every read.table/ReadMtx path in the generated R code exists
+    import re
+
+    for p in re.findall(r'"(/[^"]+)"', text):
+        assert os.path.exists(p), p
