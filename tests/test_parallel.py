@@ -640,29 +640,36 @@ def test_kl_sweep_bf16_ratio_statistical_parity(monkeypatch):
     from cnmf_torch_tpu.parallel import replicate_sweep
 
     assert resolve_bf16_ratio(1.0, "online") is True
+    assert resolve_bf16_ratio(0.0, "online") is True
     assert resolve_bf16_ratio(2.0, "online") is False
     assert resolve_bf16_ratio(1.0, "batch") is False
-    assert resolve_bf16_ratio(0.0, "online") is False
     monkeypatch.setenv("CNMF_TPU_BF16_RATIO", "0")
     assert resolve_bf16_ratio(1.0, "online") is False
     assert resolve_bf16_ratio(1.0, "online", override=True) is True
     monkeypatch.delenv("CNMF_TPU_BF16_RATIO")
 
+    from cnmf_torch_tpu.parallel.replicates import _sweep_program
+
     X = _lowrank(n=120, g=60, k=4, seed=9) + 0.05
     seeds = [3, 11, 27]
-    kw = dict(beta_loss="kullback-leibler", mode="online",
-              online_chunk_size=64)
-    sp_bf, _, errs_bf = replicate_sweep(X, seeds, 4, **kw)
-    sp_bf2, _, errs_bf2 = replicate_sweep(X, seeds, 4, **kw)
-    np.testing.assert_array_equal(sp_bf, sp_bf2)  # deterministic
+    # per-seed trajectory-divergence bounds measured per loss: ~1-2% for
+    # KL; up to ~4% for IS (gamma=0.5-damped steps amplify path
+    # divergence; on the TPU fixture bf16 was BETTER on every IS seed)
+    bound = {"kullback-leibler": 2e-2, "itakura-saito": 5e-2}
+    for beta_loss in ("kullback-leibler", "itakura-saito"):
+        kw = dict(beta_loss=beta_loss, mode="online", online_chunk_size=64)
+        sp_bf, _, errs_bf = replicate_sweep(X, seeds, 4, **kw)
+        sp_bf2, _, errs_bf2 = replicate_sweep(X, seeds, 4, **kw)
+        np.testing.assert_array_equal(sp_bf, sp_bf2)  # deterministic
 
-    monkeypatch.setenv("CNMF_TPU_BF16_RATIO", "0")
-    from cnmf_torch_tpu.parallel.replicates import _sweep_program
-    _sweep_program.cache_clear()
-    sp_f32, _, errs_f32 = replicate_sweep(X, seeds, 4, **kw)
-    _sweep_program.cache_clear()
-    rel = (errs_bf - errs_f32) / np.abs(errs_f32)
-    assert np.all(np.abs(rel) < 2e-2), (errs_bf, errs_f32)
-    # and no systematic quality loss across replicates
-    assert rel.mean() < 1e-2, rel
-    assert (sp_bf >= 0).all()
+        monkeypatch.setenv("CNMF_TPU_BF16_RATIO", "0")
+        _sweep_program.cache_clear()
+        sp_f32, _, errs_f32 = replicate_sweep(X, seeds, 4, **kw)
+        _sweep_program.cache_clear()
+        monkeypatch.delenv("CNMF_TPU_BF16_RATIO")
+        rel = (errs_bf - errs_f32) / np.abs(errs_f32)
+        assert np.all(np.abs(rel) < bound[beta_loss]), (
+            beta_loss, errs_bf, errs_f32)
+        # and no systematic quality loss across replicates
+        assert rel.mean() < 1e-2, (beta_loss, rel)
+        assert (sp_bf >= 0).all()
