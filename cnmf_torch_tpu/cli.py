@@ -188,10 +188,9 @@ def main(argv=None):
     # so go through jax.config like tests/conftest.py does.
     sim = os.environ.get("CNMF_SIM_CPU_DEVICES")
     if sim:
-        import jax
+        from .utils.jax_compat import force_cpu_devices
 
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", int(sim))
+        force_cpu_devices(int(sim))
 
     # persistent XLA compile cache (no-op if the user configured their own):
     # repeat runs and the per-K k-selection loop skip recompilation

@@ -511,25 +511,73 @@ class cNMF:
         import jax
         import jax.numpy as jnp
 
-        X = norm_counts.X
-        if sp.issparse(X):
-            X = X.toarray()
-        # device-resident once, reused by every per-K sweep program (a jit
-        # argument, so the host->HBM transfer happens exactly once); with a
-        # mesh, replicate it across devices here rather than per sweep call
-        X = jnp.asarray(np.asarray(X, dtype=np.float32))
-        if mesh is not None:
+        # sparsity-aware beta != 2 dispatch (ISSUE 1, ops/sparse.py): a
+        # sparse norm_counts with a KL/IS ledger below the ELL density
+        # threshold stays in its fixed-width ELL encoding — the sweeps then
+        # run the nonzero-only kernels. Auto below the threshold;
+        # CNMF_TPU_SPARSE_BETA=0 forces dense, =1 forces ELL. The dense
+        # path remains the default everywhere else.
+        beta_val = beta_loss_to_float(_nmf_kwargs["beta_loss"])
+        use_ell = False
+        if (sp.issparse(norm_counts.X) and beta_val in (1.0, 0.0)
+                and _nmf_kwargs.get("init", "random") == "random"
+                and _nmf_kwargs.get("algo", "mu") == "mu"):
+            from ..ops.sparse import ell_row_width, resolve_sparse_beta
+
+            n_c, g_c = norm_counts.X.shape
+            ell_w = ell_row_width(norm_counts.X)
+            density = norm_counts.X.nnz / max(n_c * g_c, 1)
+            use_ell = resolve_sparse_beta(beta_val, density=density,
+                                          width=ell_w, g=g_c)
+
+        if use_ell and packed:
+            # fail BEFORE the CSR->ELL conversion and host->HBM staging
+            raise ValueError(
+                "packed K-sweeps run dense only; set CNMF_TPU_SPARSE_BETA=0 "
+                "to keep packed=True, or drop packed for the ELL path")
+
+        if use_ell:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            X = jax.device_put(X, NamedSharding(mesh, PartitionSpec()))
-        elif self._stageable(norm_counts.X):
-            # donate the residency to the consensus stage (same size guard
-            # as _stage_dense — donating an over-budget matrix would pin
-            # HBM the cache can never serve): its refits use the same
-            # matrix, so an in-process factorize->consensus run (launcher,
-            # k-selection) never re-crosses the host link
-            self._dev_cache["norm_counts"] = (
-                self._content_token(norm_counts.X), X)
+            from ..ops.sparse import (csr_to_ell, ell_chunk_rows,
+                                      ell_device_put)
+
+            if _nmf_kwargs.get("mode", "online") == "online":
+                Xe, _ = ell_chunk_rows(
+                    norm_counts.X,
+                    int(min(_nmf_kwargs.get("online_chunk_size", 5000),
+                            norm_counts.X.shape[0])))
+            else:
+                Xe = csr_to_ell(norm_counts.X)
+            X = ell_device_put(
+                Xe, None if mesh is None
+                else NamedSharding(mesh, PartitionSpec()))
+            print("factorize: ELL sparse path engaged for beta=%g "
+                  "(density %.3f, width %d of %d genes; "
+                  "CNMF_TPU_SPARSE_BETA=0 forces dense)."
+                  % (beta_val, density, X.width, norm_counts.X.shape[1]))
+        else:
+            X = norm_counts.X
+            if sp.issparse(X):
+                X = X.toarray()
+            # device-resident once, reused by every per-K sweep program (a
+            # jit argument, so the host->HBM transfer happens exactly
+            # once); with a mesh, replicate it across devices here rather
+            # than per sweep call
+            X = jnp.asarray(np.asarray(X, dtype=np.float32))
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                X = jax.device_put(X, NamedSharding(mesh, PartitionSpec()))
+            elif self._stageable(norm_counts.X):
+                # donate the residency to the consensus stage (same size
+                # guard as _stage_dense — donating an over-budget matrix
+                # would pin HBM the cache can never serve): its refits use
+                # the same matrix, so an in-process factorize->consensus
+                # run (launcher, k-selection) never re-crosses the host
+                # link
+                self._dev_cache["norm_counts"] = (
+                    self._content_token(norm_counts.X), X)
 
         by_k: dict[int, list] = {}
         for idx in jobs:
@@ -548,7 +596,10 @@ class cNMF:
             # the regime test uses LEDGER-wide replicate counts (per-worker
             # shards of a 100-replicate production sweep must not flip into
             # the slower packed path just because each worker sees few)
-            packed = (_nmf_kwargs["init"] == "random" and len(by_k) >= 4
+            # ELL-encoded sweeps always take the per-K path (the packed
+            # program's K_max-padded init is defined on the dense matrix)
+            packed = (not use_ell
+                      and _nmf_kwargs["init"] == "random" and len(by_k) >= 4
                       and max((len(t) for t in by_k.values()), default=0)
                       * max(1, int(total_workers)) <= 32)
         elif packed and _nmf_kwargs["init"] != "random":
@@ -564,10 +615,12 @@ class cNMF:
             beta_loss_to_float(_nmf_kwargs["beta_loss"]),
             _nmf_kwargs.get("online_h_tol"), _nmf_kwargs.get("n_passes"))
         self._save_factorize_provenance(
-            "batched-packed" if packed else "batched", worker_i,
+            "batched-packed" if packed else
+            ("batched-ell" if use_ell else "batched"), worker_i,
             dict({k: v for k, v in _nmf_kwargs.items() if k != "n_jobs"},
                  online_h_tol=_h_tol_eff, n_passes=_n_passes_eff,
                  online_h_tol_start=_h_tol_start,
+                 sparse_path=("ell" if use_ell else "dense"),
                  mesh_devices=(1 if mesh is None
                                else int(np.prod(mesh.devices.shape)))))
 
@@ -617,8 +670,10 @@ class cNMF:
             # run (parallel/replicates.py: warm_sweep_programs)
             from ..parallel import warm_sweep_programs
 
+            # always the ORIGINAL (cells, genes): a pre-chunked EllMatrix's
+            # leading dims are (n_chunks, chunk_rows), not cells
             n_progs = warm_sweep_programs(
-                int(X.shape[0]), int(X.shape[1]),
+                int(norm_counts.X.shape[0]), int(norm_counts.X.shape[1]),
                 {k: len(t) for k, t in by_k.items()},
                 beta_loss=_nmf_kwargs["beta_loss"],
                 init=_nmf_kwargs["init"],
@@ -631,7 +686,8 @@ class cNMF:
                 l1_ratio_W=_nmf_kwargs.get("l1_ratio_W", 0.0),
                 alpha_H=_nmf_kwargs.get("alpha_H", 0.0),
                 l1_ratio_H=_nmf_kwargs.get("l1_ratio_H", 0.0),
-                mesh=mesh, replicates_per_batch=replicates_per_batch)
+                mesh=mesh, replicates_per_batch=replicates_per_batch,
+                ell_dims=(X.width, X.t_width) if use_ell else None)
             print("[Worker %d]. Warmed %d sweep programs concurrently."
                   % (worker_i, n_progs))
 
@@ -674,7 +730,10 @@ class cNMF:
                 alpha_H=_nmf_kwargs.get("alpha_H", 0.0),
                 l1_ratio_H=_nmf_kwargs.get("l1_ratio_H", 0.0),
                 mesh=mesh, replicates_per_batch=replicates_per_batch,
-                fetch=False)
+                fetch=False,
+                # pre-chunked ELL leaves carry padded rows; the sweep needs
+                # the true cell count for the init scale + program keys
+                n_rows=int(norm_counts.X.shape[0]) if use_ell else None)
             pending.append((k, iters, spectra_d))
             _drain(window - 1)
         _drain(0)
@@ -1102,18 +1161,31 @@ class cNMF:
                              n_rows=int(R_max), k_pad=int(K_max))
 
         def warm_refit():
+            # the (n_hv, g_hv) dummy goes through the SHARED _warm_dummies
+            # cache (ADVICE r5 #3): concurrent warm paths then hold ONE
+            # device allocation per shape instead of a fresh unbudgeted
+            # ones-array next to the staged norm_counts copy
+            shape = (int(n_hv), int(g_hv))
+            with self._warm_lock:
+                arr = self._warm_dummies.get(shape)
+                if arr is None:
+                    arr = jnp.ones(shape, jnp.float32)
+                    self._warm_dummies[shape] = arr
             # kk < K_max exercises the padded-init gather ops too
             kk = max(1, int(K_max) - 1)
-            fit_h(jnp.ones((int(n_hv), int(g_hv)), jnp.float32),
-                  np.ones((kk, int(g_hv)), np.float32), chunk_size=csz,
+            fit_h(arr, np.ones((kk, int(g_hv)), np.float32), chunk_size=csz,
                   chunk_max_iter=cmi, h_tol=0.05, l1_reg_H=l1H,
                   l2_reg_H=0.0, beta=beta, k_pad=int(K_max))
 
         jobs = [warm_kmeans, warm_sil]
-        if n_hv < self.rowshard_threshold:
+        if (n_hv < self.rowshard_threshold
+                and int(n_hv) * int(g_hv) * 4 <= int(os.environ.get(
+                    "CNMF_TPU_WARM_DUMMY_BUDGET_BYTES", 2 << 30))):
             # above the threshold refit_usage takes fit_h_rowsharded, which
             # compiles per-K (k_pad unsupported there) — warming this
-            # executable would only pin a useless (n, g) dummy in HBM
+            # executable would only pin a useless (n, g) dummy in HBM; the
+            # bytes budget mirrors _warm_harmony_programs' cap so warm +
+            # production peak HBM stays bounded on large in-core datasets
             jobs.append(warm_refit)
 
         def run_one(job):

@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.jax_compat import assert_threefry_partitionable
+
 __all__ = ["kmeans"]
 
 
@@ -284,6 +286,9 @@ def kmeans(X, k: int, n_init: int = 10, max_iter: int = 300,
         if not (0 < k <= k_pad and 0 < n_rows <= X.shape[0]):
             raise ValueError(f"invalid packed dims k={k} k_pad={k_pad} "
                              f"n_rows={n_rows} R_max={X.shape[0]}")
+        # _kmeanspp_packed's split-prefix seeding parity needs the
+        # partitionable threefry (ADVICE r5 #1)
+        assert_threefry_partitionable("kmeans(k_pad=...)")
         labels, C, inertia = _kmeans_packed_jit(
             X, jnp.int32(k), jnp.int32(n_rows), int(k_pad), int(n_init),
             int(max_iter), jnp.float32(tol), jax.random.key(seed))

@@ -1,0 +1,602 @@
+"""Fixed-width ELL encoding + sparsity-aware beta in {1, 0} MU statistics.
+
+Single-cell count matrices are ~85-95% zeros, yet the dense beta != 2 (KL/IS)
+MU chains in ``ops/nmf.py`` materialize WH and X/WH over all n x g entries —
+the measured MFU gap between the KL kernel (0.038) and the Frobenius bundle
+(0.42) is structural, not a kernel-tuning problem (COMPLETENESS.md "Remaining
+perf levers": "further gains need different math"). The different math
+(arXiv:1604.04026; arXiv:2202.09518) is that for beta=1 the MU numerator
+``(X/WH) @ W^T`` only needs the ratio at X's nonzeros and the denominator
+``sum_g W`` is data-independent; for beta=0 the numerator ``(X/WH^2) @ W^T``
+is likewise supported on X's nonzeros (the ``1/WH`` denominator still needs
+the dense WH, so the IS path there is a hybrid).
+
+Encoding: **dual fixed-width ELL** — per-row ``(values, col_indices)``
+padded to one static width for the H-side statistics, PLUS a transposed
+index set (per-column row indices + a permutation into the flat row-major
+value buffer) for the W-side statistics. Every shape is static, so the
+encoding rides jit/vmap/scan/shard_map exactly like a dense array, and —
+critically for both CPU and TPU — every kernel below is gathers and
+reductions only: scatter-free (XLA scatter measured 2-6 s per (k, g)
+numerator at the bench shape on CPU, ~50x the whole dense update).
+
+Kernel shape (all four statistics + the objective): a ``lax.scan`` over the
+k components, each step one flat gather from a small table (a W row / an H
+column — k*g / n-sized, cache- or VMEM-class) at the stored indices plus a
+fused multiply-reduce. Work per MU update is O(k * nnz_padded) instead of
+the dense chain's O(k * n * g), with no (n, w, k) gather intermediate (the
+einsum form measured 4x slower than the k-scan on CPU and holds k extra
+copies of the ratio buffer).
+
+Padding entries carry ``value 0`` (at column 0 row-side; at a sentinel
+one-past-the-end flat position transpose-side), so every padded slot
+contributes an exact +0.0 to every statistic — the same absorbing-zero
+argument the packed K-sweeps use.
+
+The bf16 ratio chain (``ops/nmf.py:resolve_bf16_ratio``) composes: stored
+values, gathered tables, and the ratio live in bf16 with f32 reduction of
+the numerators, mirroring the dense chain's memory-format relief.
+
+This module is imported by ``ops/nmf.py`` and must not import it back —
+the MU rate application (``_apply_rate``/``mu_gamma``) stays in nmf.py and
+composes these statistics.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.sparse as sp
+
+__all__ = [
+    "EllMatrix",
+    "csr_to_ell",
+    "ell_chunk_rows",
+    "ell_to_dense",
+    "ell_device_put",
+    "ell_row_width",
+    "resolve_sparse_beta",
+    "ell_w_table",
+    "ell_wh_at_nz",
+    "ell_kl_h_stats",
+    "ell_kl_w_numer",
+    "ell_kl_w_stats",
+    "ell_is_h_stats",
+    "ell_is_w_stats",
+    "ell_beta_err",
+    "is_per_elem",
+    "kl_nz_term",
+    "SPARSE_DENSITY_THRESHOLD",
+]
+
+EPS = 1e-16  # matches ops.nmf.EPS (no import: nmf.py imports this module)
+
+# auto-dispatch density ceiling. The inner-iteration cost ratio is
+# ~(2k + 2) * width / (3 * g) (slab passes vs dense WH/ratio passes), so
+# the win shrinks as width/g grows: measured warm 8-replicate KL sweeps
+# at the bench shape (10000 x 2000, k=9) run 1.5x FASTER ELL at 95%
+# sparsity (width 136) but 1.5x SLOWER at 88% (width 296). The default
+# engages only where the win is comfortable — <=10% nonzeros AND
+# width <= g/8 — real HVG count matrices are typically 90-95% zeros.
+# CNMF_TPU_SPARSE_BETA overrides (see resolve_sparse_beta).
+SPARSE_DENSITY_THRESHOLD = 0.10
+
+# pad the ELL widths to a lane-friendly multiple so the gather / ratio
+# arrays tile cleanly
+_WIDTH_MULTIPLE = 8
+
+
+@jax.tree_util.register_pytree_node_class
+class EllMatrix:
+    """Dual fixed-width ELL matrix.
+
+    Row side (always present): ``vals (..., n, w)`` + ``cols (..., n, w)``
+    — per-row nonzero values and column indices, padded with
+    ``(0.0, column 0)``.
+
+    Transpose side (present when W-side statistics are needed):
+    ``rows_t (..., g, wt)`` — per-column row indices — and
+    ``perm_t (..., g, wt)`` — the flat index of that nonzero in the
+    row-major ``vals`` buffer (``row * w + slot``), padding pointing at the
+    sentinel ``n * w`` (one past the end; kernels gather from the ratio
+    buffer with one appended zero). ``None`` for H-only uses (``fit_h``).
+
+    ``g`` is static aux data, so the encoding is a pytree that rides
+    jit/vmap/scan/shard_map like an array; leading batch/chunk axes on all
+    leaves are fine (``lax.scan`` over a chunked EllMatrix yields
+    per-chunk EllMatrix slices)."""
+
+    def __init__(self, vals, cols, g: int, rows_t=None, perm_t=None):
+        self.vals = vals
+        self.cols = cols
+        self.rows_t = rows_t
+        self.perm_t = perm_t
+        self.g = int(g)
+
+    @property
+    def shape(self):
+        return self.vals.shape[:-1] + (self.g,)
+
+    @property
+    def width(self) -> int:
+        return int(self.vals.shape[-1])
+
+    @property
+    def t_width(self) -> int | None:
+        return None if self.rows_t is None else int(self.rows_t.shape[-1])
+
+    @property
+    def dtype(self):
+        return self.vals.dtype
+
+    def astype(self, dtype):
+        """Cast the stored values only (all index buffers stay int32) —
+        the bf16 ratio chain's ``x.astype(bfloat16)`` works unchanged."""
+        return EllMatrix(self.vals.astype(dtype), self.cols, self.g,
+                         self.rows_t, self.perm_t)
+
+    def tree_flatten(self):
+        return (self.vals, self.cols, self.rows_t, self.perm_t), self.g
+
+    @classmethod
+    def tree_unflatten(cls, g, children):
+        vals, cols, rows_t, perm_t = children
+        return cls(vals, cols, g, rows_t, perm_t)
+
+    def __repr__(self):
+        return (f"EllMatrix(shape={self.shape}, width={self.width}, "
+                f"t_width={self.t_width}, dtype={self.vals.dtype})")
+
+
+def _pad_width(w: int) -> int:
+    return max(_WIDTH_MULTIPLE, -(-max(w, 1) // _WIDTH_MULTIPLE)
+               * _WIDTH_MULTIPLE)
+
+
+def ell_row_width(X) -> int:
+    """The fixed row-ELL width a matrix will encode at: max row nnz,
+    padded to a lane-friendly multiple (dense inputs count nonzeros)."""
+    if sp.issparse(X):
+        nnz_per_row = np.diff(X.tocsr().indptr)
+    else:
+        nnz_per_row = np.count_nonzero(np.asarray(X), axis=1)
+    return _pad_width(int(nnz_per_row.max()) if nnz_per_row.size else 1)
+
+
+def _row_ell_buffers(Xc: sp.csr_matrix, width: int, dtype):
+    n, _ = Xc.shape
+    row_nnz = np.diff(Xc.indptr)
+    vals = np.zeros((n, int(width)), dtype=dtype)
+    cols = np.zeros((n, int(width)), dtype=np.int32)
+    if Xc.nnz:
+        rows = np.repeat(np.arange(n), row_nnz)
+        pos = np.arange(Xc.nnz) - np.repeat(Xc.indptr[:-1], row_nnz)
+        vals[rows, pos] = Xc.data
+        cols[rows, pos] = Xc.indices
+    return vals, cols
+
+
+def _transpose_buffers(Xc: sp.csr_matrix, width: int, t_width: int):
+    """Per-column (rows_t, perm_t) for a row-ELL block: ``perm_t`` maps
+    each transpose slot to its flat ``row * width + slot`` position in the
+    block's row-major value buffer; padding -> sentinel ``n * width``."""
+    n, g = Xc.shape
+    row_nnz = np.diff(Xc.indptr)
+    rows_t = np.zeros((g, int(t_width)), np.int32)
+    perm_t = np.full((g, int(t_width)), n * int(width), np.int32)
+    if Xc.nnz:
+        pos_in_row = np.arange(Xc.nnz) - np.repeat(Xc.indptr[:-1], row_nnz)
+        flatpos = np.repeat(np.arange(n), row_nnz) * int(width) + pos_in_row
+        # route the flat positions through CSC to group them per column
+        # (+1 keeps position 0 distinguishable from CSC's implicit zeros)
+        P = sp.csr_matrix((flatpos + 1, Xc.indices, Xc.indptr),
+                          shape=(n, g)).tocsc()
+        col_nnz = np.diff(P.indptr)
+        pos_in_col = np.arange(P.nnz) - np.repeat(P.indptr[:-1], col_nnz)
+        cc = np.repeat(np.arange(g), col_nnz)
+        rows_t[cc, pos_in_col] = P.indices
+        perm_t[cc, pos_in_col] = P.data - 1
+    return rows_t, perm_t
+
+
+def _as_clean_csr(X) -> sp.csr_matrix:
+    if sp.issparse(X):
+        Xc = X.tocsr().copy()
+        Xc.eliminate_zeros()
+        return Xc
+    return sp.csr_matrix(np.asarray(X))
+
+
+def csr_to_ell(X, width: int | None = None, t_width: int | None = None,
+               transpose: bool = True, dtype=np.float32) -> EllMatrix:
+    """Host-side CSR (or dense) -> dual fixed-width ELL conversion.
+
+    ``width`` / ``t_width`` pin the static widths (must cover the longest
+    row / column — pass global maxima when sharding so every shard
+    compiles one program); both default to the matrix's own maxima.
+    ``transpose=False`` skips the W-side index set (H-only uses).
+    Explicit zeros are dropped so the kernels' "stored value > 0 <=>
+    data nonzero" invariant holds. Returns numpy-backed arrays; stage
+    with :func:`ell_device_put`.
+    """
+    Xc = _as_clean_csr(X)
+    n, g = Xc.shape
+    max_row = int(np.diff(Xc.indptr).max()) if n else 0
+    if width is None:
+        width = _pad_width(max_row)
+    elif width < max_row:
+        raise ValueError(
+            f"width={width} < max row nnz {max_row}: rows would truncate")
+    vals, cols = _row_ell_buffers(Xc, width, dtype)
+    rows_t = perm_t = None
+    if transpose:
+        max_col = int(np.diff(Xc.tocsc().indptr).max()) if g else 0
+        if t_width is None:
+            t_width = _pad_width(max_col)
+        elif t_width < max_col:
+            raise ValueError(f"t_width={t_width} < max col nnz {max_col}")
+        rows_t, perm_t = _transpose_buffers(Xc, width, t_width)
+    return EllMatrix(vals, cols, g, rows_t, perm_t)
+
+
+def ell_chunk_rows(X, chunk_size: int, width: int | None = None,
+                   dtype=np.float32):
+    """Host-side chunked dual-ELL staging for the ONLINE solver: rows are
+    zero-padded to a multiple of ``chunk_size`` and each chunk gets its
+    own transpose index set (the online beta != 2 W step uses per-chunk
+    statistics, so the column grouping must be per chunk). All widths are
+    global maxima, so every chunk shares one static shape. Returns
+    ``(EllMatrix with (C, chunk, w) row leaves and (C, g, wt) transpose
+    leaves, pad)``.
+    """
+    Xc = _as_clean_csr(X)
+    n, g = Xc.shape
+    chunk_size = int(min(chunk_size, n))
+    n_chunks = max(1, -(-n // chunk_size))
+    pad = n_chunks * chunk_size - n
+    if pad:
+        Xc = sp.vstack(
+            [Xc, sp.csr_matrix((pad, g), dtype=Xc.dtype)]).tocsr()
+    if width is None:
+        width = ell_row_width(Xc)
+    blocks = [Xc[i * chunk_size:(i + 1) * chunk_size]
+              for i in range(n_chunks)]
+    t_width = _pad_width(max(
+        int(np.diff(b.tocsc().indptr).max()) if g else 0 for b in blocks))
+    vs, cs, rts, pts = [], [], [], []
+    for b in blocks:
+        v, c = _row_ell_buffers(b, width, dtype)
+        rt, pt = _transpose_buffers(b, width, t_width)
+        vs.append(v)
+        cs.append(c)
+        rts.append(rt)
+        pts.append(pt)
+    return EllMatrix(np.stack(vs), np.stack(cs), g,
+                     np.stack(rts), np.stack(pts)), pad
+
+
+def ell_to_dense(x: EllMatrix) -> np.ndarray:
+    """Exact inverse of the row-side encoding (host numpy). Padding
+    entries scatter +0.0 into column 0 — a no-op."""
+    vals = np.asarray(x.vals)
+    cols = np.asarray(x.cols)
+    n = vals.shape[0]
+    out = np.zeros((n, x.g), dtype=vals.dtype)
+    np.add.at(out, (np.repeat(np.arange(n), vals.shape[1]), cols.ravel()),
+              vals.ravel())
+    return out
+
+
+def ell_device_put(x: EllMatrix, sharding=None) -> EllMatrix:
+    """Stage the ELL buffers to device (optionally with a sharding that
+    applies to every leaf — e.g. replicated ``P()`` for sweeps)."""
+    def put(a, dt):
+        if a is None:
+            return None
+        a = jnp.asarray(np.asarray(a), dtype=dt)
+        return a if sharding is None else jax.device_put(a, sharding)
+
+    return EllMatrix(put(x.vals, jnp.float32), put(x.cols, jnp.int32),
+                     x.g, put(x.rows_t, jnp.int32),
+                     put(x.perm_t, jnp.int32))
+
+
+def resolve_sparse_beta(beta: float, density: float | None = None,
+                        width: int | None = None, g: int | None = None,
+                        override=None) -> bool:
+    """Should a beta != 2 solve take the ELL path?
+
+    Production default: ON for beta in {1, 0} when the matrix is at most
+    ``SPARSE_DENSITY_THRESHOLD`` dense AND the fixed row width is at most
+    an eighth of the gene count (the ragged-row guard: the cost model is
+    width-driven — one dense-ish row pads every row's width and erodes
+    the win; see the threshold's derivation above).
+    ``CNMF_TPU_SPARSE_BETA`` env override: ``0`` forces dense, ``1``
+    forces ELL (for any beta in {1, 0}), any value in (0, 1) replaces
+    the density threshold (the width guard stays). An explicit
+    ``override`` argument wins over the env.
+    """
+    if beta not in (1.0, 0.0):
+        return False
+    if override is not None:
+        return bool(override)
+    threshold = SPARSE_DENSITY_THRESHOLD
+    env = os.environ.get("CNMF_TPU_SPARSE_BETA", "")
+    if env:
+        try:
+            t = float(env)
+        except ValueError:
+            raise ValueError(
+                f"CNMF_TPU_SPARSE_BETA={env!r}: expected 0 (dense), "
+                "1 (force ELL), or a density threshold in (0, 1)")
+        if t <= 0.0:
+            return False
+        if t >= 1.0:
+            return True
+        threshold = t
+    if density is None:
+        return False
+    if width is not None and g is not None and 8 * width > g:
+        return False
+    return float(density) <= threshold
+
+
+# ---------------------------------------------------------------------------
+# nonzero-only statistics kernels (unrolled k-slab gathers; scatter-free)
+# ---------------------------------------------------------------------------
+#
+# Form chosen by measurement (CPU, 10000x2000 @ 88% sparsity, k=9):
+#   * XLA scatter-based (k, g) numerators: 2-6 s/update — unusable;
+#   * (n, w, k)-gather + einsum ('nwk,nk->nw'): batched tiny matvecs the
+#     backend cannot vectorize — ~0.6x DENSE;
+#   * lax.scan over k with flat gathers: accumulator re-materializes per
+#     step — ~parity with dense;
+#   * UNROLLED sum over k slabs of a pre-gathered (n, w, k) table: one
+#     fused pass per statistic, 2.1x the dense chain per inner iteration
+#     (exact to f32 tolerance) — this form.
+# The table is loop-invariant whenever W is fixed (every inner H-solve,
+# the objective scans, the per-chunk W step) — gathered ONCE per chunk
+# solve and reused across all inner iterations (``ell_w_table``). When no
+# table is supplied (the batch solver's alternating updates) the slabs
+# are gathered inline, still unrolled.
+
+def _take(table, idx):
+    return jnp.take(table, idx, mode="clip")
+
+
+def ell_w_table(W, cols, bf16: bool = False):
+    """Pre-gathered ``(k, n, w)`` slab table (``W[c][cols]`` stacked) —
+    build once per fixed-W solve. Component-major layout so every inner
+    iteration reads CONTIGUOUS (n, w) slabs: the (n, w, k) gather layout
+    reads stride-k inside the loop, which measured 3x slower per
+    iteration. The k x g source table is cache/VMEM-class."""
+    Wt = W.T.astype(jnp.bfloat16) if bf16 else W.T
+    return jnp.take(Wt, cols, axis=0, mode="clip").transpose(2, 0, 1)
+
+
+def _slab(W, cols, w_table, c):
+    return w_table[c] if w_table is not None else _take(W[c], cols)
+
+
+def _wh_at_nz(cols, H, W, w_table=None):
+    """``(H @ W)`` at the stored coordinates: unrolled sum of k slab
+    FMAs — the SDDMM form, fused by XLA into one pass. Accumulates in the
+    operand dtype (bf16 under the ratio chain, exactly like the dense
+    chain's WH matmul)."""
+    k = H.shape[-1]
+    acc = H[..., 0:1] * _slab(W, cols, w_table, 0)
+    for c in range(1, k):
+        acc = acc + H[..., c:c + 1] * _slab(W, cols, w_table, c)
+    return acc
+
+
+def ell_wh_at_nz(x: EllMatrix, H, W):
+    """Public f32 SDDMM: ``wh[i, j] = H[i, :] @ W[:, cols[i, j]]``."""
+    return _wh_at_nz(x.cols, H, W)
+
+
+def _h_numer(cols, ratio, W, w_table=None):
+    """``ratio @ W^T`` with ratio supported on the stored coordinates:
+    one unrolled slab-reduce per component — f32 accumulation."""
+    k = W.shape[0]
+    outs = [jnp.sum((ratio * _slab(W, cols, w_table, c)).astype(
+        jnp.float32), axis=-1) for c in range(k)]
+    return jnp.stack(outs, axis=-1)
+
+
+def _w_numer(x: EllMatrix, ratio, H):
+    """``H^T @ R`` with R supported on the stored coordinates — the
+    scatter-free transpose-side form: the ratio buffer is permuted into
+    per-column groups (one static gather through ``perm_t``; padding hits
+    the appended zero), then each component gathers its H column at
+    ``rows_t`` and reduces, unrolled. f32 accumulation."""
+    if x.rows_t is None:
+        raise ValueError(
+            "this EllMatrix has no transpose index set (rows_t/perm_t); "
+            "encode with csr_to_ell(transpose=True) / ell_chunk_rows for "
+            "W-side updates")
+    r_flat = jnp.concatenate(
+        [ratio.reshape(-1), jnp.zeros((1,), ratio.dtype)])
+    r_t = _take(r_flat, x.perm_t)                    # (g, wt)
+    k = H.shape[-1]
+    outs = [jnp.sum((r_t * _take(H[..., c], x.rows_t)).astype(jnp.float32),
+                    axis=-1) for c in range(k)]
+    return jnp.stack(outs, axis=0)                   # (k, g)
+
+
+def _cast_pair(x: EllMatrix, H, W, bf16: bool):
+    if bf16:
+        return (x.vals.astype(jnp.bfloat16), H.astype(jnp.bfloat16),
+                W.astype(jnp.bfloat16))
+    return x.vals, H, W
+
+
+def ell_kl_h_stats(x: EllMatrix, H, W, bf16_ratio: bool = False,
+                   w_table=None):
+    """KL (beta=1) H-update statistics, nonzeros only.
+
+    numer = (X/WH) @ W^T restricted to X's support (zero entries of X
+    contribute an exact 0 to the dense numerator); denom = row-broadcast
+    ``W.sum(axis=1)`` — data-independent, never touches X. With
+    ``bf16_ratio`` the stored values, gathered tables, and the ratio live
+    in bf16 with f32 numerator accumulation (the same memory-format
+    relief as the dense chain in ``ops/nmf.py:_update_H``). Padding
+    entries have value 0 => ratio 0 => exact +0.0 contributions.
+    ``w_table``: pre-gathered :func:`ell_w_table` (loop-invariant inner
+    solves); must be in the chain's compute dtype.
+    """
+    vals, Hc, Wc = _cast_pair(x, H, W, bf16_ratio)
+    wh = _wh_at_nz(x.cols, Hc, Wc, w_table)
+    ratio = vals / jnp.maximum(wh, jnp.asarray(EPS, wh.dtype))
+    numer = _h_numer(x.cols, ratio, Wc, w_table)
+    denom = jnp.broadcast_to(W.sum(axis=1)[None, :], H.shape)
+    return numer, denom
+
+
+def ell_kl_w_numer(x: EllMatrix, H, W, bf16_ratio: bool = False,
+                   w_table=None):
+    """KL W-update numerator ``H^T @ (X/WH)`` via the transpose-side
+    gathers (f32 accumulation)."""
+    vals, Hc, Wc = _cast_pair(x, H, W, bf16_ratio)
+    wh = _wh_at_nz(x.cols, Hc, Wc, w_table)
+    ratio = vals / jnp.maximum(wh, jnp.asarray(EPS, wh.dtype))
+    return _w_numer(x, ratio, Hc)
+
+
+def ell_kl_w_stats(x: EllMatrix, H, W, bf16_ratio: bool = False,
+                   w_table=None):
+    """Full KL W-update statistics: transpose-gather numerator + the
+    data-independent column-sum denominator."""
+    numer = ell_kl_w_numer(x, H, W, bf16_ratio, w_table)
+    denom = jnp.broadcast_to(H.sum(axis=0)[:, None], W.shape)
+    return numer, denom
+
+
+def _wh_dense(H, W, bf16: bool):
+    if bf16:
+        wh = jnp.matmul(H.astype(jnp.bfloat16), W.astype(jnp.bfloat16),
+                        preferred_element_type=jnp.bfloat16)
+        return jnp.maximum(wh, jnp.bfloat16(EPS))
+    return jnp.maximum(H @ W, EPS)
+
+
+def ell_is_h_stats(x: EllMatrix, H, W, bf16_ratio: bool = False,
+                   w_table=None):
+    """IS (beta=0) H-update statistics — the hybrid form.
+
+    The IS denominator ``(1/WH) @ W^T`` is supported on ALL n x g entries,
+    so WH is materialized densely (one MXU/BLAS matmul, as the dense chain
+    does); the numerator ``(X/WH^2) @ W^T`` is supported only on X's
+    nonzeros and runs as a take_along_axis gather of the dense WH plus the
+    per-component table gathers — the dense X buffer and the dense
+    X/WH^2 ratio pass are what this saves."""
+    bf = bool(bf16_ratio)
+    wh = _wh_dense(H, W, bf)
+    inv = 1.0 / wh
+    Wb = W.astype(jnp.bfloat16) if bf else W
+    denom = jnp.matmul(inv, Wb.T, preferred_element_type=jnp.float32)
+    inv_nz = jnp.take_along_axis(inv, x.cols, axis=-1, mode="clip")
+    vals = x.vals.astype(wh.dtype)
+    r2 = vals * inv_nz * inv_nz
+    numer = _h_numer(x.cols, r2, Wb, w_table)
+    return numer, denom
+
+
+def ell_is_w_stats(x: EllMatrix, H, W, bf16_ratio: bool = False):
+    """IS W-update statistics: dense ``H^T @ (1/WH)`` denominator +
+    nonzero-only transpose-gather numerator (f32 accumulation)."""
+    bf = bool(bf16_ratio)
+    wh = _wh_dense(H, W, bf)
+    inv = 1.0 / wh
+    Hb = H.astype(jnp.bfloat16) if bf else H
+    denom = jnp.matmul(Hb.T, inv, preferred_element_type=jnp.float32)
+    inv_nz = jnp.take_along_axis(inv, x.cols, axis=-1, mode="clip")
+    vals = x.vals.astype(wh.dtype)
+    r2 = vals * inv_nz * inv_nz
+    numer = _w_numer(x, r2, Hb)
+    return numer, denom
+
+
+# ---------------------------------------------------------------------------
+# objective
+# ---------------------------------------------------------------------------
+
+def kl_nz_term(Xp, WHs):
+    """Cancellation-safe KL per-element term for entries with X > 0:
+    ``X * (u - log1p(u))`` with ``u = WH/X - 1``. Same two regimes as
+    :func:`is_per_elem`: near convergence the log1p form keeps the O(u^2)
+    terms; when ``WH/X`` underflows f32 (``u`` rounds to exactly -1.0,
+    ``log1p(-1) = -inf`` — routinely hit on genuinely sparse data whose
+    WH collapses at some nonzeros) the logs are split, which is finite
+    and cancellation-free. Shared by the dense objective and
+    :func:`ell_beta_err`."""
+    ratio = WHs / Xp
+    u = ratio - 1.0
+    stable = u - jnp.log1p(jnp.maximum(u, -1.0 + EPS))
+    tiny = u + jnp.log(Xp) - jnp.log(WHs)
+    return Xp * jnp.where(ratio < 1e-6, tiny, stable)
+
+
+def is_per_elem(Xs, WHs):
+    """Cancellation-safe Itakura-Saito per-element divergence
+    ``x/wh - log(x/wh) - 1`` for EPS-floored operands.
+
+    Two regimes: near convergence (ratio ~ 1) the ``v - log1p(v)`` form
+    keeps the O(v^2) terms f32 cancellation would lose; for near-zero
+    ratios (EPS-floored zero counts of a sparse matrix) ``v`` rounds to
+    exactly -1.0 in f32 (EPS = 1e-16 is far below f32 epsilon) and
+    ``log1p(-1) = -inf`` — there the logs are split instead
+    (``log(wh) - log(x)``), which is finite and has no cancellation
+    (the operands differ by orders of magnitude by construction).
+    Shared by the dense objective (``ops/nmf.py:_beta_div_dense``) and
+    the ELL objective below so both report identical finite values on
+    sparse data."""
+    ratio = Xs / WHs
+    v = ratio - 1.0
+    stable = v - jnp.log1p(jnp.maximum(v, -1.0 + EPS))
+    tiny = v + jnp.log(WHs) - jnp.log(Xs)
+    return jnp.where(ratio < 1e-6, tiny, stable)
+
+
+def ell_beta_err(x: EllMatrix, H, W, beta: float):
+    """``D_beta(X || HW)`` from the ELL encoding, f32, matching
+    ``ops/nmf.py:_beta_div_dense``'s cancellation-safe per-element forms
+    exactly in exact arithmetic.
+
+    beta=1 (KL): the dense sum splits as
+    ``sum_{X>0} [X (u - log1p(u)) - WH] + sum_all WH`` with
+    ``u = WH/X - 1``; the first term is supported on the nonzeros and
+    ``sum_all WH = H.sum(0) . W.sum(1)`` is k-sized — fully sparse.
+
+    beta=0 (IS): the divergence is supported on ALL entries (zero counts
+    are EPS-floored, exactly as the dense form does), so WH is evaluated
+    densely (the IS updates materialize it anyway) and the nonzero terms
+    are corrected via a take_along_axis gather.
+    """
+    vals = x.vals.astype(jnp.float32)
+    if beta == 1.0:
+        wh_nz = _wh_at_nz(x.cols, H.astype(jnp.float32),
+                          W.astype(jnp.float32))
+        total_wh = jnp.sum(H.sum(axis=0) * W.sum(axis=1))
+        nz = jnp.where(
+            vals > 0,
+            kl_nz_term(jnp.maximum(vals, EPS), jnp.maximum(wh_nz, EPS))
+            - wh_nz,
+            0.0)
+        return jnp.sum(nz) + total_wh
+    if beta == 0.0:
+        WH = jnp.maximum(H @ W, EPS)
+        eps = jnp.float32(EPS)
+        base = jnp.sum(is_per_elem(eps, WH))
+        wh_nz = jnp.take_along_axis(WH, x.cols, axis=-1, mode="clip")
+        corr = jnp.where(
+            vals > 0,
+            is_per_elem(jnp.maximum(vals, EPS), wh_nz)
+            - is_per_elem(eps, wh_nz),
+            0.0)
+        return base + jnp.sum(corr)
+    raise NotImplementedError(
+        f"ELL objective implements beta in {{1, 0}}, got {beta}")

