@@ -581,9 +581,13 @@ def bench_rowshard():
     nbytes_sparse = X.data.nbytes + X.indices.nbytes + X.indptr.nbytes
     dense_gb = n * g * 4 / 1e9
 
+    from cnmf_torch_tpu.parallel.streaming import (StreamStats,
+                                                   stream_threads)
+
     mesh = Mesh(np.asarray(jax.devices()[:1]), ("cells",))
+    dense_stats = StreamStats()
     t0 = time.perf_counter()
-    Xd, n_orig = prepare_rowsharded(X, mesh)
+    Xd, n_orig = prepare_rowsharded(X, mesh, stats=dense_stats)
     _device_sync(Xd)
     stream_s = time.perf_counter() - t0
 
@@ -635,10 +639,44 @@ def bench_rowshard():
     t1 = min(refit(1) for _ in range(2))
     t2 = min(refit(1 + refit_iters) for _ in range(2))
     refit_s = max(t2 - t1, 1e-9)
+
+    # ELL staging measured separately (the beta != 2 sparse path): free the
+    # dense shard first so both paths stage into the same headroom
+    del Xd, Hd, Wd
+    from cnmf_torch_tpu.parallel.rowshard import stream_ell_to_mesh
+
+    ell_stats = StreamStats()
+    t0 = time.perf_counter()
+    E, _pad = stream_ell_to_mesh(X, mesh, "cells", stats=ell_stats)
+    _device_sync(E.vals)
+    for leaf in (E.cols, E.rows_t, E.perm_t):
+        leaf.block_until_ready()
+    ell_s = time.perf_counter() - t0
+    ell_bytes = sum(int(leaf.nbytes)
+                    for leaf in (E.vals, E.cols, E.rows_t, E.perm_t))
+    del E
+
     return {
         "cells": n, "genes": g, "csr_gb": round(nbytes_sparse / 1e9, 2),
-        "stream_seconds": round(stream_s, 3),
+        "stream_threads": stream_threads(),
+        # dense staging rate is DENSE-EQUIVALENT GB/s (what a naive
+        # densify-then-upload would move) — comparable across rounds;
+        # wire bytes are in stream_dense_wire_gb_per_s
+        "stream_dense_seconds": round(stream_s, 3),
         "stream_dense_gb_per_s": round(dense_gb / stream_s, 2),
+        "stream_dense_wire_gb_per_s": round(dense_stats.gb_per_s(), 2),
+        "stream_dense_host_prep_seconds": round(dense_stats.host_prep_s, 3),
+        "stream_dense_h2d_seconds": round(dense_stats.h2d_s, 3),
+        "stream_dense_overlap_fraction": round(
+            dense_stats.overlap_fraction, 3),
+        # ELL staging rate is ACTUAL leaf bytes landed per second (the
+        # encoding is what crosses the wire on this path)
+        "stream_ell_seconds": round(ell_s, 3),
+        "stream_ell_gb_per_s": round(ell_bytes / 1e9 / ell_s, 2),
+        "stream_ell_host_prep_seconds": round(ell_stats.host_prep_s, 3),
+        "stream_ell_h2d_seconds": round(ell_stats.h2d_s, 3),
+        "stream_ell_overlap_fraction": round(
+            ell_stats.overlap_fraction, 3),
         "solve_seconds_3pass_k9": round(solve_s, 3),
         "cells_per_second": int(n * n_passes / solve_s),
         "staged_kl_refit_seconds_per_mu_iter": round(refit_s / refit_iters, 3),

@@ -153,9 +153,15 @@ class cNMF:
         link — once per refit; X never changes between them, SURVEY §3.3).
         Entries are validated by a content fingerprint, not just shape.
         Returns X unchanged when it exceeds the residency budget or the
-        row-sharded paths will handle it."""
+        row-sharded paths will handle it.
+
+        Uploads run through the pipelined staging engine
+        (``parallel.streaming``): sparse inputs ship CSR slabs and densify
+        on device — the full dense matrix never exists on host — and the
+        per-phase walls/bytes land in the timings ledger."""
         import jax
-        import jax.numpy as jnp
+
+        from ..parallel.streaming import StreamStats, stream_to_device
 
         if not self._stageable(X):
             return X
@@ -163,9 +169,9 @@ class cNMF:
         ent = self._dev_cache.get(key)
         if ent is not None and ent[0] == token:
             return ent[1]
-        Xd = jnp.asarray(X.toarray() if sp.issparse(X) else np.asarray(X),
-                         dtype=jnp.float32)
-        Xd = jax.block_until_ready(Xd)
+        stats = StreamStats()
+        Xd = jax.block_until_ready(stream_to_device(X, stats=stats))
+        stats.record_to(self._timer, f"stage_dense:{key}")
         self._dev_cache[key] = (token, Xd)
         return Xd
 

@@ -290,18 +290,24 @@ def ell_to_dense(x: EllMatrix) -> np.ndarray:
     return out
 
 
-def ell_device_put(x: EllMatrix, sharding=None) -> EllMatrix:
+def ell_device_put(x: EllMatrix, sharding=None, stats=None) -> EllMatrix:
     """Stage the ELL buffers to device (optionally with a sharding that
-    applies to every leaf — e.g. replicated ``P()`` for sweeps)."""
-    def put(a, dt):
-        if a is None:
-            return None
-        a = jnp.asarray(np.asarray(a), dtype=dt)
-        return a if sharding is None else jax.device_put(a, sharding)
+    applies to every leaf — e.g. replicated ``P()`` for sweeps). The four
+    leaves upload through the streaming pool
+    (:func:`~cnmf_torch_tpu.parallel.streaming.stream_put_leaves`) so
+    their transfers overlap instead of queueing serially."""
+    from ..parallel.streaming import stream_put_leaves
 
-    return EllMatrix(put(x.vals, jnp.float32), put(x.cols, jnp.int32),
-                     x.g, put(x.rows_t, jnp.int32),
-                     put(x.perm_t, jnp.int32))
+    leaves = [(x.vals, np.float32), (x.cols, np.int32),
+              (x.rows_t, np.int32), (x.perm_t, np.int32)]
+    host = [None if a is None else np.asarray(a, dtype=dt)
+            for a, dt in leaves]
+    live = [i for i, a in enumerate(host) if a is not None]
+    put = stream_put_leaves([host[i] for i in live], sharding, stats=stats)
+    out = [None] * len(host)
+    for i, d in zip(live, put):
+        out[i] = d
+    return EllMatrix(out[0], out[1], x.g, out[2], out[3])
 
 
 def resolve_sparse_beta(beta: float, density: float | None = None,

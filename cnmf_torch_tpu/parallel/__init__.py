@@ -15,8 +15,16 @@ from .replicates import (
     worker_filter,
 )
 from .rowshard import fit_h_rowsharded, nmf_fit_rowsharded, pad_rows_to_mesh
+from .streaming import (
+    StreamStats,
+    stream_put_leaves,
+    stream_to_device,
+)
 
 __all__ = [
+    "StreamStats",
+    "stream_put_leaves",
+    "stream_to_device",
     "auto_replicates_per_batch",
     "clear_sweep_cache",
     "default_mesh",

@@ -483,9 +483,12 @@ def replicate_sweep_packed(X, ks, seeds, beta_loss="frobenius",
             "replicate_sweep_packed does not support ELL-encoded X; use "
             "per-K replicate_sweep calls (packed=False)")
     if not isinstance(X, jax.Array):
-        if sp.issparse(X):
-            X = X.toarray()
-        X = jnp.asarray(np.asarray(X), dtype=jnp.float32)
+        # pipelined staging (parallel.streaming): sparse inputs densify
+        # on device slab-by-slab — the full dense matrix never exists on
+        # host — and dense inputs upload slab-wise off this thread
+        from .streaming import stream_to_device
+
+        X = stream_to_device(X, dtype=jnp.float32)
     n, g = X.shape
     beta = beta_loss_to_float(beta_loss)
     online_h_tol, n_passes, h_tol_start = resolve_online_schedule(
@@ -643,10 +646,13 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
                     Xe = csr_to_ell(X)
                 X = ell_device_put(Xe)
                 n_rows = n_s
-            else:
-                X = X.toarray()
-        if not isinstance(X, EllMatrix):
-            X = jnp.asarray(np.asarray(X), dtype=jnp.float32)
+        if not isinstance(X, (EllMatrix, jax.Array)):
+            # pipelined staging (parallel.streaming) for host input:
+            # above-ELL-threshold sparse densifies slab-by-slab (never the
+            # full matrix on host), dense uploads slab-wise off-thread
+            from .streaming import stream_to_device
+
+            X = stream_to_device(X, dtype=jnp.float32)
     if isinstance(X, EllMatrix):
         if n_rows is None:
             # caller-staged encoding: padded rows (all-zero) are benign —

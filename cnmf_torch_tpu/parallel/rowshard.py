@@ -18,6 +18,7 @@ per-device program is exactly the single-chip kernel.
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -26,6 +27,12 @@ import scipy.sparse as sp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..utils.jax_compat import shard_map
+
+from .streaming import (
+    StreamStats,
+    _stream_csr_sharded,
+    _stream_dense_sharded,
+)
 
 from ..ops.nmf import (
     EPS,
@@ -70,109 +77,28 @@ def pad_rows_to_mesh(X, multiple: int):
     return X, pad
 
 
-@functools.partial(jax.jit, static_argnames=("rows", "g"))
-def _csr_densify(vals, cols, indptr, rows: int, g: int):
-    """Densify one CSR row slab ON DEVICE: row ids recovered from indptr
-    by searchsorted, then one scatter-add. Padded tail entries (vals 0,
-    cols 0, positions past indptr[-1]) land as +0 adds — harmless."""
-    rowids = jnp.clip(
-        jnp.searchsorted(indptr, jnp.arange(vals.shape[0]), side="right") - 1,
-        0, rows - 1)
-    # cols may arrive int16 (halves wire bytes when g < 2**15); widen on
-    # device for the scatter
-    return jnp.zeros((rows, g), vals.dtype).at[
-        rowids, cols.astype(jnp.int32)].add(vals)
-
-
-@functools.partial(jax.jit, donate_argnums=(0,))
-def _place_slab(big, sub, start):
-    """In-place (donated) row-slab write — the shard buffer is never
-    duplicated, so peak device memory stays one shard + one slab."""
-    return jax.lax.dynamic_update_slice(big, sub, (start, 0))
-
-
-@functools.lru_cache(maxsize=None)
-def _zeros_builder(dev, rows: int, g: int, dtype):
-    """Per-(device, shape) cached allocator for a shard's dense buffer —
-    built once, not re-traced per shard in the staging loop."""
-    return jax.jit(lambda: jnp.zeros((rows, g), dtype),
-                   out_shardings=jax.sharding.SingleDeviceSharding(dev))
-
-
-# rows per on-device scatter. TPU scatter materializes sort/workspace
-# temporaries proportional to its OUTPUT, so densifying a multi-GB shard in
-# one scatter can double its footprint and OOM; slab-sized scatters keep
-# the transient small while the donated update assembles the shard.
-_DENSIFY_SLAB_ROWS = 65_536
-
-
-def _stream_csr_sharded(X, sharding, dtype):
-    """Ship CSR buffers (values + column indices + indptr) to each device
-    and densify there — host->HBM bytes scale with nnz, not rows x genes
-    (~10x less for typical single-cell sparsity; on tunneled links the
-    transfer IS the staging wall). Each shard densifies slab-by-slab into
-    a donated buffer; slab nnz is padded to the global maximum so every
-    slab reuses one compiled scatter program."""
-    n, g = X.shape
-    idx_map = sharding.addressable_devices_indices_map((n, g))
-    slices = [(dev, idx[0]) for dev, idx in idx_map.items()]
-
-    def slab_bounds(s):
-        start, stop = (s.start or 0), (s.stop if s.stop is not None else n)
-        for lo in range(start, stop, _DENSIFY_SLAB_ROWS):
-            yield lo, min(lo + _DENSIFY_SLAB_ROWS, stop)
-
-    pad_nnz = max((int(X.indptr[hi] - X.indptr[lo])
-                   for _, s in slices for lo, hi in slab_bounds(s)),
-                  default=1)
-    pad_nnz = max(pad_nnz, 1)
-
-    col_dtype = np.int16 if g < 2 ** 15 else np.int32
-    blocks = []
-    for dev, s in slices:
-        start = (s.start or 0)
-        stop = (s.stop if s.stop is not None else n)
-        rows = stop - start
-        slabs = list(slab_bounds(s))
-        big = None
-        for lo, hi in slabs:
-            blk = X[lo:hi]
-            nnz = blk.nnz
-            vals = np.zeros(pad_nnz, dtype=np.dtype(dtype))
-            vals[:nnz] = blk.data
-            cols = np.zeros(pad_nnz, col_dtype)
-            cols[:nnz] = blk.indices
-            sub = _csr_densify(
-                jax.device_put(vals, dev),
-                jax.device_put(cols, dev),
-                jax.device_put(blk.indptr.astype(np.int32), dev),
-                rows=int(hi - lo), g=int(g))
-            if len(slabs) == 1:
-                big = sub
-            else:
-                if big is None:
-                    big = _zeros_builder(dev, rows, int(g),
-                                         np.dtype(dtype))()
-                big = _place_slab(big, sub, lo - start)
-        blocks.append(big)
-    return jax.make_array_from_single_device_arrays((n, g), sharding, blocks)
-
-
 def stream_rows_to_mesh(X, mesh: Mesh, axis: str, dtype=jnp.float32,
-                        pad_multiple: int | None = None):
+                        pad_multiple: int | None = None,
+                        stats: StreamStats | None = None):
     """Out-of-core host→HBM transfer: build the row-sharded device array
-    straight from a host CSR (or dense) matrix. Sparse inputs ship their
-    CSR buffers and densify on-device (:func:`_csr_densify`) — the full
-    dense matrix exists neither on host nor on the wire; dense inputs
-    stream one shard's row slice at a time. This is the reference's
-    5,000-row streaming contract (``cnmf.py:350-381``) with the shard
-    boundary as the streaming unit.
+    straight from a host CSR (or dense) matrix. Sparse inputs densify
+    slab-by-slab (on device via ``streaming._csr_densify``, or on host per
+    ``streaming._csr_transport``) — the full dense matrix never exists on
+    host; dense inputs stream slab-wise. This is the reference's 5,000-row
+    streaming contract (``cnmf.py:350-381``) with the slab as the
+    streaming unit.
 
     Rows shard over the named ``axis`` of ``mesh`` (1-D cells mesh or the
     2-D replicates x cells mesh — in the latter the array is replicated
     over the other axis). Multi-host safe: every process supplies only its
     addressable shards. Returns ``(X_device, pad)`` where ``pad`` rows of
     zeros were appended to make the rows axis divide the mesh axis.
+
+    Both branches run through the :mod:`.streaming` pipeline: slab prep on
+    the stream thread pool, transfers round-robin across devices, donated
+    on-device assembly — overlapped, with host memory bounded by
+    ``CNMF_TPU_STREAM_DEPTH``. Pass ``stats`` to collect per-phase
+    host_prep/H2D/device walls and bytes.
     """
     n_shards = dict(mesh.shape)[axis]
     multiple = int(pad_multiple) if pad_multiple else n_shards
@@ -183,17 +109,15 @@ def stream_rows_to_mesh(X, mesh: Mesh, axis: str, dtype=jnp.float32,
     X, pad = pad_rows_to_mesh(X, multiple)
     sharding = NamedSharding(mesh, P(axis, None))
     if sp.issparse(X):
-        return _stream_csr_sharded(X.tocsr(), sharding, dtype), pad
-
-    def _shard_block(index):
-        blk = X[index[0]]
-        return np.ascontiguousarray(np.asarray(blk, dtype=dtype))
-
-    return jax.make_array_from_callback(X.shape, sharding, _shard_block), pad
+        return _stream_csr_sharded(X.tocsr(), sharding, dtype,
+                                   stats=stats), pad
+    return _stream_dense_sharded(np.asarray(X), sharding, dtype,
+                                 stats=stats), pad
 
 
 def stream_ell_to_mesh(X, mesh: Mesh, axis: str, width: int | None = None,
-                       pad_multiple: int | None = None):
+                       pad_multiple: int | None = None,
+                       stats: StreamStats | None = None):
     """Row-shard a host CSR matrix as fixed-width ELL — the beta != 2
     sparse staging path. The CSR buffers are already what crosses the wire
     on this path (``_stream_csr_sharded``); instead of densifying into an
@@ -222,53 +146,88 @@ def stream_ell_to_mesh(X, mesh: Mesh, axis: str, width: int | None = None,
     # this process's addressable ones: every process holds the same host
     # CSR and shards are equal row blocks, so scanning every block keeps
     # the static shape identical across a multi-host pod (a per-process
-    # local max would lower different programs per host)
+    # local max would lower different programs per host). One bincount
+    # over each block's indices slice — no tocsc() (which re-sorts the
+    # whole block's nnz) on this path.
     rows_per_shard = n // n_shards
     t_width = 8
-    if g:
+    if g and X.nnz:
+        ip = X.indptr
         for s0 in range(0, n, rows_per_shard):
-            blk_nnz = np.diff(
-                X[s0:s0 + rows_per_shard].tocsc().indptr)
-            if blk_nnz.size:
-                t_width = max(t_width, int(blk_nnz.max()))
+            lo, hi = ip[s0], ip[min(s0 + rows_per_shard, n)]
+            if hi > lo:
+                t_width = max(t_width, int(np.bincount(
+                    X.indices[lo:hi], minlength=g).max()))
     # one static transpose width across shards => one compiled program
     t_width = -(-t_width // 8) * 8
     sharding = NamedSharding(mesh, P(axis, None))
     idx_map = sharding.addressable_devices_indices_map((n, int(width)))
-    csr_blocks = {}
-    for dev, idx in idx_map.items():
-        s = idx[0]
-        csr_blocks[dev] = X[(s.start or 0):(s.stop if s.stop is not None
-                                            else n)]
-    ell_blocks = {dev: csr_to_ell(blk, width=int(width),
-                                  t_width=int(t_width))
-                  for dev, blk in csr_blocks.items()}
+    devs = list(idx_map)
+    bounds = {dev: ((idx[0].start or 0),
+                    (idx[0].stop if idx[0].stop is not None else n))
+              for dev, idx in idx_map.items()}
 
-    def assemble(shape, attr, leaf_shard):
-        amap = leaf_shard.addressable_devices_indices_map(shape)
-        arrs = [jax.device_put(getattr(ell_blocks[dev], attr), dev)
-                for dev in amap]
+    # pipeline the per-shard dual-ELL conversion (the expensive host prep:
+    # row/transpose index builds) and the four leaf uploads per shard —
+    # at most CNMF_TPU_STREAM_DEPTH shards' host ELL buffers are alive at
+    # once (a shard's 4 leaves are the slab unit, so the bytes budget
+    # clamps the window by the per-shard ELL footprint), and shards headed
+    # to different devices convert/transfer concurrently instead of
+    # serially
+    from .streaming import run_pipeline, stream_depth, stream_threads
+
+    shard_bytes = (rows_per_shard * int(width) * (4 + 4)
+                   + g * int(t_width) * (4 + 4))
+    ell_threads = stream_threads()
+    ell_depth = stream_depth(slab_bytes=shard_bytes, threads=ell_threads)
+
+    leaf_arrs: dict = {dev: None for dev in devs}
+
+    def prep(dev):
+        lo, hi = bounds[dev]
+        t0 = time.perf_counter()
+        ell = csr_to_ell(X[lo:hi], width=int(width), t_width=int(t_width))
+        host = (ell.vals, ell.cols, ell.rows_t, ell.perm_t)
+        t1 = time.perf_counter()
+        parts = tuple(jax.device_put(a, dev) for a in host)
+        jax.block_until_ready(parts)
+        t2 = time.perf_counter()
+        if stats is not None:
+            stats.add(host_prep_s=t1 - t0, h2d_s=t2 - t1, slabs=1,
+                      nbytes=sum(a.nbytes for a in host))
+        return parts
+
+    def commit(dev, parts):
+        leaf_arrs[dev] = parts
+
+    t_wall = time.perf_counter()
+    run_pipeline(devs, prep, commit, depth=ell_depth, threads=ell_threads)
+
+    def assemble(shape, leaf_i, leaf_shard):
+        arrs = [leaf_arrs[dev][leaf_i] for dev in devs]
         return jax.make_array_from_single_device_arrays(
             shape, leaf_shard, arrs)
 
-    vals = assemble((n, int(width)), "vals", sharding)
-    cols = assemble((n, int(width)), "cols", sharding)
+    vals = assemble((n, int(width)), 0, sharding)
+    cols = assemble((n, int(width)), 1, sharding)
     # transpose leaves: per-shard (g, t_width) blocks stack into a global
     # (n_shards * g, t_width) array split over the same axis — inside
     # shard_map each device sees exactly its shard's column grouping, with
     # perm_t indexing that shard's local flat value buffer
     t_shape = (n_shards * g, int(t_width))
-    rows_t = assemble(t_shape, "rows_t", sharding)
-    perm_t = assemble(t_shape, "perm_t", sharding)
+    rows_t = assemble(t_shape, 2, sharding)
+    perm_t = assemble(t_shape, 3, sharding)
+    if stats is not None:
+        stats.wall_s += time.perf_counter() - t_wall
     return EllMatrix(vals, cols, g, rows_t, perm_t), pad
 
 
-def prepare_rowsharded(X, mesh: Mesh):
+def prepare_rowsharded(X, mesh: Mesh, stats: StreamStats | None = None):
     """Stage a counts matrix for repeated row-sharded solves (one transfer,
     many replicates). Returns ``(X_device, n_orig)`` to pass to
     :func:`nmf_fit_rowsharded` / :func:`fit_h_rowsharded`."""
     n_orig = int(X.shape[0])
-    Xd, _ = stream_rows_to_mesh(X, mesh, mesh.axis_names[0])
+    Xd, _ = stream_rows_to_mesh(X, mesh, mesh.axis_names[0], stats=stats)
     return Xd, n_orig
 
 

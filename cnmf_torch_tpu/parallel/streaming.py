@@ -1,0 +1,634 @@
+"""Pipelined host->device staging: the bounded, double-buffered streaming
+engine behind every shard upload.
+
+BENCH_r05's ``rowshard`` tier put the wall in sharp relief: streaming a
+1M-cell CSR host->HBM took 21.9 s (0.37 GB/s dense-equivalent) while the
+entire 3-pass K=9 solve took 1.4 s. The old loops were fully serial —
+per device, per slab: slice CSR on host, ``np.zeros`` a fresh pad buffer,
+``device_put``, wait, densify, repeat — so host prep, the wire, and the
+device scatter each idled two-thirds of the time. "Distributed
+Out-of-Memory NMF" (PAPERS.md) attributes most of its speedup to exactly
+this overlap; MPI-FAUN's design keeps communication off the critical path
+for the same reason.
+
+This module provides the general machinery:
+
+  * :func:`run_pipeline` — a sliding-window producer/consumer: host slab
+    preparation (CSR row slicing, nnz padding, ELL conversion) runs on a
+    small thread pool, transfers are issued (and awaited) inside the
+    workers so uploads to *different devices* proceed concurrently, and
+    the caller thread commits on-device compute (densify / donated slab
+    placement) in deterministic task order. In-flight depth is capped by
+    ``CNMF_TPU_STREAM_DEPTH`` and a host-bytes budget, so host RAM stays
+    bounded no matter how large the matrix. Depth 1 (or 0 threads) is the
+    exact serial fallback.
+  * :class:`SlabBufferPool` — reusable host slab buffers (no ``np.zeros``
+    per slab): each buffer remembers its dirty prefix so reuse zeroes only
+    what the previous slab wrote.
+  * power-of-two nnz *bucketing* (:func:`nnz_bucket`) — a single skewed
+    slab no longer inflates every slab's transfer to the global max pad;
+    slabs ride the smallest bucket that fits, and the compile count stays
+    logarithmic.
+  * :class:`StreamStats` — per-phase wall ledger (host_prep / h2d /
+    device / wall, bytes) with an overlap fraction, recordable into a
+    :class:`~cnmf_torch_tpu.utils.profiling.StageTimer` so the bench can
+    verify the overlap instead of vibing it.
+  * :func:`stream_to_device` — single-device staging of a dense or CSR
+    host matrix (CSR densifies slab-by-slab, on device or on host per
+    :func:`_csr_transport`; the full dense matrix never exists on host),
+    used by ``cNMF._stage_dense`` and the replicate-sweep staging sites.
+
+Env knobs
+---------
+``CNMF_TPU_STREAM_DEPTH``    max prepared-but-uncommitted slabs in flight
+                             (default ``2 x threads``; ``1`` = serial)
+``CNMF_TPU_STREAM_THREADS``  host-prep worker threads (default
+                             ``min(4, cpu_count)``; ``0`` = serial)
+``CNMF_TPU_STREAM_BYTES``    host bytes budget for in-flight slab buffers
+                             (default 4 GiB) — depth is clamped so
+                             ``depth * slab_bytes`` stays under it
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.sparse as sp
+
+__all__ = ["StreamStats", "SlabBufferPool", "run_pipeline", "nnz_bucket",
+           "stream_threads", "stream_depth", "stream_to_device",
+           "stream_put_leaves", "DENSIFY_SLAB_ROWS"]
+
+# rows per on-device scatter / dense slab. TPU scatter materializes
+# sort/workspace temporaries proportional to its OUTPUT, so densifying a
+# multi-GB shard in one scatter can double its footprint and OOM;
+# slab-sized scatters keep the transient small while the donated update
+# assembles the shard.
+DENSIFY_SLAB_ROWS = 65_536
+
+# bytes per host-densified slab on the dense transport. ~32 MB is the
+# measured sweet spot: the slab stays L3-resident between the worker's
+# toarray write and the device_put read (h2d ran at cache speed, 2-3x the
+# DRAM rate 64-256 MB slabs got), while the depth window stays meaningful
+# on small-RAM hosts.
+_DENSE_SLAB_BYTES = 32 << 20
+
+DEPTH_ENV = "CNMF_TPU_STREAM_DEPTH"
+THREADS_ENV = "CNMF_TPU_STREAM_THREADS"
+BYTES_ENV = "CNMF_TPU_STREAM_BYTES"
+TRANSPORT_ENV = "CNMF_TPU_STREAM_TRANSPORT"
+
+_DEFAULT_BYTES_BUDGET = 4 << 30
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def stream_threads() -> int:
+    """Host-prep worker count. 0 disables the pipeline (serial staging).
+    Default leaves one core for the caller thread's commit dispatch and
+    the XLA runtime (measured faster than cpu_count workers on small
+    hosts, where an extra worker just contends for memory bandwidth)."""
+    return max(0, _env_int(THREADS_ENV,
+                           max(1, min(4, (os.cpu_count() or 2) - 1))))
+
+
+def stream_depth(slab_bytes: int | None = None,
+                 threads: int | None = None, windows: int = 1) -> int:
+    """In-flight slab cap: explicit ``CNMF_TPU_STREAM_DEPTH`` wins, else
+    double-buffered per worker plus a slot for the commit window; either
+    way clamped so the in-flight host buffers stay under the
+    ``CNMF_TPU_STREAM_BYTES`` budget. ``windows`` is how many depth-sized
+    windows of slab buffers the caller keeps alive at once (the CSR path
+    holds a prep window AND a commit-drain window, so its budget share is
+    per-window)."""
+    if threads is None:
+        threads = stream_threads()
+    depth = _env_int(DEPTH_ENV, max(2 * threads + 1, 3))
+    if slab_bytes and slab_bytes > 0:
+        budget = max(_env_int(BYTES_ENV, _DEFAULT_BYTES_BUDGET), 1)
+        depth = min(depth,
+                    max(budget // (int(slab_bytes) * max(windows, 1)), 1))
+    return max(depth, 1)
+
+
+class StreamStats:
+    """Thread-safe per-phase wall ledger for one staging run.
+
+    ``host_prep_s`` / ``h2d_s`` accumulate across worker threads (their sum
+    can exceed ``wall_s`` — that IS the overlap); ``device_s`` is commit
+    dispatch plus the final device sync; ``wall_s`` is end-to-end.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.host_prep_s = 0.0
+        self.h2d_s = 0.0
+        self.device_s = 0.0
+        self.wall_s = 0.0
+        self.nbytes = 0
+        self.slabs = 0
+
+    def add(self, host_prep_s=0.0, h2d_s=0.0, device_s=0.0, nbytes=0,
+            slabs=0):
+        with self._lock:
+            self.host_prep_s += host_prep_s
+            self.h2d_s += h2d_s
+            self.device_s += device_s
+            self.nbytes += nbytes
+            self.slabs += slabs
+
+    @property
+    def overlap_fraction(self) -> float:
+        """How much of the phase work ran concurrently: 0 on the serial
+        path (phase walls sum to the end-to-end wall), approaching 1 when
+        prep, transfer, and device work fully hide behind each other."""
+        busy = self.host_prep_s + self.h2d_s + self.device_s
+        if busy <= 0.0 or self.wall_s <= 0.0:
+            return 0.0
+        return max(0.0, min(1.0, 1.0 - self.wall_s / busy))
+
+    def gb_per_s(self) -> float:
+        return (self.nbytes / self.wall_s / 1e9) if self.wall_s > 0 else 0.0
+
+    def record_to(self, timer, prefix: str):
+        """Write one row per phase (plus the wall) into a StageTimer so
+        overlap is inspectable post-hoc from the timings TSV."""
+        if timer is None:
+            return
+        timer.record(f"{prefix}/host_prep", self.host_prep_s)
+        timer.record(f"{prefix}/h2d", self.h2d_s, nbytes=self.nbytes)
+        timer.record(f"{prefix}/device", self.device_s)
+        timer.record(f"{prefix}/wall", self.wall_s, nbytes=self.nbytes,
+                     slabs=self.slabs,
+                     overlap=round(self.overlap_fraction, 3))
+
+    def __repr__(self):
+        return (f"StreamStats(wall={self.wall_s:.3f}s "
+                f"prep={self.host_prep_s:.3f}s h2d={self.h2d_s:.3f}s "
+                f"device={self.device_s:.3f}s bytes={self.nbytes} "
+                f"slabs={self.slabs} overlap={self.overlap_fraction:.2f})")
+
+
+class _Buf:
+    __slots__ = ("arr", "used")
+
+    def __init__(self, arr):
+        self.arr = arr
+        self.used = 0  # dirty prefix length from the previous fill
+
+
+class SlabBufferPool:
+    """Reusable host slab buffers keyed by (shape, dtype).
+
+    ``fill`` writes the payload prefix and zeroes only the stale remainder
+    of the previous occupant — a fresh ``np.zeros`` per slab is exactly
+    the host-side churn the pipeline is trying to hide. Buffers must be
+    returned (``give``) only after the device transfer that reads them has
+    completed.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._free: dict = collections.defaultdict(list)
+        self.allocated = 0
+
+    def take(self, shape, dtype) -> _Buf:
+        key = (tuple(np.atleast_1d(shape)), np.dtype(dtype).str)
+        with self._lock:
+            free = self._free[key]
+            if free:
+                return free.pop()
+            self.allocated += 1
+        return _Buf(np.zeros(shape, np.dtype(dtype)))
+
+    def give(self, buf: _Buf):
+        key = (buf.arr.shape, buf.arr.dtype.str)
+        with self._lock:
+            self._free[key].append(buf)
+
+    @staticmethod
+    def fill(buf: _Buf, data) -> np.ndarray:
+        n = len(data)
+        buf.arr[:n] = data
+        if buf.used > n:
+            buf.arr[n:buf.used] = 0
+        buf.used = n
+        return buf.arr
+
+
+def nnz_bucket(nnz: int, cap: int, floor: int = 1024) -> int:
+    """Pad width for a slab's nnz: the smallest power-of-two bucket that
+    fits (never below ``floor``, never above the global max ``cap``) — so
+    one skewed slab compiles its own program instead of inflating every
+    slab's transfer to the global max, and the total number of compiled
+    scatter shapes stays logarithmic."""
+    cap = max(int(cap), 1)
+    b = max(int(floor), 1)
+    n = max(int(nnz), 1)
+    while b < n:
+        b <<= 1
+    return min(b, cap)
+
+
+def run_pipeline(tasks, prep, commit, *, depth: int | None = None,
+                 threads: int | None = None):
+    """Sliding-window pipeline: ``prep(task)`` on worker threads, with at
+    most ``depth`` tasks prepared-but-uncommitted; ``commit(task,
+    payload)`` on the caller thread in exact submission order (donated
+    device buffers chain per device, so commit order is load-bearing).
+
+    ``depth <= 1``, ``threads <= 0``, or a single task degrade to the
+    serial loop — bit-identical behavior, no threads spawned.
+    """
+    tasks = list(tasks)
+    if threads is None:
+        threads = stream_threads()
+    if depth is None:
+        depth = stream_depth(threads=threads)
+    if depth <= 1 or threads <= 0 or len(tasks) <= 1:
+        for t in tasks:
+            commit(t, prep(t))
+        return
+    import concurrent.futures
+
+    pending = collections.deque()
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(threads, len(tasks)),
+            thread_name_prefix="cnmf-stream") as ex:
+        try:
+            for t in tasks:
+                if len(pending) >= depth:
+                    tt, fut = pending.popleft()
+                    commit(tt, fut.result())
+                pending.append((t, ex.submit(prep, t)))
+            while pending:
+                tt, fut = pending.popleft()
+                commit(tt, fut.result())
+        except BaseException:
+            # drain so workers never outlive a failed staging call
+            for _, fut in pending:
+                fut.cancel()
+            raise
+
+
+# ---------------------------------------------------------------------------
+# on-device slab assembly (shared by the sharded and single-device paths)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("rows", "g"))
+def _csr_densify(vals, cols, indptr, rows: int, g: int):
+    """Densify one CSR row slab ON DEVICE: row ids recovered from indptr
+    by searchsorted, then one scatter-add. Padded tail entries (vals 0,
+    cols 0, positions past indptr[-1]) land as +0 adds — harmless."""
+    rowids = jnp.clip(
+        jnp.searchsorted(indptr, jnp.arange(vals.shape[0]), side="right") - 1,
+        0, rows - 1)
+    # cols may arrive int16 (halves wire bytes when g < 2**15); widen on
+    # device for the scatter
+    return jnp.zeros((rows, g), vals.dtype).at[
+        rowids, cols.astype(jnp.int32)].add(vals)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _place_slab(big, sub, start):
+    """In-place (donated) row-slab write — the shard buffer is never
+    duplicated, so peak device memory stays one shard + one slab."""
+    return jax.lax.dynamic_update_slice(big, sub, (start, 0))
+
+
+@functools.lru_cache(maxsize=None)
+def _zeros_builder(dev, rows: int, g: int, dtype):
+    """Per-(device, shape) cached allocator for a shard's dense buffer —
+    built once, not re-traced per shard in the staging loop."""
+    return jax.jit(lambda: jnp.zeros((rows, g), dtype),
+                   out_shardings=jax.sharding.SingleDeviceSharding(dev))
+
+
+def _slab_bounds(start: int, stop: int, step: int | None = None):
+    # read the module global at call time so tests can shrink the slab size
+    step = DENSIFY_SLAB_ROWS if step is None else step
+    for lo in range(start, stop, step):
+        yield lo, min(lo + step, stop)
+
+
+def _shard_slices(sharding, shape):
+    """Ordered [(device, row_start, row_stop)] for this process's shards."""
+    n = shape[0]
+    out = []
+    for dev, idx in sharding.addressable_devices_indices_map(shape).items():
+        s = idx[0]
+        out.append((dev, s.start or 0, s.stop if s.stop is not None else n))
+    return out
+
+
+def _interleave(per_dev_tasks):
+    """Round-robin task order across devices: [d0s0, d1s0, ..., d0s1, ...]
+    so transfers to different devices are in flight concurrently instead
+    of draining one device's queue before the next starts."""
+    out = []
+    longest = max((len(t) for t in per_dev_tasks), default=0)
+    for i in range(longest):
+        for t in per_dev_tasks:
+            if i < len(t):
+                out.append(t[i])
+    return out
+
+
+class _ShardAssembler:
+    """Per-device donated-buffer chain: collects committed slabs into one
+    dense buffer per shard (single-slab shards skip the zeros+place)."""
+
+    def __init__(self, dtype):
+        self.dtype = np.dtype(dtype)
+        self._big: dict = {}
+        self._n_slabs: dict = {}
+
+    def expect(self, dev, n_slabs: int):
+        self._n_slabs[dev] = n_slabs
+
+    def place(self, dev, sub, offset: int, rows: int, g: int):
+        if self._n_slabs.get(dev, 2) == 1:
+            self._big[dev] = sub
+            return
+        big = self._big.get(dev)
+        if big is None:
+            big = _zeros_builder(dev, rows, g, self.dtype)()
+        self._big[dev] = _place_slab(big, sub, offset)
+
+    def blocks(self, order):
+        return [self._big[dev] for dev in order]
+
+
+def _csr_transport(devices) -> str:
+    """How a sparse matrix should cross to these devices.
+
+    ``csr``: ship (values, col_indices, indptr) and scatter-densify on
+    device — wire bytes scale with nnz (~10x less than dense at
+    single-cell sparsity), the right trade whenever the wire is the wall
+    (TPU/GPU, tunneled links). ``dense``: densify slab-by-slab ON HOST
+    (scipy ``toarray``, still never the full matrix) and upload dense
+    slabs — the right trade when the "wire" is a local memcpy (CPU
+    backend), where XLA's element-wise scatter costs ~4x the memcpy it
+    replaces (measured 8.8 s scatter vs 2.2 s host toarray at 300k x 2k,
+    5% density). ``CNMF_TPU_STREAM_TRANSPORT`` forces either."""
+    forced = os.environ.get(TRANSPORT_ENV, "").strip().lower()
+    if forced in ("csr", "dense"):
+        return forced
+    return "dense" if all(d.platform == "cpu" for d in devices) else "csr"
+
+
+def _stream_csr_sharded(X, sharding, dtype, stats: StreamStats | None = None):
+    """Stage a host CSR matrix as a dense sharded device array through the
+    pipeline: slab prep (CSR slicing + pad buffers, or host slab densify —
+    :func:`_csr_transport`) on the stream thread pool, transfers issued
+    round-robin so every device's wire is busy concurrently, and the
+    donated densify/place chain committed per shard in order. In-flight
+    host memory is capped by the stream depth; slab nnz pads to
+    power-of-two buckets (:func:`nnz_bucket`), so one skewed slab no
+    longer inflates every slab's transfer to the global max."""
+    t_wall = time.perf_counter()
+    n, g = X.shape
+    shards = _shard_slices(sharding, (n, g))
+    col_dtype = np.int16 if g < 2 ** 15 else np.int32
+    val_dtype = np.dtype(dtype)
+    transport = _csr_transport([dev for dev, _, _ in shards])
+
+    # host-densify slabs are (rows x g) dense — capped at _DENSE_SLAB_BYTES
+    # (32 MB L3-resident sweet spot; see its definition) so the depth
+    # window stays meaningful on small-RAM hosts
+    step = None
+    if transport == "dense":
+        step = max(1, min(DENSIFY_SLAB_ROWS,
+                          _DENSE_SLAB_BYTES // max(int(g) * val_dtype.itemsize,
+                                            1)))
+
+    per_dev = []
+    max_slab_nnz = 1
+    for dev, start, stop in shards:
+        slabs = list(_slab_bounds(start, stop, step))
+        per_dev.append([(dev, start, stop, lo, hi) for lo, hi in slabs])
+        for lo, hi in slabs:
+            max_slab_nnz = max(max_slab_nnz,
+                               int(X.indptr[hi] - X.indptr[lo]))
+    tasks = _interleave(per_dev)
+
+    if transport == "dense":
+        slab_bytes = (step or DENSIFY_SLAB_ROWS) * g * val_dtype.itemsize
+    else:
+        slab_bytes = max_slab_nnz * (val_dtype.itemsize
+                                     + np.dtype(col_dtype).itemsize)
+    threads = stream_threads()
+    # two depth-sized buffer windows are alive at once here (prep pending
+    # + commit drain), so each gets half the bytes budget
+    depth = stream_depth(slab_bytes=slab_bytes, threads=threads, windows=2)
+    pool = SlabBufferPool()
+    asm = _ShardAssembler(val_dtype)
+    for group in per_dev:
+        if group:
+            asm.expect(group[0][0], len(group))
+
+    def prep_csr(task):
+        dev, start, stop, lo, hi = task
+        t0 = time.perf_counter()
+        blk = X[lo:hi]
+        pad = nnz_bucket(blk.nnz, max_slab_nnz)
+        vb = pool.take((pad,), val_dtype)
+        cb = pool.take((pad,), col_dtype)
+        vals = SlabBufferPool.fill(vb, blk.data)
+        cols = SlabBufferPool.fill(cb, blk.indices)
+        indptr = blk.indptr.astype(np.int32)
+        t1 = time.perf_counter()
+        parts = (jax.device_put(vals, dev), jax.device_put(cols, dev),
+                 jax.device_put(indptr, dev))
+        # await the transfers IN THE WORKER — other workers/devices keep
+        # streaming while this thread sits on the wire
+        jax.block_until_ready(parts)
+        t2 = time.perf_counter()
+        if stats is not None:
+            stats.add(host_prep_s=t1 - t0, h2d_s=t2 - t1, slabs=1,
+                      nbytes=vals.nbytes + cols.nbytes + indptr.nbytes)
+        return parts, (vb, cb)
+
+    def prep_dense(task):
+        dev, start, stop, lo, hi = task
+        t0 = time.perf_counter()
+        blk = X[lo:hi].toarray()
+        if blk.dtype != val_dtype:
+            blk = blk.astype(val_dtype)
+        t1 = time.perf_counter()
+        sub = jax.device_put(blk, dev)
+        jax.block_until_ready(sub)
+        t2 = time.perf_counter()
+        if stats is not None:
+            stats.add(host_prep_s=t1 - t0, h2d_s=t2 - t1, slabs=1,
+                      nbytes=blk.nbytes)
+        return sub, None
+
+    # pooled buffers go back only once the on-device scatter has CONSUMED
+    # the staged slab: a CPU backend may zero-copy device_put (the device
+    # array aliases the host buffer), so reusing a buffer any earlier
+    # corrupts in-flight slabs. Blocking per slab would serialize every
+    # device's scatters, so releases ride a bounded window instead: up to
+    # ``depth`` densifies stay in flight (scatters on different devices
+    # overlap) and the oldest is awaited only when the window slides. The
+    # same window bounds how many dispatched-but-unexecuted host slabs XLA
+    # can keep alive on the dense transport.
+    inflight: collections.deque = collections.deque()
+
+    def _drain_one():
+        sub, bufs = inflight.popleft()
+        jax.block_until_ready(sub)
+        if bufs is not None:
+            for b in bufs:
+                pool.give(b)
+
+    def commit(task, payload):
+        dev, start, stop, lo, hi = task
+        staged, bufs = payload
+        t0 = time.perf_counter()
+        if bufs is None:
+            sub = staged
+        else:
+            sub = _csr_densify(*staged, rows=int(hi - lo), g=int(g))
+        inflight.append((sub, bufs))
+        # ``>=`` so depth=1 is strictly serial (slab work awaited before
+        # the next slab preps — the documented no-overlap fallback)
+        if len(inflight) >= depth:
+            _drain_one()
+        asm.place(dev, sub, lo - start, stop - start, int(g))
+        if stats is not None:
+            stats.add(device_s=time.perf_counter() - t0)
+
+    run_pipeline(tasks, prep_dense if transport == "dense" else prep_csr,
+                 commit, depth=depth, threads=threads)
+
+    t0 = time.perf_counter()
+    while inflight:
+        _drain_one()
+    blocks = asm.blocks([dev for dev, _, _ in shards])
+    jax.block_until_ready(blocks)
+    out = jax.make_array_from_single_device_arrays((n, g), sharding, blocks)
+    if stats is not None:
+        stats.add(device_s=time.perf_counter() - t0)
+        stats.wall_s += time.perf_counter() - t_wall
+    return out
+
+
+def _stream_dense_sharded(X, sharding, dtype,
+                          stats: StreamStats | None = None):
+    """Dense host matrix -> sharded device array, slab-pipelined: workers
+    make each slab contiguous at the target dtype (a no-op view when the
+    input already is) and upload it; the caller chains donated slab
+    placement per shard. Replaces the serial ``make_array_from_callback``
+    walk, which uploaded one whole shard at a time on one thread."""
+    t_wall = time.perf_counter()
+    n, g = X.shape
+    shards = _shard_slices(sharding, (n, g))
+    np_dtype = np.dtype(dtype)
+
+    per_dev = []
+    for dev, start, stop in shards:
+        per_dev.append([(dev, start, stop, lo, hi)
+                        for lo, hi in _slab_bounds(start, stop)])
+    tasks = _interleave(per_dev)
+
+    slab_bytes = DENSIFY_SLAB_ROWS * g * np_dtype.itemsize
+    threads = stream_threads()
+    depth = stream_depth(slab_bytes=slab_bytes, threads=threads)
+    asm = _ShardAssembler(np_dtype)
+    for group in per_dev:
+        if group:
+            asm.expect(group[0][0], len(group))
+
+    def prep(task):
+        dev, start, stop, lo, hi = task
+        t0 = time.perf_counter()
+        blk = np.ascontiguousarray(np.asarray(X[lo:hi], dtype=np_dtype))
+        t1 = time.perf_counter()
+        sub = jax.device_put(blk, dev)
+        jax.block_until_ready(sub)
+        t2 = time.perf_counter()
+        if stats is not None:
+            stats.add(host_prep_s=t1 - t0, h2d_s=t2 - t1, nbytes=blk.nbytes,
+                      slabs=1)
+        return sub
+
+    def commit(task, sub):
+        dev, start, stop, lo, hi = task
+        t0 = time.perf_counter()
+        asm.place(dev, sub, lo - start, stop - start, int(g))
+        if stats is not None:
+            stats.add(device_s=time.perf_counter() - t0)
+
+    run_pipeline(tasks, prep, commit, depth=depth, threads=threads)
+
+    t0 = time.perf_counter()
+    blocks = asm.blocks([dev for dev, _, _ in shards])
+    jax.block_until_ready(blocks)
+    out = jax.make_array_from_single_device_arrays((n, g), sharding, blocks)
+    if stats is not None:
+        stats.add(device_s=time.perf_counter() - t0)
+        stats.wall_s += time.perf_counter() - t_wall
+    return out
+
+
+def stream_to_device(X, device=None, dtype=jnp.float32,
+                     stats: StreamStats | None = None):
+    """Stage one host matrix (dense or scipy-sparse) to ONE device as a
+    dense f32 array, through the pipeline: sparse inputs ship CSR slabs
+    and densify on device (the full dense matrix never exists on host —
+    the ``cNMF._stage_dense`` contract at atlas sparsity), dense inputs
+    upload slab-wise with conversion off the caller thread."""
+    if device is None:
+        device = jax.local_devices()[0]
+    sharding = jax.sharding.SingleDeviceSharding(device)
+    if sp.issparse(X):
+        return _stream_csr_sharded(X.tocsr(), sharding, dtype, stats=stats)
+    X = np.asarray(X)
+    return _stream_dense_sharded(X, sharding, dtype, stats=stats)
+
+
+def stream_put_leaves(arrays, shardings, stats: StreamStats | None = None):
+    """Issue one ``device_put`` per (host array, sharding) pair from the
+    stream thread pool — transfers overlap instead of queueing behind one
+    another (an EllMatrix is four leaves; the old path staged them one by
+    one). Order-preserving; serial under depth<=1/threads=0."""
+    arrays = list(arrays)
+    if not isinstance(shardings, (list, tuple)):
+        shardings = [shardings] * len(arrays)
+    out = [None] * len(arrays)
+
+    def prep(i):
+        t0 = time.perf_counter()
+        a = arrays[i]
+        d = (jax.device_put(a) if shardings[i] is None
+             else jax.device_put(a, shardings[i]))
+        jax.block_until_ready(d)
+        if stats is not None:
+            nb = a.nbytes if hasattr(a, "nbytes") else 0
+            stats.add(h2d_s=time.perf_counter() - t0, nbytes=nb, slabs=1)
+        return d
+
+    def commit(i, d):
+        out[i] = d
+
+    t_wall = time.perf_counter()
+    run_pipeline(range(len(arrays)), prep, commit)
+    if stats is not None:
+        stats.wall_s += time.perf_counter() - t_wall
+    return out

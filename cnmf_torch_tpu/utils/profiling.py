@@ -40,7 +40,10 @@ class StageTimer:
         self._lock = threading.Lock()
 
     @contextlib.contextmanager
-    def stage(self, name: str, **meta):
+    def stage(self, name: str, nbytes: int | None = None, **meta):
+        """Time a stage. ``nbytes`` (bytes the stage moved/produced) fills
+        the throughput columns — staging stages record it so host_prep vs
+        H2D vs device walls carry GB/s, not just seconds."""
         t0 = time.perf_counter()
         err = ""
         try:
@@ -50,21 +53,35 @@ class StageTimer:
             raise
         finally:
             elapsed = time.perf_counter() - t0
-            self._record(name, elapsed, err, meta)
+            self._record(name, elapsed, err, meta, nbytes)
 
-    def _record(self, name: str, elapsed: float, err: str, meta: dict):
+    def record(self, name: str, seconds: float, nbytes: int | None = None,
+               **meta):
+        """Append a pre-measured row (the streaming engine measures its
+        host_prep/h2d/device phases across threads itself — a context
+        manager around any one of them would measure the wrong wall)."""
+        self._record(name, float(seconds), "", meta, nbytes)
+
+    def _record(self, name: str, elapsed: float, err: str, meta: dict,
+                nbytes: int | None = None):
         if self.timings_path is None:
             return
         meta_str = ";".join(f"{k}={v}" for k, v in sorted(meta.items()))
+        gbps = ("" if not nbytes or elapsed <= 0
+                else f"{nbytes / elapsed / 1e9:.3f}")
         try:
             with self._lock:
                 header_needed = not os.path.exists(self.timings_path)
                 with open(self.timings_path, "a") as f:
                     if header_needed:
-                        f.write(
-                            "stage\twall_seconds\ttimestamp\terror\tmeta\n")
-                    f.write(f"{name}\t{elapsed:.4f}\t{time.time():.1f}\t"
-                            f"{err}\t{meta_str}\n")
+                        # bytes/gb_per_s sit AFTER wall_seconds: the one
+                        # external parser (bench.iter_stage_rows) reads
+                        # columns [:2] positionally
+                        f.write("stage\twall_seconds\tbytes\tgb_per_s\t"
+                                "timestamp\terror\tmeta\n")
+                    f.write(f"{name}\t{elapsed:.4f}\t"
+                            f"{nbytes if nbytes else ''}\t{gbps}\t"
+                            f"{time.time():.1f}\t{err}\t{meta_str}\n")
         except OSError:
             pass  # tracing must never take the pipeline down
 

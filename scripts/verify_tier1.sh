@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Canonical tier-1 gate (ROADMAP.md "Tier-1 verify") — builders and CI call
+# THIS, not a hand-copied pytest line, so the marker filter, plugin
+# disables, and the DOTS_PASSED count stay in one place.
+#
+# Usage: scripts/verify_tier1.sh [device_count]
+#   device_count  optional simulated CPU device count (sets
+#                 --xla_force_host_platform_device_count BEFORE conftest
+#                 runs; conftest defaults to 8 when unset). Run once with 4
+#                 to exercise the multi-device staging parity tests in a
+#                 second mesh geometry.
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+if [ -n "${1:-}" ]; then
+  case "$1" in
+    ''|*[!0-9]*) echo "device_count must be an integer, got: $1" >&2; exit 2 ;;
+  esac
+  export XLA_FLAGS="${XLA_FLAGS:+$XLA_FLAGS }--xla_force_host_platform_device_count=$1"
+fi
+
+LOG="${TIER1_LOG:-/tmp/_t1.log}"
+rm -f "$LOG"
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+  -p no:randomly 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)
+exit $rc

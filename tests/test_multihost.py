@@ -23,6 +23,15 @@ from cnmf_torch_tpu.parallel.rowshard import nmf_fit_rowsharded
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# the mesh-geometry assertions ([2, 4] shapes, _balanced_rc(8, ...)) and
+# the spawned 2-process x 4-device pods are written against the canonical
+# 8-device conftest mesh; under scripts/verify_tier1.sh <N != 8> (used to
+# exercise the staging parity tests in a second geometry) they would fail
+# on geometry, not behavior — skip instead
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) != 8,
+    reason="multihost geometry tests assume the canonical 8-device mesh")
+
 
 def _fixture_X(n=64, g=24, seed=123):
     rng = np.random.default_rng(seed)

@@ -210,12 +210,15 @@ def test_stream_rows_to_mesh_matches_dense(mesh):
 
 
 def test_rowsharded_never_densifies_full_matrix(mesh, monkeypatch):
-    """The no-host-dense guarantee, now in its strongest form: a row-sharded
-    solve on CSR input never calls toarray() AT ALL — the CSR buffers ship
-    to the devices and densify there (rowshard.py:_csr_densify), so
-    host->HBM bytes scale with nnz, not rows x genes."""
+    """The no-host-dense guarantee: a row-sharded solve on CSR input never
+    materializes the FULL dense matrix on host. On the accelerator (csr)
+    transport the CSR buffers ship to the devices and densify there
+    (streaming._csr_densify) — no toarray at all; forced here because the
+    CPU backend auto-selects the host slab-densify transport (covered by
+    test_streaming.py's slab-bound test)."""
     from cnmf_torch_tpu.parallel.rowshard import prepare_rowsharded
 
+    monkeypatch.setenv("CNMF_TPU_STREAM_TRANSPORT", "csr")
     n, g = 160, 32
     X = sp.random(n, g, density=0.15, random_state=9, format="csr")
 
@@ -452,8 +455,9 @@ def test_stream_csr_multislab_assembly(mesh, monkeypatch):
     so every shard needs several scatters, and require bit-exact equality
     with the dense matrix — including a non-dividing row count."""
     import cnmf_torch_tpu.parallel.rowshard as rs
+    import cnmf_torch_tpu.parallel.streaming as streaming
 
-    monkeypatch.setattr(rs, "_DENSIFY_SLAB_ROWS", 7)
+    monkeypatch.setattr(streaming, "DENSIFY_SLAB_ROWS", 7)
     X = sp.random(107, 23, density=0.21, random_state=12, format="csr")
     Xd, pad = rs.stream_rows_to_mesh(X, mesh, mesh.axis_names[0])
     got = np.asarray(Xd)
