@@ -98,6 +98,53 @@ def synthetic_counts_df(n, g, k_true=14, seed=3):
                         columns=[f"g{j}" for j in range(g)])
 
 
+def _tier_telemetry(workdir=None, name=None):
+    """Per-tier telemetry summary for the BENCH json (`telemetry` key —
+    additive; existing keys the trajectory tooling reads are untouched):
+    stage walls + convergence stats from the run's events.jsonl when a
+    pipeline tier produced one, and the device-memory peak always."""
+    from cnmf_torch_tpu.utils.telemetry import (device_memory_peak_bytes,
+                                                read_events,
+                                                summarize_events,
+                                                telemetry_enabled)
+
+    # enabled_during_run marks the measurement condition: pipeline tiers
+    # time telemetry-ENABLED programs (that's what buys the per-phase
+    # attribution), so trajectory comparisons across rounds should compare
+    # like with like
+    out: dict = {"memory_peak_bytes": device_memory_peak_bytes(),
+                 "enabled_during_run": telemetry_enabled()}
+    if workdir and name:
+        path = os.path.join(workdir, name, "cnmf_tmp",
+                            f"{name}.events.jsonl")
+        if os.path.exists(path):
+            s = summarize_events(read_events(path))
+            out["stage_walls_s"] = {
+                stage: v["wall_s"] for stage, v in s.get("stages",
+                                                         {}).items()}
+            if "convergence" in s:
+                out["convergence"] = s["convergence"]
+            if "memory_peak_bytes" in s:
+                out["memory_peak_bytes"] = max(out["memory_peak_bytes"],
+                                               s["memory_peak_bytes"])
+            out["n_events"] = s.get("n_events")
+    return out
+
+
+def _sink_to_convergence(payloads):
+    """Collapse sweep telemetry payloads into the convergence dict shape
+    the report uses (fraction capped, spread, nonfinite) — record
+    semantics come from the ONE shared converter
+    (telemetry.replicate_records), same as the pipeline's events."""
+    from cnmf_torch_tpu.utils.telemetry import (replicate_records,
+                                                summarize_events)
+
+    events = [{"v": 1, "t": "replicates", "ts": 0.0, "k": pay["k"],
+               "beta": pay["beta"], "records": replicate_records(pay)}
+              for pay in payloads]
+    return summarize_events(events).get("convergence", {})
+
+
 def iter_stage_rows(timings_tsv):
     """Yield (stage_name, wall_seconds) rows from a StageTimer ledger, in
     file order — the ONE parser of the timings-TSV format in this file."""
@@ -132,6 +179,10 @@ def bench_north_star():
     from cnmf_torch_tpu import cNMF
     from cnmf_torch_tpu.utils import save_df_to_npz
 
+    # telemetry ON for the pipeline tiers: the BENCH json then attributes
+    # any trajectory regression to a phase (stage walls + per-K replicate
+    # convergence ride under the additive `telemetry` key)
+    os.environ.setdefault("CNMF_TPU_TELEMETRY", "1")
     workdir = tempfile.mkdtemp(prefix="bench_ns_")
     counts_fn = os.path.join(workdir, "counts.df.npz")
     save_df_to_npz(synthetic_counts_df(10000, 5000), counts_fn)
@@ -185,6 +236,7 @@ def bench_north_star():
         return out
 
     stages = read_stage_seconds(tsv)
+    telemetry = _tier_telemetry(workdir, "ns")
     shutil.rmtree(workdir)
     e2e = factorize_cold + combine_cold + consensus_cold
     warm_e2e = factorize_warm + combine_warm + consensus_warm
@@ -208,6 +260,7 @@ def bench_north_star():
         "prepare_seconds": round(prepare_s, 3),
         "vs_baseline": round(NORTH_STAR_BASELINE_SECONDS / e2e, 2),
         "vs_baseline_warm": round(NORTH_STAR_BASELINE_SECONDS / warm_e2e, 2),
+        "telemetry": telemetry,
     }
 
 
@@ -240,6 +293,7 @@ def bench_anchor():
         "seconds": round(elapsed, 3),
         "vs_baseline": round(PBMC3K_BASELINE_SECONDS / elapsed, 2),
         "baseline": "ref tutorial: ~240 s, 120 runs, 4 CPU workers",
+        "telemetry": _tier_telemetry(),
     }
 
 
@@ -429,6 +483,25 @@ def bench_kl():
     out["sparse_fixture"]["sweep_seconds_dense_8rep"] = round(dense_sweep_s, 3)
     out["sparse_fixture"]["sweep_objective_max_rel_diff"] = round(
         float(rel.max()), 5)
+
+    # convergence telemetry for the tier (additive `telemetry` key): one
+    # sink-instrumented 8-replicate sweep. The TIMED sweeps above ran
+    # without a sink, so their programs stay the telemetry-free ones —
+    # the µs/iter probes measure the unchanged production kernels.
+    payloads: list = []
+    saved_t = os.environ.get("CNMF_TPU_TELEMETRY")
+    os.environ["CNMF_TPU_TELEMETRY"] = "1"
+    try:
+        replicate_sweep(X, seeds[:8], 9, beta_loss="kullback-leibler",
+                        mode="online", online_chunk_size=5000,
+                        telemetry_sink=payloads.append)
+    finally:
+        if saved_t is None:
+            os.environ.pop("CNMF_TPU_TELEMETRY", None)
+        else:
+            os.environ["CNMF_TPU_TELEMETRY"] = saved_t
+    out["telemetry"] = dict(_tier_telemetry(),
+                            convergence=_sink_to_convergence(payloads))
     return out
 
 
@@ -554,6 +627,7 @@ def bench_mfu():
     # k=64 shows the kernel's compute ceiling once the matmuls stop being
     # bandwidth-starved (arithmetic intensity scales with k)
     results["frobenius_k64"] = probe(10000, 2000, 64, 16, 100, 2.0)
+    results["telemetry"] = _tier_telemetry()
     return results
 
 
@@ -680,6 +754,7 @@ def bench_rowshard():
         "solve_seconds_3pass_k9": round(solve_s, 3),
         "cells_per_second": int(n * n_passes / solve_s),
         "staged_kl_refit_seconds_per_mu_iter": round(refit_s / refit_iters, 3),
+        "telemetry": _tier_telemetry(),
     }
 
 
@@ -691,6 +766,7 @@ def bench_harmony():
     from cnmf_torch_tpu import Preprocess, cNMF
     from cnmf_torch_tpu.utils.anndata_lite import AnnDataLite
 
+    os.environ.setdefault("CNMF_TPU_TELEMETRY", "1")
     n, g, k_true, n_batches = 8500, 5000, 8, 4
     rng = np.random.default_rng(21)
     usage = rng.dirichlet(np.ones(k_true) * 0.3, size=n)
@@ -731,12 +807,14 @@ def bench_harmony():
     except RuntimeError:
         obj.consensus(k=8, density_threshold=2.0, show_clustering=False)
     cnmf_s = time.perf_counter() - t0
+    telemetry = _tier_telemetry(workdir, "islets")
     shutil.rmtree(workdir)
     return {
         "cells": n, "genes": g, "batches": n_batches,
         "preprocess_seconds": round(preprocess_s, 3),
         "cnmf_seconds": round(cnmf_s, 3),
         "e2e_seconds": round(preprocess_s + cnmf_s, 3),
+        "telemetry": telemetry,
     }
 
 
@@ -850,7 +928,10 @@ def main():
                     "north-star baseline is the reference's PBMC3k "
                     "2.0 s/run anchor extrapolated linearly in rows and "
                     "runs (6667 s), consensus excluded; each tier runs "
-                    "fault-isolated in its own subprocess"),
+                    "fault-isolated in its own subprocess; pipeline tiers "
+                    "(north_star, harmony) time telemetry-ENABLED "
+                    "programs — telemetry.enabled_during_run marks the "
+                    "measurement condition for cross-round comparisons"),
     }))
 
 

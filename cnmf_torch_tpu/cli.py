@@ -1,4 +1,6 @@
-"""Command-line interface: the reference's five subcommands.
+"""Command-line interface: the reference's five subcommands, plus
+``run_parallel`` (the launcher) and ``report`` (render a run's telemetry —
+see ``utils/telemetry.py``).
 
 Flag-compatible with the reference CLI (``/root/reference/src/cnmf/cnmf.py:
 1387-1470``): ``prepare | factorize | combine | consensus |
@@ -34,7 +36,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "command", type=str,
         choices=["prepare", "factorize", "combine", "consensus",
-                 "k_selection_plot", "run_parallel"])
+                 "k_selection_plot", "run_parallel", "report"])
+    parser.add_argument(
+        "run_dir", type=str, nargs="?", default=None,
+        help="[report] Run directory ([output-dir]/[name]) whose telemetry "
+             "to render; defaults to --output-dir/--name")
     parser.add_argument("--name", type=str, nargs="?", default="cNMF",
                         help="[all] Name for analysis. All output will be "
                              "placed in [output-dir]/[name]/...")
@@ -173,6 +179,26 @@ def main(argv=None):
     # backend-initialization cost or touch the cache directory
     parser = build_parser()
     args = parser.parse_args(argv)
+
+    if args.command != "report" and args.run_dir is not None:
+        # the optional positional exists for `report` only; for every
+        # other subcommand a stray positional (e.g. `consensus 9` meaning
+        # `-k 9`) must fail fast, not be silently swallowed
+        parser.error(f"unrecognized argument: {args.run_dir!r} "
+                     f"(a positional run directory applies to 'report' "
+                     f"only)")
+
+    if args.command == "report":
+        # pure host-side rendering of a run's telemetry (events JSONL from
+        # CNMF_TPU_TELEMETRY=1 runs; timings TSV fallback) — never touches
+        # jax, so it works on machines without the run's accelerator
+        from .utils.telemetry import render_report
+
+        run_dir = args.run_dir or os.path.join(args.output_dir, args.name)
+        if not os.path.isdir(run_dir):
+            parser.error(f"report: run directory not found: {run_dir}")
+        print(render_report(run_dir))
+        return
 
     if args.command in ("prepare", "run_parallel"):
         # fail as a usage error, not a traceback from deep inside prepare

@@ -51,6 +51,7 @@ from ..utils.io import (
 )
 from ..utils.paths import build_paths
 from ..utils.profiling import StageTimer, trace
+from ..utils.telemetry import EventLog
 
 __all__ = ["cNMF"]
 
@@ -69,15 +70,20 @@ def compute_tpm(input_counts: AnnDataLite, totals=None) -> AnnDataLite:
 
 
 def _timed(stage_name: str):
-    """Record a pipeline stage in the run's timing ledger and (when
-    CNMF_TPU_PROFILE_DIR is set) an XLA profiler trace."""
+    """Record a pipeline stage in the run's timing ledger, (when
+    CNMF_TPU_PROFILE_DIR is set) an XLA profiler trace, and (when
+    CNMF_TPU_TELEMETRY is set) a device-memory watermark at the stage
+    boundary."""
     import functools
 
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(self, *args, **kwargs):
-            with self._timer.stage(stage_name), trace(stage_name):
-                return fn(self, *args, **kwargs)
+            try:
+                with self._timer.stage(stage_name), trace(stage_name):
+                    return fn(self, *args, **kwargs)
+            finally:
+                self._events.emit_memory(stage_name)
         return wrapper
     return deco
 
@@ -103,10 +109,21 @@ class cNMF:
         # densifies at every solver boundary, cnmf.py:817-818, 329-330)
         self.rowshard_threshold = int(rowshard_threshold)
         self.paths = build_paths(output_dir, name)
+        # structured run telemetry (ISSUE 4): JSONL event stream next to
+        # the timings TSV — manifest, dispatch decisions, stage walls,
+        # replicate convergence, stream stats, memory watermarks. Inert
+        # (no file, no ops in the jitted solvers) unless CNMF_TPU_TELEMETRY
+        # is set; the enabled check runs per-emit so env toggles work on a
+        # live object.
+        self._events = EventLog(os.path.join(
+            output_dir, name, "cnmf_tmp", name + ".events.jsonl"),
+            manifest_extra={"run_name": name})
         # per-stage wall-clock ledger + optional XLA traces (SURVEY.md §5.1:
-        # the reference has no tracing; this fills that gap)
+        # the reference has no tracing; this fills that gap); rows mirror
+        # into the event stream as `stage` events
         self._timer = StageTimer(os.path.join(
-            output_dir, name, "cnmf_tmp", name + ".timings.tsv"))
+            output_dir, name, "cnmf_tmp", name + ".timings.tsv"),
+            events=self._events)
         # consensus-stage device residency: norm_counts / tpm staged to HBM
         # once and reused across the three refits and the K-selection sweep
         self._dev_cache: dict = {}
@@ -172,6 +189,7 @@ class cNMF:
         stats = StreamStats()
         Xd = jax.block_until_ready(stream_to_device(X, stats=stats))
         stats.record_to(self._timer, f"stage_dense:{key}")
+        self._events.emit_stream(f"stage_dense:{key}", stats)
         self._dev_cache[key] = (token, Xd)
         return Xd
 
@@ -392,10 +410,33 @@ class cNMF:
         self.save_nmf_iter_params(replicate_params, _nmf_kwargs)
 
     def save_nmf_iter_params(self, replicate_params, run_params):
+        # the ledger summary must ride the manifest, which flushes with the
+        # FIRST event (prepare's own stage event beats factorize to it)
+        self._set_ledger_manifest(replicate_params, run_params)
         save_df_to_npz(replicate_params,
                        self.paths["nmf_replicate_parameters"])
         with open(self.paths["nmf_run_parameters"], "w") as f:
             yaml.dump(run_params, f)
+
+    def _set_ledger_manifest(self, replicate_params, nmf_kwargs,
+                             n_worker_tasks=None):
+        """Seed/K summary for the telemetry manifest (utils/telemetry.py):
+        called from prepare (ledger creation) and factorize (covers
+        factorize-only workers, whose cNMF object never saw prepare)."""
+        if not self._events.enabled or not len(replicate_params):
+            return
+        ledger = {
+            "ks": sorted(set(int(v)
+                             for v in replicate_params.n_components)),
+            "n_tasks": int(len(replicate_params)),
+            "seed_min": int(replicate_params.nmf_seed.min()),
+            "seed_max": int(replicate_params.nmf_seed.max()),
+            "beta_loss": str(nmf_kwargs.get("beta_loss")),
+            "init": str(nmf_kwargs.get("init", "random")),
+            "mode": str(nmf_kwargs.get("mode", "online"))}
+        if n_worker_tasks is not None:
+            ledger["n_worker_tasks"] = int(n_worker_tasks)
+        self._events.set_manifest_extra(ledger=ledger)
 
     # ------------------------------------------------------------------
     # factorize
@@ -456,6 +497,9 @@ class cNMF:
                 run_params.index[run_params["completed"] == False],  # noqa: E712
                 worker_i, total_workers)
         jobs = list(jobs)
+
+        self._set_ledger_manifest(run_params, _nmf_kwargs,
+                                  n_worker_tasks=len(jobs))
 
         # 2-D replicates x cells mesh (multi-host layout, parallel/multihost):
         # mesh="2d" auto-builds it; a Mesh with those two axes routes as-is
@@ -535,6 +579,11 @@ class cNMF:
             density = norm_counts.X.nnz / max(n_c * g_c, 1)
             use_ell = resolve_sparse_beta(beta_val, density=density,
                                           width=ell_w, g=g_c)
+            self._events.emit(
+                "dispatch", decision="ell_vs_dense",
+                context={"use_ell": bool(use_ell), "beta": float(beta_val),
+                         "density": round(float(density), 4),
+                         "ell_width": int(ell_w), "genes": int(g_c)})
 
         if use_ell and packed:
             # fail BEFORE the CSR->ELL conversion and host->HBM staging
@@ -584,6 +633,16 @@ class cNMF:
                 # link
                 self._dev_cache["norm_counts"] = (
                     self._content_token(norm_counts.X), X)
+
+        if self._events.enabled:
+            from ..parallel.streaming import (_csr_transport, stream_depth,
+                                              stream_threads)
+
+            self._events.emit(
+                "dispatch", decision="stream_config",
+                context={"transport": _csr_transport(jax.local_devices()),
+                         "threads": stream_threads(),
+                         "depth": stream_depth()})
 
         by_k: dict[int, list] = {}
         for idx in jobs:
@@ -667,7 +726,9 @@ class cNMF:
                 alpha_H=_nmf_kwargs.get("alpha_H", 0.0),
                 l1_ratio_H=_nmf_kwargs.get("l1_ratio_H", 0.0),
                 mesh=mesh, replicates_per_batch=replicates_per_batch,
-                on_slice=write_slice)
+                on_slice=write_slice,
+                telemetry_sink=lambda _idx, pay:
+                    self._emit_replicates_event(pay))
             return
 
         if len(by_k) > 1:
@@ -704,6 +765,9 @@ class cNMF:
         # keeps working) and (b) at most `window` Ks' results sit in HBM
         pending: list[tuple[int, list, object]] = []
         window = 4
+        # sweep telemetry payloads hold DEVICE arrays until their K drains
+        # — converting eagerly would block the dispatch-ahead window
+        telem_by_k: dict[int, dict] = {}
 
         def _drain(count):
             while len(pending) > count:
@@ -716,6 +780,7 @@ class cNMF:
                     save_df_to_npz(df,
                                    self.paths["iter_spectra"] % (k, it),
                                    compress=False)
+                self._emit_replicates_event(telem_by_k.pop(k, None))
 
         for k, tasks in sorted(by_k.items()):
             iters = [t[0] for t in tasks]
@@ -739,7 +804,9 @@ class cNMF:
                 fetch=False,
                 # pre-chunked ELL leaves carry padded rows; the sweep needs
                 # the true cell count for the init scale + program keys
-                n_rows=int(norm_counts.X.shape[0]) if use_ell else None)
+                n_rows=int(norm_counts.X.shape[0]) if use_ell else None,
+                telemetry_sink=lambda pay, _k=k:
+                    telem_by_k.__setitem__(_k, pay))
             pending.append((k, iters, spectra_d))
             _drain(window - 1)
         _drain(0)
@@ -757,6 +824,26 @@ class cNMF:
         with open(tmp, "w") as f:
             yaml.dump(record, f)
         os.replace(tmp, path)  # readers never see a half-written record
+        # the engaged solver family + effective params IS the dispatch
+        # decision — every factorize path funnels through here
+        self._events.emit("dispatch", decision="solver_path",
+                          context=dict({"engaged_path": engaged_path},
+                                       **effective_params))
+
+    def _emit_replicates_event(self, payload):
+        """Land one sweep's convergence telemetry
+        (``parallel.replicates._sweep_telemetry_payload``) as a
+        ``replicates`` event. Array values may still be device arrays —
+        converted here, at drain time, so the sweep pipeline's
+        dispatch-ahead window is preserved."""
+        if payload is None or not self._events.enabled:
+            return
+        from ..utils.telemetry import replicate_records
+
+        self._events.emit("replicates", k=payload["k"], beta=payload["beta"],
+                          mode=payload["mode"], cap=int(payload["cap"]),
+                          cadence=payload["cadence"],
+                          records=replicate_records(payload))
 
     def _factorize_rowsharded(self, jobs, run_params, norm_counts,
                               nmf_kwargs, mesh, worker_i):
@@ -775,7 +862,13 @@ class cNMF:
 
             mesh = Mesh(np.asarray(jax.devices()[:1]), ("cells",))
 
-        Xd, n_orig = prepare_rowsharded(norm_counts.X, mesh)
+        from ..parallel.streaming import StreamStats
+
+        stage_stats = StreamStats() if self._events.enabled else None
+        Xd, n_orig = prepare_rowsharded(norm_counts.X, mesh,
+                                        stats=stage_stats)
+        if stage_stats is not None:
+            self._events.emit_stream("rowshard_stage_x", stage_stats)
         _, n_passes_eff, _ = resolve_online_schedule(
             beta_loss_to_float(nmf_kwargs["beta_loss"]), 0.05,
             nmf_kwargs.get("n_passes"))
@@ -810,7 +903,8 @@ class cNMF:
                 l1_ratio_W=nmf_kwargs.get("l1_ratio_W", 0.0),
                 alpha_H=nmf_kwargs.get("alpha_H", 0.0),
                 l1_ratio_H=nmf_kwargs.get("l1_ratio_H", 0.0),
-                n_orig=n_orig)
+                n_orig=n_orig,
+                telemetry_sink=self._emit_replicates_event)
             df = pd.DataFrame(spectra, index=np.arange(1, k + 1),
                               columns=norm_counts.var.index)
             save_df_to_npz(df, self.paths["iter_spectra"] % (k, p["iter"]),

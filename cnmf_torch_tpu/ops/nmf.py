@@ -36,6 +36,7 @@ TPU-first design notes:
 from __future__ import annotations
 
 import functools
+import typing
 from typing import Any
 
 import jax
@@ -70,12 +71,44 @@ __all__ = [
     "init_factors",
     "nndsvd_init",
     "BETA_LOSS",
+    "SolverTelemetry",
+    "TRACE_LEN",
 ]
 
 EPS = 1e-16
 EVAL_EVERY = 10
 
 BETA_LOSS = {"frobenius": 2.0, "kullback-leibler": 1.0, "itakura-saito": 0.0}
+
+# fixed objective-trace length for solver telemetry: the while_loop carry
+# cannot grow, so convergence traces live in a fixed buffer — one slot per
+# objective evaluation (every EVAL_EVERY iterations for the batch solvers,
+# one per pass for the online solver, whose pass caps resolve to <= 60).
+# Evaluations beyond the buffer overwrite the last slot.
+TRACE_LEN = 64
+
+
+class SolverTelemetry(typing.NamedTuple):
+    """Per-solve convergence record, threaded through the ``lax.while_loop``
+    carries when the solver is traced with ``telemetry=True`` (a STATIC
+    flag: the default-False program is byte-identical to a build without
+    telemetry — zero ops, zero transfers).
+
+    ``trace``: (TRACE_LEN,) objective values at each evaluation point
+    (NaN-filled past the last evaluation; under ``vmap`` this stacks to
+    (R, TRACE_LEN)).  ``iters``: iterations (batch) or passes (online)
+    until the replicate's OWN stopping test first failed — LATCHED: under
+    ``vmap`` the batched loop keeps stepping converged replicates until
+    the last one finishes, and those extra monotone steps must not count
+    even if a lane's windowed progress momentarily re-exceeds ``tol``
+    afterwards (plateau-then-escape).
+    ``nonfinite``: any evaluated objective (incl. the final recompute)
+    was inf/NaN.  Whether a replicate was CAPPED is host-derivable:
+    ``iters >= max_iter`` (resp. ``n_passes``)."""
+
+    trace: Any
+    iters: Any
+    nonfinite: Any
 
 
 def beta_loss_to_float(beta_loss) -> float:
@@ -397,26 +430,66 @@ def _update_W(X, H, W, beta: float, l1: float, l2: float,
 # batch solver
 # ---------------------------------------------------------------------------
 
+def _trace_update(tm: SolverTelemetry, it, err_new, active):
+    """Record one loop step into the telemetry carry: the objective lands
+    in its evaluation slot (slot = evaluation ordinal, clamped to the last
+    buffer entry), iterations count only while the replicate's own
+    stopping test holds, and nonfinite latches on any evaluated inf/NaN.
+    Outside an evaluation step the slot write is a value-preserving no-op
+    (it writes back the current occupant)."""
+    evald = it % EVAL_EVERY == 0
+    idx = jnp.minimum(it // EVAL_EVERY - 1, TRACE_LEN - 1)
+    return SolverTelemetry(
+        trace=tm.trace.at[idx].set(jnp.where(evald, err_new, tm.trace[idx])),
+        iters=tm.iters + active.astype(jnp.int32),
+        nonfinite=tm.nonfinite | (evald & ~jnp.isfinite(err_new)))
+
+
+def _trace_init(err0) -> SolverTelemetry:
+    return SolverTelemetry(
+        trace=jnp.full((TRACE_LEN,), jnp.nan, jnp.float32),
+        iters=jnp.int32(0),
+        nonfinite=~jnp.isfinite(err0))
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("beta", "max_iter", "update_W_flag", "l1_H", "l2_H",
-                     "l1_W", "l2_W"),
+                     "l1_W", "l2_W", "telemetry"),
 )
 def nmf_fit_batch(X, H0, W0, beta: float = 2.0, tol: float = 1e-4,
                   max_iter: int = 200, l1_H: float = 0.0, l2_H: float = 0.0,
                   l1_W: float = 0.0, l2_W: float = 0.0,
-                  update_W_flag: bool = True):
+                  update_W_flag: bool = True, telemetry: bool = False):
     """Alternating MU until the relative objective decrease over an
     ``EVAL_EVERY``-iteration window falls below ``tol`` (sklearn-style
     criterion) or ``max_iter``. Returns ``(H, W, err)``.
 
     vmap-safe: under ``vmap`` the loop runs until every replicate in the
     batch converges (extra MU steps are monotone, hence harmless).
+
+    ``telemetry`` (STATIC; default off adds zero ops): additionally
+    returns a :class:`SolverTelemetry` — the objective trace at every
+    ``EVAL_EVERY`` evaluation, the iteration count the replicate's own
+    stopping test kept it active, and a nonfinite flag.
     """
     err0 = beta_divergence(X, H0, W0, beta=beta)
 
+    def active_of(err_prev, err, it):
+        not_converged = (err_prev - err) / jnp.maximum(err0, EPS) >= tol
+        # before the first evaluation window, err_prev == err0 keeps us going
+        return (it < max_iter) & (not_converged | (it < EVAL_EVERY))
+
     def body(carry):
-        H, W, err_prev, err, it = carry
+        if telemetry:
+            H, W, err_prev, err, it, tm, act = carry
+            # LATCHED per-lane activity: under vmap the batched loop keeps
+            # stepping converged lanes (their err/err_prev keep moving), so
+            # a plateau-then-escape lane could re-satisfy the progress test
+            # later — the latch pins iters at the lane's FIRST stop
+            act = act & active_of(err_prev, err, it)
+        else:
+            H, W, err_prev, err, it = carry
         H = _update_H(X, H, W, beta, l1_H, l2_H)
         W = _update_W(X, H, W, beta, l1_W, l2_W) if update_W_flag else W
         it = it + 1
@@ -427,18 +500,24 @@ def nmf_fit_batch(X, H0, W0, beta: float = 2.0, tol: float = 1e-4,
         err_new = jax.lax.cond(it % EVAL_EVERY == 0, with_err,
                                lambda _: err, operand=None)
         err_prev = jnp.where(it % EVAL_EVERY == 0, err, err_prev)
+        if telemetry:
+            return (H, W, err_prev, err_new, it,
+                    _trace_update(tm, it, err_new, act), act)
         return (H, W, err_prev, err_new, it)
 
     def cond(carry):
-        _, _, err_prev, err, it = carry
-        not_converged = (err_prev - err) / jnp.maximum(err0, EPS) >= tol
-        # before the first evaluation window, err_prev == err0 keeps us going
-        return (it < max_iter) & (not_converged | (it < EVAL_EVERY))
+        return active_of(carry[2], carry[3], carry[4])
 
-    H, W, _, err, _ = jax.lax.while_loop(
-        cond, body, (H0, W0, err0, err0, jnp.int32(0))
-    )
+    init = (H0, W0, err0, err0, jnp.int32(0))
+    if telemetry:
+        init = init + (_trace_init(err0), jnp.bool_(True))
+    out = jax.lax.while_loop(cond, body, init)
+    H, W = out[0], out[1]
     err = beta_divergence(X, H, W, beta=beta)
+    if telemetry:
+        tm = out[5]
+        return H, W, err, tm._replace(
+            nonfinite=tm.nonfinite | ~jnp.isfinite(err))
     return H, W, err
 
 
@@ -595,12 +674,12 @@ def bundled_beta2_update(X, Hb, Wb, mask, l1_H: float, l2_H: float,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("max_iter", "l1_H", "l2_H", "l1_W", "l2_W"),
+    static_argnames=("max_iter", "l1_H", "l2_H", "l1_W", "l2_W", "telemetry"),
 )
 def nmf_fit_batch_bundled(X, H0, W0, tol: float = 1e-4,
                           max_iter: int = 200, l1_H: float = 0.0,
                           l2_H: float = 0.0, l1_W: float = 0.0,
-                          l2_W: float = 0.0):
+                          l2_W: float = 0.0, telemetry: bool = False):
     """R-replicate beta=2 batch MU with bundle-packed contractions.
 
     Drop-in for ``jax.vmap(nmf_fit_batch)`` over stacked ``(H0 (R,n,k),
@@ -610,6 +689,13 @@ def nmf_fit_batch_bundled(X, H0, W0, tol: float = 1e-4,
     vmapped solver is pinned to ~1e-5 relative by test (bit-identical per
     update step at production shapes). Returns ``(H (R,n,k), W (R,k,g),
     errs (R,))``.
+
+    ``telemetry`` (STATIC; default off adds zero ops): additionally
+    returns a replicate-stacked :class:`SolverTelemetry` (trace
+    (R, TRACE_LEN), iters (R,), nonfinite (R,)) — the packed analog of
+    ``vmap(nmf_fit_batch, telemetry=True)``. The per-replicate ``act``
+    mask the freeze logic already maintains IS the per-replicate active
+    flag, so iters are exact per replicate (not the batch max).
     """
     R, _, k = H0.shape
     per_b = bundle_width(k)
@@ -629,7 +715,10 @@ def nmf_fit_batch_bundled(X, H0, W0, tol: float = 1e-4,
         return (it < max_iter) & (not_conv | (it < EVAL_EVERY))
 
     def body(carry):
-        Hb, Wb, err_prev, err, it = carry
+        if telemetry:
+            Hb, Wb, err_prev, err, it, tm = carry
+        else:
+            Hb, Wb, err_prev, err, it = carry
         act = active_of(err_prev, err, it)              # (R_b,)
         Hb_n, Wb_n = bundled_beta2_update(X, Hb, Wb, mask,
                                           l1_H, l2_H, l1_W, l2_W)
@@ -645,16 +734,32 @@ def nmf_fit_batch_bundled(X, H0, W0, tol: float = 1e-4,
                                lambda _: err, operand=None)
         err_new = jnp.where(act, err_new, err)
         err_prev = jnp.where((it % EVAL_EVERY == 0) & act, err, err_prev)
+        if telemetry:
+            return (Hb, Wb, err_prev, err_new, it,
+                    _trace_update(tm, it, err_new, act))
         return (Hb, Wb, err_prev, err_new, it)
 
     def cond(carry):
-        _, _, err_prev, err, it = carry
-        return jnp.any(active_of(err_prev, err, it))
+        return jnp.any(active_of(carry[2], carry[3], carry[4]))
 
-    Hb, Wb, _, _, _ = jax.lax.while_loop(
-        cond, body, (Hb, Wb, err0, err0, jnp.int32(0)))
+    init = (Hb, Wb, err0, err0, jnp.int32(0))
+    if telemetry:
+        # per-replicate telemetry: trace (TRACE_LEN, R_b) so the shared
+        # slot-write helper applies row-wise; transposed to the vmap
+        # convention (R, TRACE_LEN) on exit
+        init = init + (SolverTelemetry(
+            trace=jnp.full((TRACE_LEN, R_b), jnp.nan, jnp.float32),
+            iters=jnp.zeros((R_b,), jnp.int32),
+            nonfinite=~jnp.isfinite(err0)),)
+    out = jax.lax.while_loop(cond, body, init)
+    Hb, Wb = out[0], out[1]
     errs = errs_of(Hb, Wb)
     H, W = unbundle_stacks(Hb, Wb, R_b, k)
+    if telemetry:
+        tm = out[5]
+        return H[:R], W[:R], errs[:R], SolverTelemetry(
+            trace=tm.trace.T[:R], iters=tm.iters[:R],
+            nonfinite=(tm.nonfinite | ~jnp.isfinite(errs))[:R])
     return H[:R], W[:R], errs[:R]
 
 
@@ -778,14 +883,15 @@ def _chunk_h_solve(x, h, W, WWT, beta, l1, l2, max_iter, h_tol,
 @functools.partial(
     jax.jit,
     static_argnames=("beta", "chunk_max_iter", "n_passes", "l1_H", "l2_H",
-                     "l1_W", "l2_W", "h_tol_start", "algo", "bf16_ratio"),
+                     "l1_W", "l2_W", "h_tol_start", "algo", "bf16_ratio",
+                     "telemetry"),
 )
 def nmf_fit_online(Xc, Hc0, W0, beta: float = 2.0, tol: float = 1e-4,
                    h_tol: float = 1e-3, chunk_max_iter: int = 1000,
                    n_passes: int = 20, l1_H: float = 0.0, l2_H: float = 0.0,
                    l1_W: float = 0.0, l2_W: float = 0.0,
                    h_tol_start: float | None = None, algo: str = "mu",
-                   bf16_ratio: bool = False):
+                   bf16_ratio: bool = False, telemetry: bool = False):
     """Streamed MU over pre-chunked inputs.
 
     ``Xc``: (n_chunks, chunk, genes) row-chunked data (zero-padded rows are
@@ -811,6 +917,11 @@ def nmf_fit_online(Xc, Hc0, W0, beta: float = 2.0, tol: float = 1e-4,
     2.09x for IS on v5e; see ``_update_H``). Factor state, W sums, and
     the objective evaluation stay f32, so the stopping rule's semantics
     are unchanged.
+
+    ``telemetry`` (STATIC; default off adds zero ops): additionally
+    returns a :class:`SolverTelemetry` whose trace holds one objective
+    per PASS (the pass loop is this solver's convergence loop; its caps
+    resolve to <= 60 <= TRACE_LEN) and whose ``iters`` counts passes.
     """
     bf16_ratio = bool(bf16_ratio) and beta in (1.0, 0.0)
     if algo not in ("mu", "halsvar"):
@@ -919,19 +1030,13 @@ def nmf_fit_online(Xc, Hc0, W0, beta: float = 2.0, tol: float = 1e-4,
     (Hc, W, err0), _ = one_pass((Hc0, W0, jnp.float32(jnp.inf)),
                                 jnp.int32(0))
 
-    def pass_body(carry):
-        Hc, W, err_prev, err, it = carry
-        (Hc, W, _), err_new = one_pass((Hc, W, err), it)
-        return (Hc, W, err, err_new, it + 1)
-
-    def pass_cond(carry):
+    def active_of(err_prev, err, it):
         # it counts completed passes (the err0 pass is #1), so `it < n_passes`
         # allows exactly n_passes total. While the coarse-to-fine inner
         # tolerance is still above its floor, small per-pass progress must
         # NOT stop the loop — the tolerance hasn't tightened yet and later
         # passes resume real progress (premature stops here plateaued
         # exact-recovery cases well above the tight-schedule optimum).
-        _, _, err_prev, err, it = carry
         if h_tol_start is None:
             still_coarse = jnp.bool_(False)
         else:
@@ -940,10 +1045,39 @@ def nmf_fit_online(Xc, Hc0, W0, beta: float = 2.0, tol: float = 1e-4,
         progressing = (err_prev - err) / jnp.maximum(err0, EPS) >= tol
         return (it < n_passes) & (still_coarse | progressing)
 
-    Hc, W, _, err, _ = jax.lax.while_loop(
-        pass_cond, pass_body,
-        (Hc, W, err0 * (1.0 + 2.0 * tol) + 1.0, err0, jnp.int32(1)),
-    )
+    def pass_body(carry):
+        if telemetry:
+            Hc, W, err_prev, err, it, tm, act = carry
+            # latched, as in nmf_fit_batch: under vmap a lane whose pass
+            # progress re-exceeds tol after its own stop must not resume
+            # counting passes
+            act = act & active_of(err_prev, err, it)
+        else:
+            Hc, W, err_prev, err, it = carry
+        (Hc, W, _), err_new = one_pass((Hc, W, err), it)
+        if telemetry:
+            # one trace slot per pass: pass it+1's objective lands at
+            # 0-based slot `it` (slot 0 holds err0 from the init below)
+            tm = SolverTelemetry(
+                trace=tm.trace.at[jnp.minimum(it, TRACE_LEN - 1)].set(
+                    err_new),
+                iters=tm.iters + act.astype(jnp.int32),
+                nonfinite=tm.nonfinite | ~jnp.isfinite(err_new))
+            return (Hc, W, err, err_new, it + 1, tm, act)
+        return (Hc, W, err, err_new, it + 1)
+
+    def pass_cond(carry):
+        return active_of(carry[2], carry[3], carry[4])
+
+    init = (Hc, W, err0 * (1.0 + 2.0 * tol) + 1.0, err0, jnp.int32(1))
+    if telemetry:
+        init = init + (SolverTelemetry(
+            trace=jnp.full((TRACE_LEN,), jnp.nan,
+                           jnp.float32).at[0].set(err0),
+            iters=jnp.int32(1),  # the err0 pass already ran
+            nonfinite=~jnp.isfinite(err0)), jnp.bool_(True))
+    out = jax.lax.while_loop(pass_cond, pass_body, init)
+    Hc, W = out[0], out[1]
 
     # the per-pass err is accumulated against the W each chunk saw *before*
     # its update; report the exact objective of the returned (H, W) pair
@@ -953,6 +1087,10 @@ def nmf_fit_online(Xc, Hc0, W0, beta: float = 2.0, tol: float = 1e-4,
         return acc + beta_divergence(x, h, W, beta=beta), None
 
     err, _ = jax.lax.scan(err_chunk, jnp.float32(0.0), (Xc, Hc))
+    if telemetry:
+        tm = out[5]
+        return Hc, W, err, tm._replace(
+            nonfinite=tm.nonfinite | ~jnp.isfinite(err))
     return Hc, W, err
 
 

@@ -39,7 +39,40 @@ from ..ops.nmf import (
     resolve_online_schedule,
     split_regularization,
 )
+from ..ops.nmf import EVAL_EVERY, SolverTelemetry
 from ..ops.sparse import EllMatrix, ell_device_put
+
+
+def _sweep_telemetry_payload(k, beta, mode, seeds, cap, tm, errs):
+    """The dict a sweep's ``telemetry_sink`` receives. Array values are
+    DEVICE arrays (one dispatch-ordered fetch per sweep already covers
+    them) — callers ``np.asarray`` when they land events, so a
+    ``fetch=False`` pipeline keeps its overlap."""
+    return {
+        "k": int(k), "beta": float(beta), "mode": mode,
+        "seeds": [int(s) for s in seeds],
+        "cap": int(cap),
+        "cadence": "pass" if mode == "online" else f"iter/{EVAL_EVERY}",
+        "trace": tm.trace, "iters": tm.iters, "nonfinite": tm.nonfinite,
+        "errs": errs,
+    }
+
+
+def _concat_telemetry(parts):
+    if len(parts) == 1:
+        return parts[0]
+    return SolverTelemetry(
+        trace=jnp.concatenate([t.trace for t in parts]),
+        iters=jnp.concatenate([t.iters for t in parts]),
+        nonfinite=jnp.concatenate([t.nonfinite for t in parts]))
+
+
+def _telemetry_requested(telemetry_sink) -> bool:
+    if telemetry_sink is None:
+        return False
+    from ..utils.telemetry import telemetry_enabled
+
+    return telemetry_enabled()
 
 __all__ = ["replicate_sweep", "replicate_sweep_packed", "worker_filter",
            "default_mesh", "auto_replicates_per_batch", "clear_sweep_cache",
@@ -226,6 +259,13 @@ def warm_sweep_programs(n: int, g: int, k_to_count: dict,
     if not specs:
         return 0
 
+    # warm the SAME telemetry variant the sweep will dispatch (the flag is
+    # part of the program cache key; warming the other variant would put
+    # the compile wall right back on the first sweep call)
+    from ..utils.telemetry import telemetry_enabled
+
+    telem = telemetry_enabled()
+
     def compile_one(spec):
         k, r_pad = spec
         prog = _sweep_program(
@@ -234,7 +274,8 @@ def warm_sweep_programs(n: int, g: int, k_to_count: dict,
             int(online_chunk_max_iter), int(n_passes), int(batch_max_iter),
             l1_H, l2_H, l1_W, l2_W, mesh, bool(return_usages),
             h_tol_start=h_tol_start,
-            bf16_ratio=resolve_bf16_ratio(beta, mode))
+            bf16_ratio=resolve_bf16_ratio(beta, mode),
+            telemetry=telem)
         if ell_dims is not None:
             w_e, wt_e = int(ell_dims[0]), int(ell_dims[1])
             if mode == "online":
@@ -327,7 +368,7 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
                    l1_H: float, l2_H: float, l1_W: float, l2_W: float,
                    mesh: Mesh | None, return_usages: bool,
                    packed: bool = False, h_tol_start: float | None = None,
-                   bf16_ratio: bool = False):
+                   bf16_ratio: bool = False, telemetry: bool = False):
     """Build (once per static configuration) the jitted sweep executable
     ``(X (n,g), seeds (R,)) -> (usages | (0,), spectra (R,k,g), errs (R,))``.
 
@@ -335,6 +376,12 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
     inside ONE jit so a steady-state sweep call is a single cached XLA
     dispatch. (Building the vmap wrapper per call re-traced the whole solver
     through Python each time, which cost ~3x the actual device time.)
+
+    ``telemetry=True`` (a separate cache entry — the False program is
+    structurally unchanged) appends a replicate-stacked
+    :class:`~cnmf_torch_tpu.ops.nmf.SolverTelemetry` to the outputs:
+    (trace (R, TRACE_LEN), iters (R,), nonfinite (R,)), fetched by the
+    caller in ONE device->host read alongside the spectra.
 
     ``packed=True`` builds the PACKED K-sweep variant: ``k`` is K_max, the
     program additionally takes the slice's actual component count (a traced
@@ -365,16 +412,21 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
         def solve(X, h0, w0):
             return nmf_fit_batch(
                 X, h0, w0, beta=beta, tol=tol, max_iter=batch_max_iter,
-                l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W)
+                l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W,
+                telemetry=telemetry)
     elif mode == "online":
         def solve(X, h0, w0):
             Xc, Hc, _ = _chunk_rows(X, h0, chunk)
-            Hc, W, err = nmf_fit_online(
+            out = nmf_fit_online(
                 Xc, Hc, w0, beta=beta, tol=tol, h_tol=h_tol,
                 chunk_max_iter=chunk_max_iter, n_passes=n_passes,
                 l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W,
-                h_tol_start=h_tol_start, bf16_ratio=bf16_ratio)
-            return Hc.reshape(-1, k)[:n], W, err
+                h_tol_start=h_tol_start, bf16_ratio=bf16_ratio,
+                telemetry=telemetry)
+            Hc, W, err = out[:3]
+            H_flat = Hc.reshape(-1, k)[:n]
+            return (H_flat, W, err, out[3]) if telemetry else \
+                (H_flat, W, err)
     else:
         raise ValueError(f"unknown mode {mode!r}")
 
@@ -411,13 +463,16 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
                 # zero-padded components survive the bundled updates too:
                 # their factor rows are exact zeros, so every masked-Gram
                 # and numerator contribution they touch is exactly zero
-                H, W, err = nmf_fit_batch_bundled(
+                out = nmf_fit_batch_bundled(
                     X, H0, W0, tol=tol, max_iter=batch_max_iter,
-                    l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W)
+                    l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W,
+                    telemetry=telemetry)
             else:
-                H, W, err = jax.vmap(solve, in_axes=(None, 0, 0))(X, H0, W0)
-            return (H if return_usages
-                    else jnp.zeros((0,), X.dtype)), W, err
+                out = jax.vmap(solve, in_axes=(None, 0, 0))(X, H0, W0)
+            H, W, err = out[:3]
+            res = ((H if return_usages
+                    else jnp.zeros((0,), X.dtype)), W, err)
+            return res + ((out[3],) if telemetry else ())
     else:
         def sweep(X, seeds):
             H0, W0 = _stacked_inits(X, k, seeds, init, n_rows=n)
@@ -425,14 +480,18 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
                 H0 = jax.lax.with_sharding_constraint(H0, spec)
                 W0 = jax.lax.with_sharding_constraint(W0, spec)
             if stacked_solver:
-                H, W, err = nmf_fit_batch_bundled(
+                out = nmf_fit_batch_bundled(
                     X, H0, W0, tol=tol, max_iter=batch_max_iter,
-                    l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W)
+                    l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W,
+                    telemetry=telemetry)
             else:
-                H, W, err = jax.vmap(solve, in_axes=(None, 0, 0))(X, H0, W0)
+                out = jax.vmap(solve, in_axes=(None, 0, 0))(X, H0, W0)
+            H, W, err = out[:3]
             # drop the usage stack inside the program when the caller
             # doesn't want it — saves the (R, n, k) device->host transfer
-            return (H if return_usages else jnp.zeros((0,), X.dtype)), W, err
+            res = ((H if return_usages
+                    else jnp.zeros((0,), X.dtype)), W, err)
+            return res + ((out[3],) if telemetry else ())
 
     return jax.jit(sweep)
 
@@ -450,7 +509,7 @@ def replicate_sweep_packed(X, ks, seeds, beta_loss="frobenius",
                            replicates_per_batch: int | None = None,
                            online_h_tol: float | None = None,
                            fetch: bool = True,
-                           on_slice=None):
+                           on_slice=None, telemetry_sink=None):
     """Run an entire multi-K sweep — ``len(seeds)`` (k, seed) tasks — as ONE
     compiled program at ``K_max``.
 
@@ -474,6 +533,11 @@ def replicate_sweep_packed(X, ks, seeds, beta_loss="frobenius",
     completes, so callers can land per-task artifacts eagerly (crash-resume
     keeps working mid-sweep). When given, the function returns ``None``
     instead of accumulating the full result.
+
+    ``telemetry_sink(task_indices, payload)``: optional per-slice
+    convergence-telemetry callback (active only under
+    ``CNMF_TPU_TELEMETRY``) — ``payload`` is a
+    :func:`_sweep_telemetry_payload` dict for that slice's replicates.
     """
     if isinstance(X, EllMatrix):
         # the packed program's K_max-padded init gathers x_mean from the
@@ -523,6 +587,7 @@ def replicate_sweep_packed(X, ks, seeds, beta_loss="frobenius",
     for i, kv in enumerate(ks):
         by_k.setdefault(kv, []).append(i)
 
+    want_telem = _telemetry_requested(telemetry_sink)
     order: list[int] = []
     parts = []
     for kv in sorted(by_k):
@@ -541,8 +606,18 @@ def replicate_sweep_packed(X, ks, seeds, beta_loss="frobenius",
                 int(online_chunk_max_iter), int(n_passes),
                 int(batch_max_iter), l1_H, l2_H, l1_W, l2_W, mesh,
                 bool(return_usages), packed=True, h_tol_start=h_tol_start,
-                bf16_ratio=resolve_bf16_ratio(beta, mode))
-            H, W, err = prog(X, np.asarray(sl_s, np.uint32), np.int32(kv))
+                bf16_ratio=resolve_bf16_ratio(beta, mode),
+                telemetry=want_telem)
+            out = prog(X, np.asarray(sl_s, np.uint32), np.int32(kv))
+            H, W, err = out[:3]
+            if want_telem:
+                tm = out[3]
+                telemetry_sink(sl_idx, _sweep_telemetry_payload(
+                    kv, beta, mode, [seeds[i] for i in sl_idx],
+                    n_passes if mode == "online" else batch_max_iter,
+                    SolverTelemetry(trace=tm.trace[:r], iters=tm.iters[:r],
+                                    nonfinite=tm.nonfinite[:r]),
+                    err[:r]))
             if on_slice is not None:
                 on_slice(sl_idx, np.asarray(W[:r]), np.asarray(err[:r]))
                 continue
@@ -583,7 +658,7 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
                     mesh: Mesh | None = None, return_usages: bool = False,
                     replicates_per_batch: int | None = None,
                     online_h_tol: float | None = None, fetch: bool = True,
-                    n_rows: int | None = None):
+                    n_rows: int | None = None, telemetry_sink=None):
     """Run ``len(seeds)`` NMF replicates at one K as a batched XLA program.
 
     Returns ``(spectra (R, k, g), usages (R, n, k) | None, errs (R,))`` in
@@ -609,6 +684,14 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
     pre-chunked leaves carry padded rows, and without it the padded count
     leaks into the init scale, the returned usage shape, and the program
     cache key.
+
+    ``telemetry_sink``: optional callable receiving ONE dict per sweep
+    (:func:`_sweep_telemetry_payload`) with per-replicate convergence
+    records — objective traces, iterations/passes run, nonfinite flags —
+    threaded through the solvers' while_loop carries and fetched with the
+    sweep results (no extra device syncs). Active only when
+    ``CNMF_TPU_TELEMETRY`` is set; otherwise the sink is never called and
+    the compiled programs are the unchanged telemetry-free ones.
     """
     beta = beta_loss_to_float(beta_loss)
     if n_rows is not None:
@@ -699,7 +782,9 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
             # themselves so this broadcast doesn't repeat per call
             X = jax.device_put(X, target)
 
+    want_telem = _telemetry_requested(telemetry_sink)
     parts = []
+    telem_parts = []
     for start, r, r_pad in slices:
         sl = seeds[start:start + r]
         if r_pad > r:
@@ -712,10 +797,17 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
             int(online_chunk_max_iter), int(n_passes), int(batch_max_iter),
             l1_H, l2_H, l1_W, l2_W, mesh, bool(return_usages),
             h_tol_start=h_tol_start,
-            bf16_ratio=resolve_bf16_ratio(beta, mode))
+            bf16_ratio=resolve_bf16_ratio(beta, mode),
+            telemetry=want_telem)
         # async dispatch: every slice is enqueued before any result is read
-        H, W, err = prog(X, np.asarray(sl, dtype=np.uint32))
+        out = prog(X, np.asarray(sl, dtype=np.uint32))
+        H, W, err = out[:3]
         parts.append((H[:r] if return_usages else None, W[:r], err[:r]))
+        if want_telem:
+            tm = out[3]
+            telem_parts.append(SolverTelemetry(
+                trace=tm.trace[:r], iters=tm.iters[:r],
+                nonfinite=tm.nonfinite[:r]))
 
     if len(parts) == 1:
         usages_d, spectra_d, errs_d = parts[0]
@@ -724,6 +816,12 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
                     if return_usages else None)
         spectra_d = jnp.concatenate([p[1] for p in parts])
         errs_d = jnp.concatenate([p[2] for p in parts])
+
+    if want_telem:
+        telemetry_sink(_sweep_telemetry_payload(
+            k, beta, mode, seeds,
+            n_passes if mode == "online" else batch_max_iter,
+            _concat_telemetry(telem_parts), errs_d))
 
     if not fetch:
         return spectra_d, usages_d, errs_d

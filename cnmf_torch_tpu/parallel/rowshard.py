@@ -36,6 +36,7 @@ from .streaming import (
 
 from ..ops.nmf import (
     EPS,
+    TRACE_LEN,
     resolve_online_schedule,
     _apply_rate,
     mu_gamma,
@@ -279,53 +280,89 @@ def _rowsharded_pass(X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
 
 
 def _rowsharded_solve_local(X_local, H_local, W, axis, beta, tol, h_tol,
-                            n_passes, chunk_max_iter, l1_H, l2_H, l1_W, l2_W):
+                            n_passes, chunk_max_iter, l1_H, l2_H, l1_W, l2_W,
+                            telemetry: bool = False):
     """Per-device block-coordinate solve loop (runs inside ``shard_map``):
     passes of :func:`_rowsharded_pass` until the psum'd objective's relative
     improvement drops below ``tol`` or ``n_passes`` is reached. Shared by the
     1-D cells mesh (:func:`_fit_rowsharded_jit`) and the 2-D
     replicates x cells sweep (``multihost.replicate_sweep_2d``), so both
-    paths have identical solver semantics."""
+    paths have identical solver semantics.
+
+    ``telemetry`` (static; default off adds zero ops): additionally
+    returns ``(trace (TRACE_LEN,), passes (), nonfinite ())`` — the
+    per-pass psum'd objectives are replicated across shards, so the
+    telemetry leaves are too (``P()`` out-specs at the shard_map
+    boundary)."""
     def body(carry):
-        H_local, W, err_prev, err, it = carry
+        if telemetry:
+            H_local, W, err_prev, err, it, trace, nonfin = carry
+        else:
+            H_local, W, err_prev, err, it = carry
         H_local, W, err_new = _rowsharded_pass(
             X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
             l1_H, l2_H, l1_W, l2_W)
+        if telemetry:
+            # pass it+1's objective lands at 0-based slot it (slot 0 holds
+            # the first pass's err0 from the init below)
+            trace = trace.at[jnp.minimum(it, TRACE_LEN - 1)].set(err_new)
+            nonfin = nonfin | ~jnp.isfinite(err_new)
+            return (H_local, W, err, err_new, it + 1, trace, nonfin)
         return (H_local, W, err, err_new, it + 1)
 
     def cond(carry):
-        _, _, err_prev, err, it = carry
+        err_prev, err, it = carry[2], carry[3], carry[4]
         rel = (err_prev - err) / jnp.maximum(err_prev, EPS)
         return (it < n_passes) & ((it < 2) | (rel >= tol))
 
     H_local, W, err0 = _rowsharded_pass(
         X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
         l1_H, l2_H, l1_W, l2_W)
-    H_local, W, _, err, _ = jax.lax.while_loop(
-        cond, body,
-        (H_local, W, err0 * (1.0 + 2.0 * tol) + 1.0, err0, jnp.int32(1)))
+    init = (H_local, W, err0 * (1.0 + 2.0 * tol) + 1.0, err0, jnp.int32(1))
+    if telemetry:
+        init = init + (jnp.full((TRACE_LEN,), jnp.nan,
+                                jnp.float32).at[0].set(err0),
+                       ~jnp.isfinite(err0))
+    out = jax.lax.while_loop(cond, body, init)
+    if telemetry:
+        H_local, W, _, err, it, trace, nonfin = out
+        return H_local, W, err, trace, it, nonfin | ~jnp.isfinite(err)
+    H_local, W, _, err, _ = out
     return H_local, W, err
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "axis", "beta", "n_passes", "chunk_max_iter",
-                     "l1_H", "l2_H", "l1_W", "l2_W"),
+                     "l1_H", "l2_H", "l1_W", "l2_W", "telemetry"),
 )
 def _fit_rowsharded_jit(X, H0, W0, mesh, axis, beta, tol, h_tol, n_passes,
-                        chunk_max_iter, l1_H, l2_H, l1_W, l2_W):
+                        chunk_max_iter, l1_H, l2_H, l1_W, l2_W,
+                        telemetry: bool = False):
+    out_specs = ((P(axis, None), P(), P()) if not telemetry
+                 else (P(axis, None), P(), P(), P(), P(), P()))
+
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(P(axis, None), P(axis, None), P()),
-        out_specs=(P(axis, None), P(), P()),
+        out_specs=out_specs,
     )
     def run(X_local, H_local, W):
-        H_local, W, err = _rowsharded_solve_local(
+        out = _rowsharded_solve_local(
             X_local, H_local, W, axis, beta, tol, h_tol, n_passes,
-            chunk_max_iter, l1_H, l2_H, l1_W, l2_W)
+            chunk_max_iter, l1_H, l2_H, l1_W, l2_W, telemetry=telemetry)
+        if telemetry:
+            H_local, W, err, trace, passes, nonfin = out
+            return (H_local, W, err[None], trace, passes[None],
+                    nonfin[None])
+        H_local, W, err = out
         return H_local, W, err[None]
 
-    H, W, err = run(X, H0, W0)
+    out = run(X, H0, W0)
+    if telemetry:
+        H, W, err, trace, passes, nonfin = out
+        return H, W, err[0], trace, passes[0], nonfin[0]
+    H, W, err = out
     return H, W, err[0]
 
 
@@ -335,9 +372,15 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
                        chunk_max_iter: int = 1000,
                        alpha_W: float = 0.0, l1_ratio_W: float = 0.0,
                        alpha_H: float = 0.0, l1_ratio_H: float = 0.0,
-                       n_orig: int | None = None, init: str = "random"):
+                       n_orig: int | None = None, init: str = "random",
+                       telemetry_sink=None):
     """Factorize a cells-sharded X over ``mesh`` (1-D). Returns
     ``(H (n,k), W (k,g), err)`` as numpy arrays.
+
+    ``telemetry_sink``: optional callable receiving one convergence
+    record dict (per-pass objective trace, passes run, capped/nonfinite
+    flags) — active only under ``CNMF_TPU_TELEMETRY``; the telemetry-off
+    program is unchanged.
 
     ``X`` may be a host matrix (dense or CSR — streamed shard-by-shard to
     HBM without a host dense copy) or a device array already staged by
@@ -409,9 +452,23 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
     l1_W, l2_W = split_regularization(alpha_W, l1_ratio_W)
     l1_H, l2_H = split_regularization(alpha_H, l1_ratio_H)
 
-    H, W, err = _fit_rowsharded_jit(
+    want_telem = False
+    if telemetry_sink is not None:
+        from ..utils.telemetry import telemetry_enabled
+
+        want_telem = telemetry_enabled()
+    out = _fit_rowsharded_jit(
         Xd, H0, W0, mesh, axis, beta, jnp.float32(tol), jnp.float32(h_tol),
-        int(n_passes), int(chunk_max_iter), l1_H, l2_H, l1_W, l2_W)
+        int(n_passes), int(chunk_max_iter), l1_H, l2_H, l1_W, l2_W,
+        telemetry=want_telem)
+    H, W, err = out[:3]
+    if want_telem:
+        trace, passes, nonfin = out[3:]
+        telemetry_sink({
+            "k": int(k), "beta": float(beta), "mode": "rowshard",
+            "seeds": [int(seed)], "cap": int(n_passes), "cadence": "pass",
+            "trace": trace[None], "iters": passes[None],
+            "nonfinite": nonfin[None], "errs": err[None]})
     return (np.asarray(H)[:n_orig], np.asarray(W), float(err))
 
 

@@ -8,8 +8,18 @@ from .io import (
     save_df_to_text,
 )
 from .paths import build_paths
+from .telemetry import (
+    EventLog,
+    render_report,
+    telemetry_enabled,
+    validate_events_file,
+)
 
 __all__ = [
+    "EventLog",
+    "render_report",
+    "telemetry_enabled",
+    "validate_events_file",
     "AnnDataLite",
     "read_h5ad",
     "write_h5ad",

@@ -17,11 +17,23 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 import time
 
 __all__ = ["StageTimer", "trace", "PROFILE_ENV"]
 
 PROFILE_ENV = "CNMF_TPU_PROFILE_DIR"
+
+
+def _sanitize_field(v) -> str:
+    """TSV fields are single-line, tab-free by contract: meta values with
+    tabs/newlines used to shift every later column and corrupt positional
+    parsers (``bench.iter_stage_rows``)."""
+    s = str(v)
+    for ch in ("\t", "\n", "\r"):
+        if ch in s:
+            s = s.replace(ch, " ")
+    return s
 
 
 class StageTimer:
@@ -31,12 +43,21 @@ class StageTimer:
     concurrently, all recording into one TSV — records serialize under a
     lock (ADVICE r5 #4) so the header is written exactly once and rows
     never interleave mid-line (``bench.py:iter_stage_rows`` parses the
-    file positionally)."""
+    file positionally).
 
-    def __init__(self, timings_path: str | None):
-        import threading
+    ``events``: optional :class:`~cnmf_torch_tpu.utils.telemetry.EventLog`
+    — every recorded row is mirrored as a ``stage`` event, so the
+    structured stream carries the same walls/bytes as the TSV without a
+    second measurement site."""
 
+    # one warning per PROCESS when the ledger is unwritable: per-instance
+    # state would re-warn for every stats pass of a K-selection sweep
+    _oserror_warned = False
+    _oserror_lock = threading.Lock()
+
+    def __init__(self, timings_path: str | None, events=None):
         self.timings_path = timings_path
+        self.events = events
         self._lock = threading.Lock()
 
     @contextlib.contextmanager
@@ -64,9 +85,17 @@ class StageTimer:
 
     def _record(self, name: str, elapsed: float, err: str, meta: dict,
                 nbytes: int | None = None):
+        if self.events is not None:
+            self.events.emit("stage", stage=str(name),
+                             wall_s=round(float(elapsed), 6),
+                             nbytes=int(nbytes) if nbytes else None,
+                             error=err or None,
+                             meta={str(k): meta[k] for k in sorted(meta)}
+                             if meta else None)
         if self.timings_path is None:
             return
-        meta_str = ";".join(f"{k}={v}" for k, v in sorted(meta.items()))
+        meta_str = ";".join(f"{k}={_sanitize_field(v)}"
+                            for k, v in sorted(meta.items()))
         gbps = ("" if not nbytes or elapsed <= 0
                 else f"{nbytes / elapsed / 1e9:.3f}")
         try:
@@ -79,35 +108,51 @@ class StageTimer:
                         # columns [:2] positionally
                         f.write("stage\twall_seconds\tbytes\tgb_per_s\t"
                                 "timestamp\terror\tmeta\n")
-                    f.write(f"{name}\t{elapsed:.4f}\t"
+                    f.write(f"{_sanitize_field(name)}\t{elapsed:.4f}\t"
                             f"{nbytes if nbytes else ''}\t{gbps}\t"
-                            f"{time.time():.1f}\t{err}\t{meta_str}\n")
-        except OSError:
-            pass  # tracing must never take the pipeline down
+                            f"{time.time():.1f}\t{_sanitize_field(err)}\t"
+                            f"{meta_str}\n")
+        except OSError as exc:
+            # tracing must never take the pipeline down — but a silently
+            # missing ledger cost a round of debugging; warn once/process
+            with StageTimer._oserror_lock:
+                if not StageTimer._oserror_warned:
+                    StageTimer._oserror_warned = True
+                    import warnings
+
+                    warnings.warn(
+                        "StageTimer: cannot append to %r (%s); timing rows "
+                        "from this process will be dropped silently from "
+                        "here on" % (self.timings_path, exc),
+                        RuntimeWarning, stacklevel=3)
 
 
-_trace_active = False
+# One profiler session at a time is a JAX-level constraint; stages both
+# NEST in one thread (k_selection_plot -> consensus) and run CONCURRENTLY
+# across threads (up to 4 stats passes). A non-blocking lock serves both:
+# the first stage to acquire owns the session, every nested or concurrent
+# stage inside it is a no-op (nested device work is already captured by
+# the outer session; concurrent stages simply go untraced rather than
+# racing two `jax.profiler.trace` sessions open, which raises).
+_trace_lock = threading.Lock()
 
 
 @contextlib.contextmanager
 def trace(stage_name: str):
     """XLA profiler trace of a stage when CNMF_TPU_PROFILE_DIR is set.
 
-    Reentrant-safe: JAX allows only one active profiler session, and
-    pipeline stages nest (k_selection_plot calls consensus), so an inner
-    stage inside an active trace is a no-op — its device work is already
-    captured by the outer session.
+    Reentrant- and thread-safe: only one profiler session can exist, so
+    whichever stage acquires the (non-blocking) session lock first traces;
+    stages nested inside it or racing it from sibling threads no-op.
     """
-    global _trace_active
     profile_dir = os.environ.get(PROFILE_ENV)
-    if not profile_dir or _trace_active:
+    if not profile_dir or not _trace_lock.acquire(blocking=False):
         yield
         return
     import jax
 
-    _trace_active = True
     try:
         with jax.profiler.trace(os.path.join(profile_dir, stage_name)):
             yield
     finally:
-        _trace_active = False
+        _trace_lock.release()

@@ -1,0 +1,660 @@
+"""Run telemetry: structured event log, convergence records, memory
+watermarks, and the ``cnmf report`` renderer.
+
+The timings TSV (:mod:`.profiling`) answers "how long did each stage
+take"; it cannot answer "did the 900 replicates behind this consensus
+actually converge", "which dispatch path ran", or "how close to the HBM
+ceiling did staging push the device" — the questions MPI-FAUN-style
+per-phase instrumentation and the out-of-memory-NMF line of work
+(PAPERS.md) show are what make scaling decisions defensible. This module
+adds the missing ledger:
+
+  * :class:`EventLog` — append-only JSON-lines event stream at
+    ``<run_dir>/cnmf_tmp/<name>.events.jsonl`` with a versioned schema.
+    A run manifest (package/jax versions, devices, ``CNMF_*`` env knobs,
+    seed summary) is emitted once, automatically, before the first event.
+    Emission is a no-op unless ``CNMF_TPU_TELEMETRY=1`` — the pipeline
+    never changes behavior for users who didn't ask.
+  * Event types: ``manifest``, ``dispatch`` (dense-vs-ELL, packed vs
+    per-K, stream transport/depth, beta path), ``stage`` (the StageTimer
+    walls/bytes, mirrored), ``replicates`` (per-replicate solver
+    convergence records from the jitted sweeps), ``stream``
+    (:class:`~cnmf_torch_tpu.parallel.streaming.StreamStats` folded in),
+    and ``memory`` (device watermarks at stage boundaries).
+  * :func:`validate_event` / :func:`validate_events_file` — the ONE
+    schema definition, shared by tests and the tier-1 telemetry smoke
+    gate (``scripts/verify_tier1.sh``).
+  * :func:`render_report` — the ``cnmf-tpu report <run_dir>`` renderer:
+    stage waterfall, staging GB/s, per-K replicate convergence summary
+    (fraction capped, objective spread, nonfinite count), memory peaks.
+
+The solver-side half lives in ``ops/nmf.py`` (fixed-length objective
+traces threaded through the ``lax.while_loop`` carries, zero ops added
+when telemetry is off) and is aggregated per sweep by
+``parallel/replicates.py`` / ``parallel/rowshard.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = [
+    "TELEMETRY_ENV",
+    "SCHEMA_VERSION",
+    "EVENT_TYPES",
+    "telemetry_enabled",
+    "EventLog",
+    "device_memory_snapshot",
+    "device_memory_peak_bytes",
+    "validate_event",
+    "validate_events_file",
+    "read_events",
+    "summarize_events",
+    "render_report",
+]
+
+TELEMETRY_ENV = "CNMF_TPU_TELEMETRY"
+
+SCHEMA_VERSION = 1
+
+# required fields per event type, beyond the common {"v", "t", "ts"}.
+# This dict IS the schema: tests and the verify_tier1.sh smoke step
+# validate every emitted line against it.
+EVENT_TYPES = {
+    "manifest": {"package_version", "jax_version", "backend", "devices",
+                 "env"},
+    "dispatch": {"decision", "context"},
+    "stage": {"stage", "wall_s"},
+    "replicates": {"k", "beta", "records"},
+    "stream": {"context", "wall_s", "nbytes", "overlap_fraction"},
+    "memory": {"stage", "devices"},
+}
+
+# per-record required fields inside a "replicates" event's records list
+REPLICATE_RECORD_FIELDS = {"seed", "err", "iters", "capped", "nonfinite"}
+
+
+def telemetry_enabled() -> bool:
+    """True when ``CNMF_TPU_TELEMETRY`` is set to anything but 0/off.
+    Checked at every emission site, so tests (and long-lived processes)
+    can toggle it without rebuilding pipeline objects."""
+    return os.environ.get(TELEMETRY_ENV, "0").strip().lower() not in (
+        "", "0", "false", "off", "no")
+
+
+# ---------------------------------------------------------------------------
+# event log
+# ---------------------------------------------------------------------------
+
+def _jsonable(v):
+    """Coerce numpy scalars/arrays (the natural products of a fetched
+    sweep) into plain JSON types; anything else falls back to str."""
+    import numpy as np
+
+    if isinstance(v, (str, bool, int, float)) or v is None:
+        return v
+    if isinstance(v, (np.bool_,)):
+        return bool(v)
+    if isinstance(v, np.integer):
+        return int(v)
+    if isinstance(v, np.floating):
+        f = float(v)
+        return f if np.isfinite(f) else repr(f)
+    if isinstance(v, np.ndarray):
+        return [_jsonable(x) for x in v.tolist()]
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    return str(v)
+
+
+class _NanSafeEncoder(json.JSONEncoder):
+    """JSON-lines must stay machine-parseable: a diverged replicate's
+    ``inf``/``nan`` objective serializes as a string, not bare ``NaN``
+    (which ``json.dumps`` emits by default and strict parsers reject)."""
+
+    def iterencode(self, o, _one_shot=False):
+        import math
+
+        def scrub(v):
+            if isinstance(v, float) and not math.isfinite(v):
+                return repr(v)
+            if isinstance(v, dict):
+                return {k: scrub(x) for k, x in v.items()}
+            if isinstance(v, (list, tuple)):
+                return [scrub(x) for x in v]
+            return v
+
+        return super().iterencode(scrub(o), _one_shot)
+
+
+class EventLog:
+    """Thread-safe append-only JSONL event stream for one run.
+
+    Construction is free; nothing touches the filesystem until the first
+    :meth:`emit` with telemetry enabled. The manifest is emitted once per
+    EventLog instance, before any other event, so a factorize-only worker
+    still produces a self-describing file.
+    """
+
+    def __init__(self, path: str | None, manifest_extra: dict | None = None):
+        self.path = path
+        self._lock = threading.Lock()
+        self._manifest_done = False
+        self._manifest_extra = dict(manifest_extra or {})
+        self._write_failed = False
+
+    def set_manifest_extra(self, **fields):
+        """Merge run-level manifest fields (seed summary, ledger Ks) known
+        only after construction; effective until the manifest is written."""
+        with self._lock:
+            self._manifest_extra.update(fields)
+
+    @property
+    def enabled(self) -> bool:
+        return self.path is not None and telemetry_enabled()
+
+    def emit(self, event_type: str, **fields):
+        """Append one event (no-op unless enabled). Never raises: telemetry
+        must not take the pipeline down."""
+        if not self.enabled:
+            return
+        try:
+            with self._lock:
+                if not self._manifest_done and event_type != "manifest":
+                    self._manifest_done = True
+                    self._write_line(self._build_manifest())
+                elif event_type == "manifest":
+                    self._manifest_done = True
+                self._write_line(self._event(event_type, fields))
+        except Exception:
+            if not self._write_failed:
+                self._write_failed = True
+                import warnings
+
+                warnings.warn(
+                    "telemetry: failed to append to %r; further events "
+                    "from this log are dropped silently" % (self.path,),
+                    RuntimeWarning, stacklevel=2)
+
+    def emit_memory(self, stage: str):
+        """Device-memory watermark event at a stage boundary."""
+        if not self.enabled:
+            return
+        self.emit("memory", stage=stage, devices=device_memory_snapshot())
+
+    def emit_stream(self, context: str, stats):
+        """Fold one ``StreamStats`` into the event stream."""
+        if not self.enabled or stats is None:
+            return
+        self.emit(
+            "stream", context=context, wall_s=round(stats.wall_s, 4),
+            host_prep_s=round(stats.host_prep_s, 4),
+            h2d_s=round(stats.h2d_s, 4),
+            device_s=round(stats.device_s, 4),
+            nbytes=int(stats.nbytes), slabs=int(stats.slabs),
+            gb_per_s=round(stats.gb_per_s(), 3),
+            overlap_fraction=round(stats.overlap_fraction, 3))
+
+    # -- internals -----------------------------------------------------
+
+    def _event(self, event_type: str, fields: dict) -> dict:
+        ev = {"v": SCHEMA_VERSION, "t": event_type, "ts": round(time.time(), 3)}
+        # None-valued fields are omitted (absent == not measured): keeps
+        # the stream compact and the schema's required-field check honest
+        ev.update({k: _jsonable(v) for k, v in fields.items()
+                   if v is not None})
+        return ev
+
+    def _build_manifest(self) -> dict:
+        return self._event("manifest", dict(_manifest_fields(),
+                                            **self._manifest_extra))
+
+    def _write_line(self, ev: dict):
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        line = json.dumps(ev, cls=_NanSafeEncoder,
+                          separators=(",", ":")) + "\n"
+        # one os.write per line on an O_APPEND fd: run_parallel workers in
+        # separate processes append to the SAME file, and buffered text
+        # mode flushes a large (multi-KB `replicates`) line as several
+        # write() syscalls — concurrent writers would tear lines mid-JSON.
+        # A single write() to an O_APPEND regular file does not interleave.
+        fd = os.open(self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                     0o644)
+        try:
+            os.write(fd, line.encode("utf-8"))
+        finally:
+            os.close(fd)
+
+
+def _manifest_fields() -> dict:
+    """Versions, device inventory, and the env knobs that steer dispatch —
+    everything needed to interpret (or reproduce) the rest of the stream."""
+    try:
+        from ..version import __version__ as pkg_version
+    except Exception:
+        pkg_version = "unknown"
+    fields = {"package_version": pkg_version}
+    try:
+        import jax
+
+        fields["jax_version"] = jax.__version__
+        devs = jax.local_devices()
+        fields["backend"] = devs[0].platform if devs else "none"
+        fields["devices"] = [
+            {"id": int(d.id), "platform": d.platform,
+             "kind": getattr(d, "device_kind", "")} for d in devs]
+        fields["process_count"] = int(jax.process_count())
+    except Exception:
+        fields.setdefault("jax_version", "unavailable")
+        fields.setdefault("backend", "unavailable")
+        fields.setdefault("devices", [])
+    fields["env"] = {
+        k: v for k, v in sorted(os.environ.items())
+        if k.startswith(("CNMF_", "JAX_")) or k == "XLA_FLAGS"}
+    return fields
+
+
+# ---------------------------------------------------------------------------
+# device-memory watermarks
+# ---------------------------------------------------------------------------
+
+def device_memory_snapshot() -> list[dict]:
+    """Per-device memory watermarks where the runtime exposes them
+    (``device.memory_stats()`` — empty on CPU and some tunneled backends),
+    plus this process's live-buffer bytes from ``jax.live_arrays()`` as the
+    backend-independent fallback signal."""
+    out = []
+    try:
+        import jax
+
+        live_by_dev: dict = {}
+        try:
+            for arr in jax.live_arrays():
+                for s in arr.addressable_shards:
+                    live_by_dev[s.device.id] = (
+                        live_by_dev.get(s.device.id, 0)
+                        + int(s.data.nbytes))
+        except Exception:
+            pass
+        for d in jax.local_devices():
+            ent = {"id": int(d.id), "platform": d.platform,
+                   "live_buffer_bytes": int(live_by_dev.get(d.id, 0))}
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:
+                stats = {}
+            for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                        "largest_alloc_size"):
+                if key in stats:
+                    ent[key] = int(stats[key])
+            out.append(ent)
+    except Exception:
+        pass
+    return out
+
+
+def device_memory_peak_bytes() -> int:
+    """Max peak (or current) device bytes across local devices; falls back
+    to the live-buffer sum when the backend reports no memory stats."""
+    peak = 0
+    for ent in device_memory_snapshot():
+        peak = max(peak, ent.get("peak_bytes_in_use",
+                                 ent.get("bytes_in_use",
+                                         ent.get("live_buffer_bytes", 0))))
+    return int(peak)
+
+
+def replicate_records(payload) -> list[dict]:
+    """The ONE payload->records conversion: turn a sweep telemetry payload
+    (``parallel.replicates._sweep_telemetry_payload`` — array values may be
+    device arrays) into the schema's per-replicate record list
+    (:data:`REPLICATE_RECORD_FIELDS`). Shared by the pipeline's event
+    emission (``models/cnmf.py``) and bench's convergence summaries, so the
+    capped/nonfinite semantics cannot drift between producers."""
+    import numpy as np
+
+    trace = np.asarray(payload["trace"])
+    iters = np.asarray(payload["iters"])
+    nonfin = np.asarray(payload["nonfinite"])
+    errs = np.asarray(payload["errs"])
+    cap = int(payload["cap"])
+    records = []
+    for i, seed in enumerate(payload["seeds"]):
+        tr = trace[i]
+        records.append({
+            "seed": int(seed),
+            "err": float(errs[i]),
+            "iters": int(iters[i]),
+            "capped": bool(iters[i] >= cap),
+            "nonfinite": bool(nonfin[i]),
+            # NaN marks never-evaluated slots; what remains is the
+            # objective trajectory at the solver's evaluation cadence
+            "trace": [float(v) for v in tr[~np.isnan(tr)]],
+        })
+    return records
+
+
+# ---------------------------------------------------------------------------
+# schema validation (shared by tests and the tier-1 smoke gate)
+# ---------------------------------------------------------------------------
+
+def validate_event(ev: dict) -> None:
+    """Raise ``ValueError`` unless ``ev`` is a schema-valid event."""
+    if not isinstance(ev, dict):
+        raise ValueError(f"event is not an object: {type(ev).__name__}")
+    for field in ("v", "t", "ts"):
+        if field not in ev:
+            raise ValueError(f"event missing required field {field!r}: {ev}")
+    if ev["v"] != SCHEMA_VERSION:
+        raise ValueError(
+            f"unknown schema version {ev['v']!r} (this build understands "
+            f"{SCHEMA_VERSION})")
+    t = ev["t"]
+    if t not in EVENT_TYPES:
+        raise ValueError(f"unknown event type {t!r}")
+    if not isinstance(ev["ts"], (int, float)):
+        raise ValueError(f"ts must be numeric, got {ev['ts']!r}")
+    missing = EVENT_TYPES[t] - set(ev)
+    if missing:
+        raise ValueError(
+            f"{t} event missing required fields {sorted(missing)}: {ev}")
+    if t == "replicates":
+        if not isinstance(ev["records"], list):
+            raise ValueError("replicates.records must be a list")
+        for rec in ev["records"]:
+            rmissing = REPLICATE_RECORD_FIELDS - set(rec)
+            if rmissing:
+                raise ValueError(
+                    f"replicate record missing {sorted(rmissing)}: {rec}")
+    if t == "memory" and not isinstance(ev["devices"], list):
+        raise ValueError("memory.devices must be a list")
+
+
+def validate_events_file(path: str) -> int:
+    """Validate every line of an events.jsonl; returns the event count.
+    The FIRST event must be a manifest (self-describing stream)."""
+    count = 0
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError as exc:
+                raise ValueError(f"{path}:{lineno}: not valid JSON: {exc}")
+            try:
+                validate_event(ev)
+            except ValueError as exc:
+                raise ValueError(f"{path}:{lineno}: {exc}")
+            if count == 0 and ev["t"] != "manifest":
+                raise ValueError(
+                    f"{path}:1: first event must be the manifest, "
+                    f"got {ev['t']!r}")
+            count += 1
+    return count
+
+
+def read_events(path: str) -> list[dict]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# report
+# ---------------------------------------------------------------------------
+
+def _find_event_files(run_dir: str) -> list[str]:
+    tmp = os.path.join(run_dir, "cnmf_tmp")
+    if not os.path.isdir(tmp):
+        return []
+    return sorted(os.path.join(tmp, fn) for fn in os.listdir(tmp)
+                  if fn.endswith(".events.jsonl"))
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024.0 or unit == "TB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} TB"
+
+
+def summarize_events(events: list[dict]) -> dict:
+    """Aggregate an event stream into the report's (and bench's) summary:
+    stage walls, staging throughput, per-K convergence, memory peaks."""
+    import math
+
+    summary: dict = {"n_events": len(events)}
+    manifest = next((e for e in events if e["t"] == "manifest"), None)
+    if manifest:
+        summary["manifest"] = {
+            "package_version": manifest.get("package_version"),
+            "jax_version": manifest.get("jax_version"),
+            "backend": manifest.get("backend"),
+            "n_devices": len(manifest.get("devices") or []),
+        }
+    summary["dispatch"] = [
+        {k: e[k] for k in ("decision", "context") if k in e}
+        for e in events if e["t"] == "dispatch"]
+
+    stages: dict = {}
+    for e in events:
+        if e["t"] != "stage":
+            continue
+        ent = stages.setdefault(e["stage"], {"wall_s": 0.0, "nbytes": 0,
+                                             "count": 0})
+        ent["wall_s"] += float(e.get("wall_s", 0.0))
+        ent["nbytes"] += int(e.get("nbytes") or 0)
+        ent["count"] += 1
+    summary["stages"] = {
+        name: {"wall_s": round(v["wall_s"], 4), "nbytes": v["nbytes"],
+               "count": v["count"]}
+        for name, v in stages.items()}
+
+    streams = [e for e in events if e["t"] == "stream"]
+    if streams:
+        summary["streaming"] = [
+            {"context": e["context"], "wall_s": e["wall_s"],
+             "nbytes": e["nbytes"], "gb_per_s": e.get("gb_per_s"),
+             "overlap_fraction": e.get("overlap_fraction")}
+            for e in streams]
+
+    conv: dict = {}
+    for e in events:
+        if e["t"] != "replicates":
+            continue
+        k = int(e["k"])
+        ent = conv.setdefault(k, {"n": 0, "capped": 0, "nonfinite": 0,
+                                  "errs": [], "iters": []})
+        for rec in e["records"]:
+            ent["n"] += 1
+            ent["capped"] += bool(rec.get("capped"))
+            ent["nonfinite"] += bool(rec.get("nonfinite"))
+            err = rec.get("err")
+            if isinstance(err, (int, float)) and math.isfinite(err):
+                ent["errs"].append(float(err))
+            ent["iters"].append(int(rec.get("iters", 0)))
+    convergence = {}
+    for k, ent in sorted(conv.items()):
+        errs = ent["errs"]
+        row = {"replicates": ent["n"],
+               "fraction_capped": round(ent["capped"] / max(ent["n"], 1), 4),
+               "nonfinite": ent["nonfinite"],
+               "mean_iters": round(sum(ent["iters"])
+                                   / max(len(ent["iters"]), 1), 1)}
+        if errs:
+            lo, hi = min(errs), max(errs)
+            med = sorted(errs)[len(errs) // 2]
+            row.update(err_min=round(lo, 6), err_median=round(med, 6),
+                       err_max=round(hi, 6),
+                       err_rel_spread=round((hi - lo) / abs(med), 6)
+                       if med else None)
+        convergence[str(k)] = row
+    if convergence:
+        summary["convergence"] = convergence
+
+    mem_peak = 0
+    mem_stage = None
+    for e in events:
+        if e["t"] != "memory":
+            continue
+        for dev in e.get("devices", []):
+            b = dev.get("peak_bytes_in_use",
+                        dev.get("bytes_in_use",
+                                dev.get("live_buffer_bytes", 0)))
+            if b and b > mem_peak:
+                mem_peak, mem_stage = int(b), e.get("stage")
+    if mem_peak:
+        summary["memory_peak_bytes"] = mem_peak
+        summary["memory_peak_stage"] = mem_stage
+    return summary
+
+
+def render_report(run_dir: str) -> str:
+    """Human-readable run report from a run directory's telemetry (events
+    JSONL preferred; the timings TSV alone still yields a stage table)."""
+    lines: list[str] = []
+    run_dir = run_dir.rstrip(os.sep)
+    lines.append(f"cNMF run report — {run_dir}")
+    lines.append("=" * min(78, len(lines[0])))
+
+    event_files = _find_event_files(run_dir)
+    events: list[dict] = []
+    for path in event_files:
+        events.extend(read_events(path))
+    if not events:
+        tsvs = []
+        tmp = os.path.join(run_dir, "cnmf_tmp")
+        if os.path.isdir(tmp):
+            tsvs = [os.path.join(tmp, fn) for fn in sorted(os.listdir(tmp))
+                    if fn.endswith(".timings.tsv")]
+        if not tsvs:
+            lines.append("no telemetry found (run with CNMF_TPU_TELEMETRY=1 "
+                         "to produce an events.jsonl; no timings TSV either)")
+            return "\n".join(lines)
+        lines.append("no events.jsonl (telemetry was off) — stage walls "
+                     "from the timings TSV:")
+        stages: dict = {}
+        for path in tsvs:
+            with open(path) as f:
+                next(f, None)
+                for line in f:
+                    parts = line.rstrip("\n").split("\t")
+                    if len(parts) >= 2:
+                        try:
+                            stages[parts[0]] = (stages.get(parts[0], 0.0)
+                                                + float(parts[1]))
+                        except ValueError:
+                            pass
+        lines.extend(_stage_waterfall(
+            {k: {"wall_s": v, "nbytes": 0, "count": 1}
+             for k, v in stages.items()}))
+        return "\n".join(lines)
+
+    summary = summarize_events(events)
+
+    man = summary.get("manifest")
+    if man:
+        lines.append("")
+        lines.append("Manifest")
+        lines.append("-" * 8)
+        lines.append(
+            f"  package {man.get('package_version')}   "
+            f"jax {man.get('jax_version')}   backend {man.get('backend')} "
+            f"({man.get('n_devices')} device(s))")
+
+    if summary.get("dispatch"):
+        lines.append("")
+        lines.append("Dispatch decisions")
+        lines.append("-" * 18)
+        for d in summary["dispatch"]:
+            ctx = d.get("context", {})
+            ctx_str = "  ".join(f"{k}={v}" for k, v in ctx.items()) \
+                if isinstance(ctx, dict) else str(ctx)
+            lines.append(f"  {d.get('decision')}: {ctx_str}")
+
+    lines.append("")
+    lines.append("Stage waterfall")
+    lines.append("-" * 15)
+    lines.extend(_stage_waterfall(summary.get("stages", {})))
+
+    if summary.get("streaming"):
+        lines.append("")
+        lines.append("Host->device staging")
+        lines.append("-" * 20)
+        for s in summary["streaming"]:
+            gbps = s.get("gb_per_s")
+            lines.append(
+                f"  {s['context']:<32s} {s['wall_s']:>8.3f} s  "
+                f"{_fmt_bytes(s['nbytes']):>10s}  "
+                f"{(f'{gbps:.2f} GB/s' if gbps is not None else ''):>11s}  "
+                f"overlap {s.get('overlap_fraction', 0):.2f}")
+
+    if summary.get("convergence"):
+        lines.append("")
+        lines.append("Replicate convergence (per K)")
+        lines.append("-" * 29)
+        lines.append(f"  {'K':>4s} {'reps':>6s} {'capped':>8s} "
+                     f"{'nonfin':>7s} {'mean it':>8s} {'err median':>12s} "
+                     f"{'rel spread':>11s}")
+        for k, row in summary["convergence"].items():
+            med = row.get("err_median")
+            spread = row.get("err_rel_spread")
+            lines.append(
+                f"  {k:>4s} {row['replicates']:>6d} "
+                f"{row['fraction_capped']:>7.1%} "
+                f"{row['nonfinite']:>7d} {row['mean_iters']:>8.1f} "
+                f"{(f'{med:.5g}' if med is not None else '-'):>12s} "
+                f"{(f'{spread:.2e}' if spread is not None else '-'):>11s}")
+
+    lines.append("")
+    lines.append("Device memory")
+    lines.append("-" * 13)
+    if summary.get("memory_peak_bytes"):
+        lines.append(
+            f"  peak {_fmt_bytes(summary['memory_peak_bytes'])} "
+            f"(at stage boundary: {summary.get('memory_peak_stage')})")
+    else:
+        lines.append("  no memory watermarks recorded (backend reports no "
+                     "memory stats and no live buffers were sampled)")
+    lines.append("")
+    lines.append(f"{summary['n_events']} events across "
+                 f"{len(event_files)} file(s)")
+    return "\n".join(lines)
+
+
+def _stage_waterfall(stages: dict) -> list[str]:
+    if not stages:
+        return ["  (no stage events)"]
+    # top-level pipeline stages first, sub-stages (dotted/slashed) under
+    top = {k: v for k, v in stages.items() if "." not in k and "/" not in k}
+    total = sum(v["wall_s"] for v in top.values()) or \
+        sum(v["wall_s"] for v in stages.values())
+    width = 32
+    out = []
+    for name, v in sorted(stages.items(),
+                          key=lambda kv: -kv[1]["wall_s"]):
+        frac = v["wall_s"] / total if total > 0 else 0.0
+        bar = "#" * max(1, int(round(min(frac, 1.0) * width))) \
+            if v["wall_s"] > 0 else ""
+        extra = ""
+        if v.get("nbytes"):
+            gbps = v["nbytes"] / v["wall_s"] / 1e9 if v["wall_s"] > 0 else 0
+            extra = f"  {_fmt_bytes(v['nbytes'])} ({gbps:.2f} GB/s)"
+        out.append(f"  {name:<36s} {v['wall_s']:>9.3f} s  "
+                   f"{bar:<{width}s}{extra}")
+    return out

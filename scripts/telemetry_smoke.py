@@ -1,0 +1,78 @@
+"""Tier-1 telemetry smoke gate (scripts/verify_tier1.sh).
+
+Runs the mini pipeline with CNMF_TPU_TELEMETRY=1 and validates EVERY
+emitted event against the schema (utils/telemetry.py — the one schema
+definition), then renders the `cnmf report` view. Exits nonzero on any
+malformed event, missing event class, or report failure, failing the gate.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+
+# runnable as `python scripts/telemetry_smoke.py` without installing the
+# package: sys.path[0] is scripts/, the package lives one level up
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["CNMF_TPU_TELEMETRY"] = "1"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    import numpy as np
+    import pandas as pd
+
+    from cnmf_torch_tpu import cNMF
+    from cnmf_torch_tpu.cli import main as cli_main
+    from cnmf_torch_tpu.utils import save_df_to_npz
+    from cnmf_torch_tpu.utils.telemetry import (read_events,
+                                                validate_events_file)
+
+    workdir = tempfile.mkdtemp(prefix="telemetry_smoke_")
+    try:
+        rng = np.random.default_rng(3)
+        usage = rng.dirichlet(np.ones(5) * 0.3, size=220)
+        spectra = rng.gamma(0.3, 1.0, size=(5, 130)) * 40.0 / 130
+        counts = rng.poisson(usage @ spectra * 300.0).astype(np.float64)
+        counts[counts.sum(axis=1) == 0, 0] = 1.0
+        df = pd.DataFrame(counts, index=[f"c{i}" for i in range(220)],
+                          columns=[f"g{j}" for j in range(130)])
+        counts_fn = os.path.join(workdir, "counts.df.npz")
+        save_df_to_npz(df, counts_fn)
+
+        obj = cNMF(output_dir=workdir, name="smoke")
+        obj.prepare(counts_fn, components=[3, 4], n_iter=10, seed=7,
+                    num_highvar_genes=100)
+        obj.factorize()
+        obj.combine()
+        obj.consensus(k=3, density_threshold=2.0, show_clustering=False)
+
+        ev_path = os.path.join(workdir, "smoke", "cnmf_tmp",
+                               "smoke.events.jsonl")
+        n = validate_events_file(ev_path)  # raises on any malformed line
+        counts_by_type: dict = {}
+        for ev in read_events(ev_path):
+            counts_by_type[ev["t"]] = counts_by_type.get(ev["t"], 0) + 1
+        required = {"manifest": 1, "dispatch": 1, "stage": 3,
+                    "replicates": 2, "memory": 1}
+        for t, minimum in required.items():
+            if counts_by_type.get(t, 0) < minimum:
+                print(f"telemetry smoke: expected >= {minimum} {t!r} "
+                      f"event(s), got {counts_by_type.get(t, 0)} "
+                      f"(all: {counts_by_type})", file=sys.stderr)
+                return 1
+
+        # the report CLI must render the stream without error
+        cli_main(["report", os.path.join(workdir, "smoke")])
+        print(f"telemetry smoke: {n} schema-valid events "
+              f"({counts_by_type}); report rendered")
+        return 0
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,4 +26,18 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
   -p no:randomly 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)
+
+# telemetry smoke: run the mini pipeline with telemetry enabled and
+# validate every emitted event line against the schema
+# (scripts/telemetry_smoke.py) — malformed events fail the gate
+if [ "$rc" -eq 0 ]; then
+  echo "[tier1] telemetry smoke (schema-validated events.jsonl) ..."
+  if timeout -k 10 300 env JAX_PLATFORMS=cpu CNMF_TPU_TELEMETRY=1 \
+      python scripts/telemetry_smoke.py; then
+    echo TELEMETRY_SMOKE=ok
+  else
+    echo TELEMETRY_SMOKE=fail
+    exit 1
+  fi
+fi
 exit $rc

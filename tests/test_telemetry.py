@@ -1,0 +1,457 @@
+"""Run telemetry subsystem (ISSUE 4): event schema, solver convergence
+traces, sweep aggregation, report rendering, and the profiling satellites
+(StageTimer sanitization/warn-once, trace() thread safety)."""
+
+import contextlib
+import json
+import os
+import threading
+import time
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from cnmf_torch_tpu.ops.nmf import (
+    EVAL_EVERY,
+    TRACE_LEN,
+    _update_H,
+    _update_W,
+    beta_divergence,
+    nmf_fit_batch,
+    nmf_fit_batch_bundled,
+    nmf_fit_online,
+    random_init,
+    _chunk_rows,
+)
+from cnmf_torch_tpu.utils import telemetry as tel
+from cnmf_torch_tpu.utils.profiling import StageTimer, trace
+
+
+@pytest.fixture()
+def small_problem():
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.random((60, 40), np.float32))
+    H0, W0 = random_init(jax.random.key(1), 60, 40, 4, jnp.mean(X))
+    return X, H0, W0
+
+
+# ---------------------------------------------------------------------------
+# solver convergence traces
+# ---------------------------------------------------------------------------
+
+class TestSolverTraces:
+    def test_batch_trace_matches_independent_objectives(self, small_problem):
+        """The trace entries ARE the objectives of the factor iterates:
+        re-run the identical MU updates step by step outside the
+        while_loop and compare at every EVAL_EVERY point (f32 tolerance —
+        the acceptance bar; on CPU the values typically bit-match)."""
+        X, H0, W0 = small_problem
+        n_iters = 4 * EVAL_EVERY
+        _, _, err, tm = nmf_fit_batch(X, H0, W0, beta=2.0, tol=0.0,
+                                      max_iter=n_iters, telemetry=True)
+        trace_vals = np.asarray(tm.trace)
+
+        H, W = H0, W0
+        expected = []
+        for i in range(1, n_iters + 1):
+            H = _update_H(X, H, W, 2.0, 0.0, 0.0)
+            W = _update_W(X, H, W, 2.0, 0.0, 0.0)
+            if i % EVAL_EVERY == 0:
+                expected.append(float(beta_divergence(X, H, W, beta=2.0)))
+        np.testing.assert_allclose(trace_vals[:len(expected)], expected,
+                                   rtol=1e-5)
+        # slots past the last evaluation stay NaN (never-evaluated marker)
+        assert np.isnan(trace_vals[len(expected):]).all()
+        # the returned err is the final recompute of the same iterate
+        np.testing.assert_allclose(float(err), expected[-1], rtol=1e-5)
+        assert not bool(tm.nonfinite)
+
+    def test_capped_vs_converged_flags(self, small_problem):
+        X, H0, W0 = small_problem
+        cap = 2 * EVAL_EVERY
+        # tol=0 can never satisfy the relative-decrease stop -> capped
+        _, _, _, tm_cap = nmf_fit_batch(X, H0, W0, beta=2.0, tol=0.0,
+                                        max_iter=cap, telemetry=True)
+        assert int(tm_cap.iters) == cap  # capped: iters == max_iter
+        # a huge tol converges at the first evaluation window
+        _, _, _, tm_conv = nmf_fit_batch(X, H0, W0, beta=2.0, tol=10.0,
+                                         max_iter=500, telemetry=True)
+        assert int(tm_conv.iters) < 500  # converged before the cap
+
+    def test_vmapped_iters_are_per_replicate(self, small_problem):
+        """Under vmap the batched while_loop steps every lane until the
+        last converges; iters must still reflect each lane's OWN stop."""
+        X, H0, W0 = small_problem
+        Hs = jnp.stack([H0, H0 * 2.0, H0 * 0.25])
+        Ws = jnp.stack([W0, W0 * 0.5, W0 * 3.0])
+        solve = jax.jit(jax.vmap(
+            lambda h, w: nmf_fit_batch(X, h, w, beta=2.0, tol=1e-3,
+                                       max_iter=400, telemetry=True),
+            in_axes=(0, 0)))
+        _, _, errs, tm = solve(Hs, Ws)
+        iters = np.asarray(tm.iters)
+        assert tm.trace.shape == (3, TRACE_LEN)
+        assert (iters > 0).all() and (iters <= 400).all()
+        # bundled solver agrees on results and telemetry shape
+        _, _, errs_b, tm_b = nmf_fit_batch_bundled(
+            X, Hs, Ws, tol=1e-3, max_iter=400, telemetry=True)
+        np.testing.assert_allclose(np.asarray(errs_b), np.asarray(errs),
+                                   rtol=1e-4)
+        assert tm_b.trace.shape == (3, TRACE_LEN)
+        np.testing.assert_array_equal(np.asarray(tm_b.iters), iters)
+
+    def test_online_trace_records_passes(self, small_problem):
+        X, H0, W0 = small_problem
+        Xc, Hc, _ = _chunk_rows(X, H0, 30)
+        out = nmf_fit_online(Xc, Hc, W0, beta=2.0, tol=1e-4, h_tol=3e-3,
+                             n_passes=20)
+        assert len(out) == 3  # default path unchanged
+        _, _, err, tm = nmf_fit_online(Xc, Hc, W0, beta=2.0, tol=1e-4,
+                                       h_tol=3e-3, n_passes=20,
+                                       telemetry=True)
+        passes = int(tm.iters)
+        tr = np.asarray(tm.trace)
+        assert 1 <= passes <= 20
+        assert np.isfinite(tr[:passes]).all()
+        assert np.isnan(tr[passes:]).all()
+        # per-pass objectives are non-increasing after the first pass
+        assert (np.diff(tr[:passes]) <= 1e-3 * tr[0]).all()
+        assert not bool(tm.nonfinite)
+
+    def test_telemetry_off_returns_three_outputs(self, small_problem):
+        """The disabled path must not grow outputs (no extra device
+        transfers) — telemetry is a static flag, not a runtime branch."""
+        X, H0, W0 = small_problem
+        assert len(nmf_fit_batch(X, H0, W0, beta=2.0)) == 3
+        assert len(nmf_fit_batch_bundled(X, jnp.stack([H0]),
+                                         jnp.stack([W0]))) == 3
+
+
+# ---------------------------------------------------------------------------
+# sweep aggregation
+# ---------------------------------------------------------------------------
+
+class TestSweepTelemetry:
+    def test_sink_receives_per_replicate_records(self, monkeypatch):
+        from cnmf_torch_tpu.parallel import replicate_sweep
+
+        monkeypatch.setenv(tel.TELEMETRY_ENV, "1")
+        rng = np.random.default_rng(1)
+        X = rng.random((90, 40)).astype(np.float32)
+        got = []
+        spectra, _, errs = replicate_sweep(
+            X, [11, 12, 13], 3, mode="online", online_chunk_size=45,
+            telemetry_sink=got.append)
+        assert len(got) == 1
+        pay = got[0]
+        assert pay["k"] == 3 and pay["seeds"] == [11, 12, 13]
+        assert np.asarray(pay["trace"]).shape == (3, TRACE_LEN)
+        np.testing.assert_allclose(np.asarray(pay["errs"]), errs)
+        iters = np.asarray(pay["iters"])
+        assert (iters >= 1).all() and (iters <= pay["cap"]).all()
+
+    def test_sink_not_called_when_disabled(self, monkeypatch):
+        from cnmf_torch_tpu.parallel import replicate_sweep
+
+        monkeypatch.delenv(tel.TELEMETRY_ENV, raising=False)
+        rng = np.random.default_rng(1)
+        X = rng.random((90, 40)).astype(np.float32)
+        got = []
+        replicate_sweep(X, [11, 12], 3, mode="online", online_chunk_size=45,
+                        telemetry_sink=got.append)
+        assert got == []
+
+    def test_rowsharded_solver_telemetry(self, monkeypatch):
+        from jax.sharding import Mesh
+
+        from cnmf_torch_tpu.parallel.rowshard import nmf_fit_rowsharded
+
+        monkeypatch.setenv(tel.TELEMETRY_ENV, "1")
+        rng = np.random.default_rng(2)
+        X = rng.random((96, 30)).astype(np.float32)
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("cells",))
+        got = []
+        _, _, err = nmf_fit_rowsharded(X, 3, mesh, seed=5,
+                                       telemetry_sink=got.append)
+        assert len(got) == 1
+        pay = got[0]
+        tr = np.asarray(pay["trace"])
+        assert tr.shape == (1, TRACE_LEN)
+        passes = int(np.asarray(pay["iters"])[0])
+        assert 1 <= passes <= pay["cap"]
+        assert np.isfinite(tr[0, :passes]).all()
+        # the last recorded pass objective is the returned err
+        np.testing.assert_allclose(tr[0, passes - 1], err, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# event log + schema + pipeline integration + report
+# ---------------------------------------------------------------------------
+
+def _mini_counts(n=200, g=120, seed=3):
+    rng = np.random.default_rng(seed)
+    usage = rng.dirichlet(np.ones(5) * 0.3, size=n)
+    spectra = rng.gamma(0.3, 1.0, size=(5, g)) * 40.0 / g
+    counts = rng.poisson(usage @ spectra * 300.0).astype(np.float64)
+    counts[counts.sum(axis=1) == 0, 0] = 1.0
+    return pd.DataFrame(counts, index=[f"c{i}" for i in range(n)],
+                        columns=[f"g{j}" for j in range(g)])
+
+
+class TestEventsAndReport:
+    def test_pipeline_emits_schema_valid_events(self, tmp_path, monkeypatch):
+        from cnmf_torch_tpu import cNMF
+        from cnmf_torch_tpu.utils import save_df_to_npz
+
+        monkeypatch.setenv(tel.TELEMETRY_ENV, "1")
+        counts_fn = str(tmp_path / "counts.df.npz")
+        save_df_to_npz(_mini_counts(), counts_fn)
+        obj = cNMF(output_dir=str(tmp_path), name="ev")
+        obj.prepare(counts_fn, components=[3, 4], n_iter=4, seed=7,
+                    num_highvar_genes=80)
+        obj.factorize()
+        obj.combine()
+
+        ev_path = tmp_path / "ev" / "cnmf_tmp" / "ev.events.jsonl"
+        assert ev_path.exists()
+        n = tel.validate_events_file(str(ev_path))
+        events = tel.read_events(str(ev_path))
+        assert n == len(events)
+        by_type = {}
+        for e in events:
+            by_type.setdefault(e["t"], []).append(e)
+
+        # manifest: first event, complete, self-describing
+        assert events[0]["t"] == "manifest"
+        man = by_type["manifest"][0]
+        assert len(by_type["manifest"]) == 1
+        assert man["jax_version"] == jax.__version__
+        assert man["backend"] == "cpu"
+        assert isinstance(man["devices"], list) and man["devices"]
+        assert man["env"].get(tel.TELEMETRY_ENV) == "1"
+        assert man["ledger"]["ks"] == [3, 4]
+        assert man["ledger"]["n_tasks"] == 8
+        assert "seed_min" in man["ledger"]
+
+        # dispatch: the engaged solver path is recorded
+        decisions = {d["decision"] for d in by_type["dispatch"]}
+        assert "solver_path" in decisions
+        solver = [d for d in by_type["dispatch"]
+                  if d["decision"] == "solver_path"][0]
+        assert solver["context"]["engaged_path"] in (
+            "batched", "batched-packed", "batched-ell")
+
+        # per-stage events and replicate convergence records per K
+        assert {e["stage"] for e in by_type["stage"]} >= {"prepare",
+                                                          "factorize",
+                                                          "combine"}
+        reps = by_type["replicates"]
+        assert {int(e["k"]) for e in reps} == {3, 4}
+        for e in reps:
+            assert len(e["records"]) == 4
+            for rec in e["records"]:
+                assert rec["iters"] >= 1
+                assert isinstance(rec["capped"], bool)
+                assert rec["trace"], "objective trace must be non-empty"
+                assert np.isfinite(rec["trace"]).all()
+
+        # memory watermark at stage boundaries (CPU: live-buffer fallback)
+        assert by_type["memory"]
+        assert all(isinstance(m["devices"], list) for m in by_type["memory"])
+
+        # the report renders the stream without error and names the pieces
+        report = tel.render_report(str(tmp_path / "ev"))
+        for needle in ("Manifest", "Dispatch decisions", "Stage waterfall",
+                       "Replicate convergence", "factorize"):
+            assert needle in report
+
+        # report CLI (positional run_dir form)
+        from cnmf_torch_tpu.cli import main as cli_main
+
+        cli_main(["report", str(tmp_path / "ev")])
+
+    def test_telemetry_off_emits_nothing(self, tmp_path, monkeypatch):
+        from cnmf_torch_tpu import cNMF
+        from cnmf_torch_tpu.utils import save_df_to_npz
+
+        monkeypatch.delenv(tel.TELEMETRY_ENV, raising=False)
+        counts_fn = str(tmp_path / "counts.df.npz")
+        save_df_to_npz(_mini_counts(n=120, g=80), counts_fn)
+        obj = cNMF(output_dir=str(tmp_path), name="off")
+        obj.prepare(counts_fn, components=[3], n_iter=2, seed=7,
+                    num_highvar_genes=60)
+        obj.factorize()
+        assert not (tmp_path / "off" / "cnmf_tmp"
+                    / "off.events.jsonl").exists()
+        # the report falls back to the timings TSV instead of failing
+        report = tel.render_report(str(tmp_path / "off"))
+        assert "timings TSV" in report and "factorize" in report
+
+    def test_cli_rejects_stray_positional_for_non_report(self, capsys):
+        """The optional run_dir positional serves `report` only — a stray
+        positional on any other subcommand (e.g. `consensus 9` meaning
+        `-k 9`) must fail fast, not be silently swallowed."""
+        from cnmf_torch_tpu.cli import main as cli_main
+
+        with pytest.raises(SystemExit) as exc:
+            cli_main(["consensus", "9"])
+        assert exc.value.code == 2
+        assert "unrecognized argument" in capsys.readouterr().err
+
+    def test_validate_event_rejects_malformed(self):
+        tel.validate_event({"v": 1, "t": "stage", "ts": 1.0,
+                            "stage": "x", "wall_s": 0.1})
+        with pytest.raises(ValueError, match="missing required field"):
+            tel.validate_event({"t": "stage", "ts": 1.0})
+        with pytest.raises(ValueError, match="unknown event type"):
+            tel.validate_event({"v": 1, "t": "nope", "ts": 1.0})
+        with pytest.raises(ValueError, match="missing required fields"):
+            tel.validate_event({"v": 1, "t": "stage", "ts": 1.0})
+        with pytest.raises(ValueError, match="schema version"):
+            tel.validate_event({"v": 99, "t": "stage", "ts": 1.0,
+                                "stage": "x", "wall_s": 0.1})
+        with pytest.raises(ValueError, match="replicate record missing"):
+            tel.validate_event({"v": 1, "t": "replicates", "ts": 1.0,
+                                "k": 3, "beta": 2.0,
+                                "records": [{"seed": 1}]})
+
+    def test_validate_events_file_requires_manifest_first(self, tmp_path):
+        p = tmp_path / "e.jsonl"
+        p.write_text(json.dumps({"v": 1, "t": "stage", "ts": 1.0,
+                                 "stage": "x", "wall_s": 0.1}) + "\n")
+        with pytest.raises(ValueError, match="manifest"):
+            tel.validate_events_file(str(p))
+
+    def test_eventlog_nonfinite_values_stay_parseable(self, tmp_path,
+                                                      monkeypatch):
+        monkeypatch.setenv(tel.TELEMETRY_ENV, "1")
+        log = tel.EventLog(str(tmp_path / "e.jsonl"))
+        log.emit("replicates", k=3, beta=2.0, records=[
+            {"seed": 1, "err": float("inf"), "iters": 5, "capped": False,
+             "nonfinite": True}])
+        # strict JSON (bare NaN/Infinity rejected) must parse every line
+        with open(tmp_path / "e.jsonl") as f:
+            for line in f:
+                json.loads(line, parse_constant=lambda c: pytest.fail(
+                    f"non-strict JSON constant {c!r} in event stream"))
+        assert tel.validate_events_file(str(tmp_path / "e.jsonl")) == 2
+
+
+# ---------------------------------------------------------------------------
+# profiling satellites
+# ---------------------------------------------------------------------------
+
+class TestStageTimerSatellites:
+    def test_meta_with_tabs_newlines_stays_single_row(self, tmp_path):
+        path = str(tmp_path / "t.tsv")
+        timer = StageTimer(path)
+        timer.record("stage_a", 1.0, note="bad\tvalue\nwith\rbreaks")
+        timer.record("stage_b", 2.0)
+        with open(path) as f:
+            lines = f.read().splitlines()
+        assert len(lines) == 3  # header + exactly one row per record
+        row = lines[1].split("\t")
+        assert len(row) == 7  # no column shift from the embedded tab
+        assert row[0] == "stage_a"
+        assert "note=bad value with breaks" in row[6]
+        # bench's positional parser still reads (stage, wall)
+        import bench
+
+        rows = list(bench.iter_stage_rows(path))
+        assert rows == [("stage_a", 1.0), ("stage_b", 2.0)]
+
+    def test_oserror_warns_once_per_process(self, tmp_path):
+        timer = StageTimer(str(tmp_path / "no_such_dir" / "t.tsv"))
+        saved = StageTimer._oserror_warned
+        StageTimer._oserror_warned = False
+        try:
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                timer.record("s1", 1.0)
+                timer.record("s2", 1.0)
+            mine = [w for w in caught
+                    if issubclass(w.category, RuntimeWarning)
+                    and "StageTimer" in str(w.message)]
+            assert len(mine) == 1  # warned exactly once, not per record
+        finally:
+            StageTimer._oserror_warned = saved
+
+    def test_stage_events_mirror_rows(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(tel.TELEMETRY_ENV, "1")
+        log = tel.EventLog(str(tmp_path / "e.jsonl"))
+        timer = StageTimer(str(tmp_path / "t.tsv"), events=log)
+        with timer.stage("work", nbytes=1000):
+            pass
+        events = tel.read_events(str(tmp_path / "e.jsonl"))
+        stage_evs = [e for e in events if e["t"] == "stage"]
+        assert len(stage_evs) == 1
+        assert stage_evs[0]["stage"] == "work"
+        assert stage_evs[0]["nbytes"] == 1000
+        tel.validate_events_file(str(tmp_path / "e.jsonl"))
+
+
+class TestTraceReentrancy:
+    def test_concurrent_stages_open_one_profiler_session(self, tmp_path,
+                                                         monkeypatch):
+        """k_selection runs up to 4 concurrent stats passes; only ONE may
+        hold a jax.profiler session (a second concurrent session raises
+        inside JAX). The old module-global flag let two threads race past
+        the check; the lock must serialize them."""
+        state = {"depth": 0, "max_depth": 0, "entries": 0}
+        state_lock = threading.Lock()
+
+        @contextlib.contextmanager
+        def fake_profiler_trace(path):
+            with state_lock:
+                state["depth"] += 1
+                state["entries"] += 1
+                state["max_depth"] = max(state["max_depth"], state["depth"])
+            try:
+                time.sleep(0.02)
+                yield
+            finally:
+                with state_lock:
+                    state["depth"] -= 1
+
+        monkeypatch.setattr(jax.profiler, "trace", fake_profiler_trace)
+        monkeypatch.setenv("CNMF_TPU_PROFILE_DIR", str(tmp_path))
+
+        barrier = threading.Barrier(4)
+
+        def worker(i):
+            barrier.wait()
+            for _ in range(5):
+                with trace(f"stage_{i}"):
+                    time.sleep(0.002)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert state["entries"] >= 1
+        assert state["max_depth"] == 1
+
+    def test_nested_stage_is_noop(self, tmp_path, monkeypatch):
+        entries = []
+
+        @contextlib.contextmanager
+        def fake_profiler_trace(path):
+            entries.append(path)
+            yield
+
+        monkeypatch.setattr(jax.profiler, "trace", fake_profiler_trace)
+        monkeypatch.setenv("CNMF_TPU_PROFILE_DIR", str(tmp_path))
+        with trace("outer"):
+            with trace("inner"):
+                pass
+        assert len(entries) == 1 and entries[0].endswith("outer")
+        # the session is released afterwards — a later stage traces again
+        with trace("later"):
+            pass
+        assert len(entries) == 2
