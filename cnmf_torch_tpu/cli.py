@@ -103,9 +103,10 @@ def build_parser() -> argparse.ArgumentParser:
                              "learning.")
     parser.add_argument("--skip-completed-runs", action="store_true",
                         default=False,
-                        help="[factorize] Skip previously completed runs. "
-                             "Must re-run prepare first to update completed "
-                             "runs")
+                        help="[factorize] Resume: skip replicates whose "
+                             "artifacts probe AND validate on disk (torn "
+                             "files are rerun, quarantined lanes stay "
+                             "excluded). No prepare re-run needed.")
     parser.add_argument("--sequential", action="store_true", default=False,
                         help="[factorize] Run replicates one at a time "
                              "instead of as one batched device program")
@@ -277,15 +278,29 @@ def main(argv=None):
             use_gpu=args.use_gpu, batch_size=args.batch_size)
 
     elif args.command == "factorize":
-        cnmf_obj.factorize(
-            worker_i=args.worker_index,
-            total_workers=max(args.total_workers, 1),
-            skip_completed_runs=args.skip_completed_runs,
-            batched=not args.sequential,
-            mesh="2d" if args.mesh_2d else None,
-            rowshard=args.rowshard,
-            rowshard_threshold=args.rowshard_threshold,
-            packed=False if args.per_k_programs else None)
+        from .runtime.resilience import (UNHEALTHY_EXIT_CODE,
+                                         UnhealthySweepError)
+
+        try:
+            cnmf_obj.factorize(
+                worker_i=args.worker_index,
+                total_workers=max(args.total_workers, 1),
+                skip_completed_runs=args.skip_completed_runs,
+                batched=not args.sequential,
+                mesh="2d" if args.mesh_2d else None,
+                rowshard=args.rowshard,
+                rowshard_threshold=args.rowshard_threshold,
+                packed=False if args.per_k_programs else None)
+        except UnhealthySweepError as exc:
+            # a distinct exit code: the launcher must NOT respawn (the
+            # derived retry seeds are deterministic — a rerun fails
+            # identically) and must NOT fall back to skip-missing combine
+            # (that would produce the degraded consensus the
+            # CNMF_TPU_MIN_HEALTHY_FRAC floor exists to prevent)
+            import sys
+
+            print(f"factorize: {exc}", file=sys.stderr)
+            sys.exit(UNHEALTHY_EXIT_CODE)
 
     elif args.command == "combine":
         cnmf_obj.combine(components=args.components)

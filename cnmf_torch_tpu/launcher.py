@@ -7,9 +7,14 @@ Two engines replace GNU parallel:
   * ``subprocess`` — N independent OS worker processes, round-robin sharded
     by ``--worker-index`` over the replicate ledger, exactly the reference's
     model (files as the dataplane). Right for a fleet of single-chip hosts
-    with a shared filesystem and for CPU dev boxes. A dead worker costs only
-    its own replicates: combine runs with ``skip_missing_files=True`` when
-    any worker exits nonzero.
+    with a shared filesystem and for CPU dev boxes. Self-healing (ISSUE 5):
+    a worker that dies (or exceeds ``CNMF_TPU_WORKER_TIMEOUT`` seconds and
+    is killed) is respawned onto its own unfinished ledger shard with
+    ``--skip-completed-runs`` — resume rides the eager, atomic per-replicate
+    artifacts — after an exponential backoff, up to
+    ``CNMF_TPU_WORKER_RESPAWNS`` times (default 1). Only when the respawn
+    budget is exhausted does the run fall back to the reference's
+    dead-worker tolerance: combine with ``skip_missing_files=True``.
   * ``multihost`` — ONE single-controller JAX program spanning N processes
     stitched by ``jax.distributed`` (``parallel/multihost.py``); factorize
     runs over the 2-D (replicates x cells) mesh, with the cells-psum on ICI
@@ -43,6 +48,100 @@ def _free_port() -> int:
 def _worker_cmd(output_dir: str, name: str, extra: list[str]) -> list[str]:
     return [sys.executable, "-m", "cnmf_torch_tpu", "factorize",
             "--output-dir", output_dir, "--name", name] + extra
+
+
+def _run_subprocess_workers(
+        output_dir: str, name: str, total_workers: int,
+        factorize_flags: list[str], base_env: dict,
+        poll_s: float = 0.05) -> tuple[set[int], set[int]]:
+    """Run the subprocess-engine worker fleet with self-healing: per-worker
+    wall timeouts (``CNMF_TPU_WORKER_TIMEOUT`` seconds; 0/unset = none)
+    and bounded exponential-backoff respawn of dead workers
+    (``CNMF_TPU_WORKER_RESPAWNS`` attempts, delays
+    ``CNMF_TPU_WORKER_BACKOFF_S * 2^(attempt-1)``). A respawned worker
+    resumes its OWN round-robin ledger shard via ``--skip-completed-runs``
+    — factorize probes AND validates the eager per-replicate artifacts, so
+    a SIGKILL'd predecessor's torn files are rerun, not trusted. Returns
+    ``(failed, unhealthy)``: worker indices that stayed dead after the
+    respawn budget, and workers that exited with
+    ``resilience.UNHEALTHY_EXIT_CODE`` (below the min-healthy-frac floor
+    — a deterministic policy failure that is neither respawned nor
+    degraded around; the caller aborts the pipeline)."""
+    import time
+
+    from .runtime.resilience import UNHEALTHY_EXIT_CODE
+
+    respawn_limit = max(0, int(
+        os.environ.get("CNMF_TPU_WORKER_RESPAWNS", "1") or 0))
+    timeout_s = float(os.environ.get("CNMF_TPU_WORKER_TIMEOUT", "0") or 0)
+    backoff_s = float(os.environ.get("CNMF_TPU_WORKER_BACKOFF_S", "0.5")
+                      or 0)
+
+    def spawn(i: int, resume: bool):
+        flags = ["--worker-index", str(i),
+                 "--total-workers", str(total_workers)]
+        if resume and "--skip-completed-runs" not in factorize_flags:
+            flags.append("--skip-completed-runs")
+        return subprocess.Popen(
+            _worker_cmd(output_dir, name, flags + factorize_flags),
+            env=base_env)
+
+    now = time.monotonic
+    procs = {i: spawn(i, False) for i in range(total_workers)}
+    deadline = {i: (now() + timeout_s if timeout_s > 0 else None)
+                for i in procs}
+    attempts = {i: 0 for i in procs}
+    respawn_at: dict[int, float] = {}
+    failed: set[int] = set()
+    unhealthy: set[int] = set()
+
+    while procs or respawn_at:
+        for i in [j for j, t in respawn_at.items() if now() >= t]:
+            del respawn_at[i]
+            procs[i] = spawn(i, True)
+            deadline[i] = now() + timeout_s if timeout_s > 0 else None
+        for i in list(procs):
+            p = procs[i]
+            rc = p.poll()
+            if rc is None:
+                if deadline[i] is not None and now() > deadline[i]:
+                    warnings.warn(
+                        "factorize worker %d exceeded CNMF_TPU_WORKER_"
+                        "TIMEOUT=%gs; killing it" % (i, timeout_s),
+                        RuntimeWarning)
+                    p.kill()
+                    p.wait()
+                    rc = p.returncode
+                else:
+                    continue
+            del procs[i]
+            if rc == 0:
+                continue
+            if rc == UNHEALTHY_EXIT_CODE:
+                # below the min-healthy-frac floor: deterministic — a
+                # respawn reruns the same derived seeds and fails the
+                # same way, so don't burn the budget
+                unhealthy.add(i)
+                continue
+            if attempts[i] < respawn_limit:
+                attempts[i] += 1
+                delay = backoff_s * (2 ** (attempts[i] - 1))
+                warnings.warn(
+                    "factorize worker %d died (rc=%s); respawning onto its "
+                    "unfinished ledger shard in %.1fs (attempt %d/%d)"
+                    % (i, rc, delay, attempts[i], respawn_limit),
+                    RuntimeWarning)
+                respawn_at[i] = now() + delay
+            else:
+                failed.add(i)
+                warnings.warn(
+                    "factorize worker %d exited with rc=%d; its replicates "
+                    "will be skipped at combine (the reference's dead-worker "
+                    "tolerance, cnmf.py:904-909)" % (i, rc),
+                    RuntimeWarning)
+        if procs or respawn_at:
+            time.sleep(poll_s)
+    return failed, unhealthy
 
 
 def run_pipeline(counts: str, output_dir: str, name: str,
@@ -114,29 +213,24 @@ def run_pipeline(counts: str, output_dir: str, name: str,
 
     any_failed = False
     if engine == "subprocess":
-        procs = []
-        for i in range(total_workers):
-            cmd = _worker_cmd(output_dir, name,
-                              ["--worker-index", str(i),
-                               "--total-workers", str(total_workers)]
-                              + factorize_flags)
-            procs.append((i, subprocess.Popen(cmd, env=base_env)))
-        n_failed = 0
-        for i, p in procs:
-            if p.wait() != 0:
-                any_failed = True
-                n_failed += 1
-                warnings.warn(
-                    "factorize worker %d exited with rc=%d; its replicates "
-                    "will be skipped at combine (the reference's dead-worker "
-                    "tolerance, cnmf.py:904-909)" % (i, p.returncode),
-                    RuntimeWarning)
-        if n_failed == total_workers:
+        failed, unhealthy = _run_subprocess_workers(
+            output_dir, name, total_workers, factorize_flags, base_env)
+        if unhealthy:
+            # the min-healthy-frac floor is a hard guarantee end-to-end:
+            # degrading around it with skip-missing combine would produce
+            # exactly the under-powered consensus it exists to prevent
+            raise RuntimeError(
+                "factorize worker(s) %s reported too few healthy "
+                "replicates (below CNMF_TPU_MIN_HEALTHY_FRAC; see their "
+                "output above) — aborting before combine/consensus"
+                % sorted(unhealthy))
+        any_failed = bool(failed)
+        if len(failed) == total_workers:
             # nothing survived — combine/k_selection would only crash on
             # missing files with a misleading traceback
             raise RuntimeError(
-                f"all {total_workers} factorize workers failed; see their "
-                "output above")
+                f"all {total_workers} factorize workers failed (respawn "
+                "budget exhausted); see their output above")
     elif engine == "multihost":
         port = _free_port()
         procs = []
@@ -167,8 +261,17 @@ def run_pipeline(counts: str, output_dir: str, name: str,
     if clean:
         # the reference's `rm .../cnmf_tmp/*.iter_*.df.npz`
         # (run_parallel.py:64): per-replicate spectra are redundant once
-        # merged_spectra exists
-        pattern = os.path.join(output_dir, name, "cnmf_tmp",
-                               "*.iter_*.df.npz")
-        for f in glob.glob(pattern):
-            os.remove(f)
+        # merged_spectra exists. Also sweep pid-suffixed atomic-write
+        # temp files orphaned by killed workers (utils/anndata_lite
+        # .atomic_artifact) — no reader ever trusts them, but they
+        # accumulate across preemptions; all workers have exited by here,
+        # so none are live.
+        run_dir = os.path.join(output_dir, name)
+        for pattern in (os.path.join("cnmf_tmp", "*.iter_*.df.npz"),
+                        # atomic-write temp orphans land wherever their
+                        # artifact lives: intermediates in cnmf_tmp/, the
+                        # txt/stats finals in the run dir itself
+                        os.path.join("cnmf_tmp", "*.tmp-*"),
+                        "*.tmp-*"):
+            for f in glob.glob(os.path.join(run_dir, pattern)):
+                os.remove(f)

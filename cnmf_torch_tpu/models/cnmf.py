@@ -443,11 +443,12 @@ class cNMF:
     # ------------------------------------------------------------------
 
     def _nmf(self, X, nmf_kwargs):
-        """Single-replicate solve; returns ``(spectra, usages)``
-        (``cnmf.py:805-821``)."""
+        """Single-replicate solve; returns ``(spectra, usages, err)``
+        (``cnmf.py:805-821``; the final objective rides along as the
+        per-replicate health signal, ``ops.nmf.lane_health``)."""
         kwargs = {k: v for k, v in nmf_kwargs.items() if k != "n_jobs"}
-        usages, spectra, _err = run_nmf(X, **kwargs)
-        return spectra, usages
+        usages, spectra, err = run_nmf(X, **kwargs)
+        return spectra, usages, err
 
     @_timed("factorize")
     def factorize(self, worker_i=0, total_workers=1,
@@ -483,23 +484,99 @@ class cNMF:
         across the mesh — CSR row blocks stream host→HBM one shard at a time
         (never a host dense copy), the staged device array is reused across
         all replicates, and each replicate's W statistics psum over ICI.
+
+        Fault tolerance (ISSUE 5, ``runtime/resilience.py``): every
+        replicate is health-graded (``ops.nmf.lane_health`` — host-side,
+        zero program changes); unhealthy lanes are retried with derived
+        seeds (``seed XOR attempt``, up to ``CNMF_TPU_MAX_RETRIES``) and
+        quarantined into the per-worker resilience ledger when the budget
+        runs out, with a hard failure below ``CNMF_TPU_MIN_HEALTHY_FRAC``
+        survivors per K. ``skip_completed_runs`` probes AND validates
+        artifacts (torn files rerun), and resumes the batched paths at
+        whole-K-group granularity so a resumed sweep is bit-identical to
+        an uninterrupted one. (The 2-D multi-host path keeps the plain
+        write path: cross-host retry coordination is out of scope.)
         """
+        from ..runtime import faults, resilience
+
         run_params = load_df_from_npz(self.paths["nmf_replicate_parameters"])
         norm_counts = read_h5ad(self.paths["normalized_counts"])
         with open(self.paths["nmf_run_parameters"]) as f:
             _nmf_kwargs = yaml.load(f, Loader=yaml.FullLoader)
 
+        my_tasks = list(worker_filter(range(len(run_params)), worker_i,
+                                      total_workers))
+        quarantined_idx: dict[int, int | None] = {}  # task idx -> attempts
         if not skip_completed_runs:
-            jobs = worker_filter(range(len(run_params)), worker_i,
-                                 total_workers)
+            jobs = my_tasks
+            if int(worker_i) == 0:
+                # a fresh run recomputes every replicate, voiding prior
+                # quarantine records; in-range workers rewrite/remove
+                # their own ledgers at finalize, but ledgers from a run
+                # with MORE workers have no owner — sweep them here so
+                # their stale records can't haunt later resumes/combines
+                resilience.sweep_stale_ledgers(
+                    self.paths["resilience_ledger"],
+                    max(int(total_workers), 1))
         else:
-            jobs = worker_filter(
-                run_params.index[run_params["completed"] == False],  # noqa: E712
-                worker_i, total_workers)
-        jobs = list(jobs)
+            # torn-artifact-proof resume: probe AND validate the on-disk
+            # artifacts of this worker's own ledger shard. The persisted
+            # `completed` column is stale unless prepare re-ran, and a
+            # SIGKILL mid-write used to leave truncated npz files the
+            # column then trusted; a torn file counts as incomplete here
+            # and its rerun overwrites it atomically. (Divergence from
+            # the reference's resume, which re-round-robins the
+            # incomplete SUBSET across workers: a respawned worker must
+            # resume exactly its own unfinished shard while its peers
+            # keep running theirs.)
+            quarantined_prev = resilience.load_quarantine_records(
+                self.paths["resilience_ledger"])
+            jobs = []
+            # torn-artifact events are deferred past _set_ledger_manifest
+            # below: the FIRST emit flushes the telemetry manifest, and
+            # emitting here would flush it without its ledger block
+            deferred_torn: list[dict] = []
+            for idx in my_tasks:
+                p = run_params.iloc[idx, :]
+                k_t, it_t = int(p["n_components"]), int(p["iter"])
+                fn = self.paths["iter_spectra"] % (k_t, it_t)
+                reason = resilience.probe_spectra_file(
+                    fn, k=k_t, n_genes=int(norm_counts.X.shape[1]))
+                if reason is None:
+                    continue
+                if (k_t, it_t) in quarantined_prev:
+                    attempts_prev = quarantined_prev[(k_t, it_t)]
+                    if (attempts_prev is not None
+                            and attempts_prev < resilience.max_retries()):
+                        # the quarantine warning tells users to raise
+                        # CNMF_TPU_MAX_RETRIES — honor it: under a larger
+                        # budget the record is not final, so the lane
+                        # reruns with the full new retry ladder
+                        jobs.append(idx)
+                        continue
+                    # deliberately absent: a previous run exhausted this
+                    # lane's retry budget. Without this check every
+                    # resume would rerun (and re-quarantine) it forever —
+                    # resume after a degraded run must be idempotent.
+                    quarantined_idx[idx] = attempts_prev
+                    continue
+                if reason != "missing":
+                    warnings.warn(
+                        "resume: replicate artifact failed validation and "
+                        "will be rerun — %s" % reason,
+                        RuntimeWarning, stacklevel=2)
+                    deferred_torn.append({"path": fn, "reason": reason})
+                jobs.append(idx)
 
+        # n_worker_tasks counts the tasks NEEDING RECOVERY on a resume
+        # (pre-expansion): the whole-K-group expansion below may rerun
+        # more replicates for bit-parity, and those surface as ordinary
+        # per-replicate convergence records in the event stream
         self._set_ledger_manifest(run_params, _nmf_kwargs,
                                   n_worker_tasks=len(jobs))
+        if skip_completed_runs:
+            for ctx in deferred_torn:
+                self._events.emit("fault", kind="torn_artifact", context=ctx)
 
         # 2-D replicates x cells mesh (multi-host layout, parallel/multihost):
         # mesh="2d" auto-builds it; a Mesh with those two axes routes as-is
@@ -512,6 +589,55 @@ class cNMF:
                 mesh = mesh_2d()
             self._factorize_2d(jobs, run_params, norm_counts, _nmf_kwargs,
                                mesh, worker_i, replicates_per_batch)
+            return
+
+        # quarantine + reseeded-retry bookkeeping (runtime/resilience.py):
+        # every single-controller factorize path reports per-replicate
+        # health through this guard; unhealthy lanes retry with derived
+        # seeds and exhausted lanes quarantine (excluded from combine via
+        # the resilience ledger). The 2-D multi-host path above is exempt:
+        # retries there would have to be coordinated collectives.
+        guard = resilience.ReplicateGuard(
+            events=self._events,
+            ledger_path=self.paths["resilience_ledger"] % int(worker_i))
+
+        def _credit_completed(final_jobs):
+            # resume accounting: replicates already valid on disk count as
+            # healthy toward the per-K min-healthy-frac floor — without
+            # the credit a resume that reruns 1 of N replicates and
+            # quarantines it would hard-fail at 0/1 observed when the K
+            # is really (N-1)/N healthy
+            if not skip_completed_runs:
+                return
+            per_k: dict[int, int] = {}
+            for i in set(my_tasks) - set(final_jobs):
+                p = run_params.iloc[i, :]
+                kk = int(p["n_components"])
+                if i in quarantined_idx:
+                    # still-unresolved quarantine, not rerun this session:
+                    # counts toward the total (not healthy) and rides into
+                    # the rewritten ledger so combine keeps excluding it
+                    guard.carry_quarantined(kk, int(p["iter"]),
+                                            int(p["nmf_seed"]),
+                                            attempts=quarantined_idx[i])
+                else:
+                    per_k[kk] = per_k.get(kk, 0) + 1
+            for kk, n in per_k.items():
+                guard.credit_existing(kk, n)
+
+        if skip_completed_runs and not jobs:
+            # nothing to re-solve — but the floor accounting must still
+            # run: a resume after a below-floor run would otherwise exit
+            # 0 here and let the pipeline proceed to the exact degraded
+            # consensus the UNHEALTHY_EXIT_CODE plumbing aborts on.
+            # Credits + carried quarantines reproduce the K's true state;
+            # finalize re-evaluates the floor and rewrites the ledger.
+            _credit_completed(jobs)
+            guard.finalize()
+            print("[Worker %d]. All assigned replicates already have valid "
+                  "artifacts%s; nothing to resume."
+                  % (worker_i, " or quarantine records"
+                     if quarantined_idx else ""))
             return
 
         if rowshard_threshold is None:
@@ -528,29 +654,48 @@ class cNMF:
                       "replicate sweep)."
                       % (norm_counts.X.shape[0], int(rowshard_threshold)))
         if rowshard:
+            _credit_completed(jobs)
             self._factorize_rowsharded(jobs, run_params, norm_counts,
-                                       _nmf_kwargs, mesh, worker_i)
+                                       _nmf_kwargs, mesh, worker_i,
+                                       guard=guard)
             return
 
         if not batched:
+            _credit_completed(jobs)
             self._save_factorize_provenance(
                 "sequential", worker_i,
                 {k: v for k, v in _nmf_kwargs.items() if k != "n_jobs"})
+
+            def _solve_seq(k_r, seed_r):
+                kwargs = dict(_nmf_kwargs)
+                kwargs["random_state"] = int(seed_r)
+                kwargs["n_components"] = int(k_r)
+                spectra, _usages, err = self._nmf(norm_counts.X, kwargs)
+                return np.asarray(spectra), err
+
             for idx in jobs:
                 p = run_params.iloc[idx, :]
                 print("[Worker %d]. Starting task %d." % (worker_i, idx))
-                kwargs = dict(_nmf_kwargs)
-                kwargs["random_state"] = p["nmf_seed"]
-                kwargs["n_components"] = p["n_components"]
-                spectra, _usages = self._nmf(norm_counts.X, kwargs)
-                spectra = pd.DataFrame(
-                    spectra,
-                    index=np.arange(1, kwargs["n_components"] + 1),
-                    columns=norm_counts.var.index)
-                save_df_to_npz(
-                    spectra,
-                    self.paths["iter_spectra"] % (p["n_components"], p["iter"]),
-                    compress=False)
+                k_t, it_t = int(p["n_components"]), int(p["iter"])
+                spectra, err = _solve_seq(k_t, p["nmf_seed"])
+                sp3, errs = faults.maybe_poison_lanes(
+                    k_t, [it_t], spectra[None], np.asarray([err]),
+                    seeds=[int(p["nmf_seed"])])
+                healthy = guard.observe(
+                    k_t, [it_t], [int(p["nmf_seed"])],
+                    resilience.lane_health(errs, spectra=sp3))
+                if healthy[0]:
+                    self._write_iter_spectra(k_t, it_t, sp3[0],
+                                             norm_counts.var.index)
+                faults.maybe_kill("factorize", worker_i)
+
+            def rerun_seq(k_r, seeds_r):
+                outs = [_solve_seq(k_r, s) for s in seeds_r]
+                return (np.stack([o[0] for o in outs]),
+                        np.asarray([o[1] for o in outs], np.float64))
+
+            self._finish_resilience(guard, rerun_seq, norm_counts.var.index,
+                                    worker_i)
             return
 
         if mesh is None:
@@ -644,6 +789,38 @@ class cNMF:
                          "threads": stream_threads(),
                          "depth": stream_depth()})
 
+        if skip_completed_runs and jobs:
+            # sweep-granular resume: a K with ANY incomplete replicate
+            # reruns this worker's whole K group. The vmapped while_loop
+            # steps every lane until the batch's slowest lane converges,
+            # so a lane's result depends on batch composition — rerunning
+            # only the missing lanes would be valid but not bit-identical
+            # to the uninterrupted run. Whole-group reruns make
+            # interrupted+resumed sweeps byte-for-byte reproducible
+            # (kill-resume parity, tests/test_resilience.py) and cost
+            # almost nothing: the batch runs to its slowest lane either
+            # way, and the overwrites are atomic.
+            ks_incomplete = {int(run_params.iloc[i]["n_components"])
+                             for i in jobs}
+            # quarantined lanes stay excluded even when their K is
+            # rerun for other reasons: re-solving a deterministically
+            # divergent lane would burn the whole retry ladder again on
+            # every resume. (In this compound case — quarantine + torn
+            # lane in one K — the rerun batch omits the quarantined
+            # lane, so bit-parity with an uninterrupted run is waived
+            # for that K; validity and determinism of the rerun hold.)
+            expanded = [i for i in my_tasks
+                        if int(run_params.iloc[i]["n_components"])
+                        in ks_incomplete and i not in quarantined_idx]
+            if len(expanded) > len(jobs):
+                print("[Worker %d]. Resume reruns %d replicate(s) (whole-K "
+                      "groups for K=%s) so resumed sweeps are bit-identical "
+                      "to uninterrupted ones."
+                      % (worker_i, len(expanded),
+                         ",".join(str(k) for k in sorted(ks_incomplete))))
+            jobs = expanded
+        _credit_completed(jobs)
+
         by_k: dict[int, list] = {}
         for idx in jobs:
             p = run_params.iloc[idx, :]
@@ -689,6 +866,29 @@ class cNMF:
                  mesh_devices=(1 if mesh is None
                                else int(np.prod(mesh.devices.shape)))))
 
+        def rerun_batched(k_r, seeds_r):
+            # quarantine-retry solver for the batched paths: a fresh per-K
+            # sweep over the staged X with the derived seeds (the packed
+            # program's K_max padding is irrelevant for a retry — bit
+            # parity with the original attempt is not a goal, a healthy
+            # fresh draw is)
+            spectra_r, _, errs_r = replicate_sweep(
+                X, seeds_r, k_r,
+                beta_loss=_nmf_kwargs["beta_loss"],
+                init=_nmf_kwargs["init"],
+                mode=_nmf_kwargs.get("mode", "online"),
+                tol=_nmf_kwargs.get("tol", 1e-4),
+                online_chunk_size=_nmf_kwargs.get("online_chunk_size", 5000),
+                online_chunk_max_iter=_nmf_kwargs.get(
+                    "online_chunk_max_iter", _DEFAULT_CHUNK_MAX_ITER),
+                alpha_W=_nmf_kwargs.get("alpha_W", 0.0),
+                l1_ratio_W=_nmf_kwargs.get("l1_ratio_W", 0.0),
+                alpha_H=_nmf_kwargs.get("alpha_H", 0.0),
+                l1_ratio_H=_nmf_kwargs.get("l1_ratio_H", 0.0),
+                mesh=mesh, replicates_per_batch=replicates_per_batch,
+                n_rows=int(norm_counts.X.shape[0]) if use_ell else None)
+            return np.asarray(spectra_r), np.asarray(errs_r)
+
         if packed and by_k:
             from ..parallel import replicate_sweep_packed
 
@@ -699,19 +899,29 @@ class cNMF:
                   % (worker_i, len(tasks),
                      ",".join(str(k) for k in sorted(by_k)),
                      max(by_k)))
-            def write_slice(task_idx, spectra, _errs):
+            def write_slice(task_idx, spectra, errs):
                 # eager per-slice writes: a mid-sweep crash keeps every
-                # completed slice's files (--skip-completed-runs resumes)
+                # completed slice's files (--skip-completed-runs resumes).
+                # Slices are K-homogeneous (replicate_sweep_packed groups
+                # by K), so one health pass grades the whole slice.
+                k = tasks[task_idx[0]][0]
+                iters = [tasks[ti][1] for ti in task_idx]
+                seeds_sl = [tasks[ti][2] for ti in task_idx]
+                spectra, errs = faults.maybe_poison_lanes(
+                    k, iters, spectra, errs, seeds=seeds_sl)
+                healthy = guard.observe(
+                    k, iters, seeds_sl,
+                    resilience.lane_health(errs, spectra=spectra))
                 for j, ti in enumerate(task_idx):
-                    k, it, _seed = tasks[ti]
-                    df = pd.DataFrame(spectra[j][:k],
-                                      index=np.arange(1, k + 1),
-                                      columns=norm_counts.var.index)
+                    if not healthy[j]:
+                        continue
+                    _k, it, _seed = tasks[ti]
                     # stored, not deflated: 900 per-replicate writes cost
                     # ~3.2 s of a 12.6 s warm factorize in zlib alone, for
                     # transient files combine deletes under --clean
-                    save_df_to_npz(df, self.paths["iter_spectra"] % (k, it),
-                                   compress=False)
+                    self._write_iter_spectra(_k, it, spectra[j][:_k],
+                                             norm_counts.var.index)
+                faults.maybe_kill("factorize", worker_i)
 
             replicate_sweep_packed(
                 X, [t[0] for t in tasks], [t[2] for t in tasks],
@@ -729,6 +939,8 @@ class cNMF:
                 on_slice=write_slice,
                 telemetry_sink=lambda _idx, pay:
                     self._emit_replicates_event(pay))
+            self._finish_resilience(guard, rerun_batched,
+                                    norm_counts.var.index, worker_i)
             return
 
         if len(by_k) > 1:
@@ -763,7 +975,7 @@ class cNMF:
         # of later ones while (a) each K's spectra files still land on disk
         # as soon as that K is done (crash-resume via --skip-completed-runs
         # keeps working) and (b) at most `window` Ks' results sit in HBM
-        pending: list[tuple[int, list, object]] = []
+        pending: list[tuple[int, list, list, object, object]] = []
         window = 4
         # sweep telemetry payloads hold DEVICE arrays until their K drains
         # — converting eagerly would block the dispatch-ahead window
@@ -771,23 +983,36 @@ class cNMF:
 
         def _drain(count):
             while len(pending) > count:
-                k, iters, spectra_d = pending.pop(0)
+                k, iters, seeds_k, spectra_d, errs_d = pending.pop(0)
                 spectra = np.asarray(spectra_d)
+                errs = np.asarray(errs_d)
+                payload = telem_by_k.pop(k, None)
+                spectra, errs = faults.maybe_poison_lanes(
+                    k, iters, spectra, errs, seeds=seeds_k)
+                # always-on health pass over the final objectives +
+                # written spectra. Deliberately does NOT fold in the
+                # telemetry nonfinite latch: quarantine decisions must be
+                # identical with and without CNMF_TPU_TELEMETRY — an
+                # observability flag must never change which spectra land
+                # on disk. (A transiently-inf-then-recovered lane stays
+                # visible in the latch's `fault`-free telemetry record.)
+                healthy = guard.observe(
+                    k, iters, seeds_k,
+                    resilience.lane_health(errs, spectra=spectra))
                 for r, it in enumerate(iters):
-                    df = pd.DataFrame(spectra[r],
-                                      index=np.arange(1, k + 1),
-                                      columns=norm_counts.var.index)
-                    save_df_to_npz(df,
-                                   self.paths["iter_spectra"] % (k, it),
-                                   compress=False)
-                self._emit_replicates_event(telem_by_k.pop(k, None))
+                    if not healthy[r]:
+                        continue
+                    self._write_iter_spectra(k, it, spectra[r],
+                                             norm_counts.var.index)
+                self._emit_replicates_event(payload)
+                faults.maybe_kill("factorize", worker_i)
 
         for k, tasks in sorted(by_k.items()):
             iters = [t[0] for t in tasks]
             seeds = [t[1] for t in tasks]
             print("[Worker %d]. Running %d replicates for k=%d as one "
                   "batched program." % (worker_i, len(tasks), k))
-            spectra_d, _, _errs = replicate_sweep(
+            spectra_d, _, errs_d = replicate_sweep(
                 X, seeds, k,
                 beta_loss=_nmf_kwargs["beta_loss"],
                 init=_nmf_kwargs["init"],
@@ -807,9 +1032,11 @@ class cNMF:
                 n_rows=int(norm_counts.X.shape[0]) if use_ell else None,
                 telemetry_sink=lambda pay, _k=k:
                     telem_by_k.__setitem__(_k, pay))
-            pending.append((k, iters, spectra_d))
+            pending.append((k, iters, seeds, spectra_d, errs_d))
             _drain(window - 1)
         _drain(0)
+        self._finish_resilience(guard, rerun_batched, norm_counts.var.index,
+                                worker_i)
 
     def _save_factorize_provenance(self, engaged_path: str, worker_i,
                                    effective_params: dict):
@@ -820,10 +1047,11 @@ class cNMF:
                   "worker_index": int(worker_i),
                   "effective_params": effective_params}
         path = self.paths["factorize_provenance"] % int(worker_i)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            yaml.dump(record, f)
-        os.replace(tmp, path)  # readers never see a half-written record
+        from ..utils.anndata_lite import atomic_artifact
+
+        with atomic_artifact(path) as tmp:  # never a half-written record
+            with open(tmp, "w") as f:
+                yaml.dump(record, f)
         # the engaged solver family + effective params IS the dispatch
         # decision — every factorize path funnels through here
         self._events.emit("dispatch", decision="solver_path",
@@ -845,8 +1073,61 @@ class cNMF:
                           cadence=payload["cadence"],
                           records=replicate_records(payload))
 
+    def _write_iter_spectra(self, k, it, spectrum, columns):
+        """One replicate's spectra artifact (atomic via save_df_to_npz);
+        stored, not deflated — see the packed write path's note."""
+        df = pd.DataFrame(spectrum, index=np.arange(1, int(k) + 1),
+                          columns=columns)
+        save_df_to_npz(df, self.paths["iter_spectra"] % (int(k), int(it)),
+                       compress=False)
+
+    def _finish_resilience(self, guard, rerun, columns, worker_i=0):
+        """Retry waves + final accounting for one factorize call.
+
+        ``rerun(k, seeds) -> (spectra (R,k',g) numpy, errs (R,) numpy)``
+        re-solves a list of replicates at one K (each path supplies its
+        own solver family; ``k' >= k`` for K_max-padded outputs). Seeds
+        are derived per attempt (``resilience.derive_retry_seed``), so an
+        interrupted run resumed later retries with identical seeds; the
+        guard's ledger records every (seed, attempt, derived_seed,
+        outcome) and the final quarantine set, then enforces the per-K
+        min-healthy-frac floor."""
+        from ..runtime import faults, resilience
+
+        attempt = 1
+        while attempt <= guard.max_retries:
+            wave = guard.take_pending()
+            if not wave:
+                break
+            by_k: dict[int, list] = {}
+            for t in wave:
+                by_k.setdefault(int(t["k"]), []).append(t)
+            for k, tasks in sorted(by_k.items()):
+                iters = [t["iter"] for t in tasks]
+                orig_seeds = [t["seed"] for t in tasks]
+                derived = [resilience.derive_retry_seed(s, attempt)
+                           for s in orig_seeds]
+                print("[Worker %d]. Retrying %d unhealthy replicate(s) for "
+                      "k=%d with derived seeds (attempt %d/%d)."
+                      % (worker_i, len(tasks), k, attempt,
+                         guard.max_retries))
+                spectra, errs = rerun(k, derived)
+                spectra, errs = faults.maybe_poison_lanes(
+                    k, iters, spectra, errs, attempt=attempt,
+                    seeds=orig_seeds)
+                healthy = guard.observe(
+                    k, iters, orig_seeds,
+                    resilience.lane_health(errs, spectra=spectra),
+                    attempt=attempt, derived_seeds=derived)
+                for j, it in enumerate(iters):
+                    if healthy[j]:
+                        self._write_iter_spectra(k, it, spectra[j][:k],
+                                                 columns)
+            attempt += 1
+        guard.finalize()
+
     def _factorize_rowsharded(self, jobs, run_params, norm_counts,
-                              nmf_kwargs, mesh, worker_i):
+                              nmf_kwargs, mesh, worker_i, guard=None):
         """Atlas-scale factorize: cells sharded over the mesh, replicates
         sequential. X streams host→HBM once (shard-sized CSR blocks, no host
         dense copy) and is reused by every replicate; padded rows contribute
@@ -888,14 +1169,14 @@ class cNMF:
              "alpha_H": nmf_kwargs.get("alpha_H", 0.0),
              "mesh_devices": int(np.prod(mesh.devices.shape)),
              "ledger_keys_ignored": ["mode", "online_chunk_size"]})
-        for idx in jobs:
-            p = run_params.iloc[idx, :]
-            k = int(p["n_components"])
-            _H, spectra, _err = nmf_fit_rowsharded(
-                Xd, k, mesh,
+        from ..runtime import faults, resilience
+
+        def _solve_rowshard(k_r, seed_r):
+            _H, spectra, err = nmf_fit_rowsharded(
+                Xd, int(k_r), mesh,
                 beta_loss=nmf_kwargs["beta_loss"],
                 init=nmf_kwargs.get("init", "random"),
-                seed=int(p["nmf_seed"]),
+                seed=int(seed_r),
                 tol=nmf_kwargs.get("tol", 1e-4),
                 n_passes=n_passes_eff,
                 chunk_max_iter=nmf_kwargs.get("online_chunk_max_iter", _DEFAULT_CHUNK_MAX_ITER),
@@ -905,10 +1186,34 @@ class cNMF:
                 l1_ratio_H=nmf_kwargs.get("l1_ratio_H", 0.0),
                 n_orig=n_orig,
                 telemetry_sink=self._emit_replicates_event)
-            df = pd.DataFrame(spectra, index=np.arange(1, k + 1),
-                              columns=norm_counts.var.index)
-            save_df_to_npz(df, self.paths["iter_spectra"] % (k, p["iter"]),
-                           compress=False)
+            return np.asarray(spectra), err
+
+        if guard is None:
+            guard = resilience.ReplicateGuard(
+                events=self._events,
+                ledger_path=self.paths["resilience_ledger"] % int(worker_i))
+        for idx in jobs:
+            p = run_params.iloc[idx, :]
+            k, it = int(p["n_components"]), int(p["iter"])
+            spectra, err = _solve_rowshard(k, p["nmf_seed"])
+            sp3, errs = faults.maybe_poison_lanes(
+                k, [it], spectra[None], np.asarray([err]),
+                seeds=[int(p["nmf_seed"])])
+            healthy = guard.observe(
+                k, [it], [int(p["nmf_seed"])],
+                resilience.lane_health(errs, spectra=sp3))
+            if healthy[0]:
+                self._write_iter_spectra(k, it, sp3[0],
+                                         norm_counts.var.index)
+            faults.maybe_kill("factorize", worker_i)
+
+        def rerun_rowshard(k_r, seeds_r):
+            outs = [_solve_rowshard(k_r, s) for s in seeds_r]
+            return (np.stack([o[0] for o in outs]),
+                    np.asarray([o[1] for o in outs], np.float64))
+
+        self._finish_resilience(guard, rerun_rowshard, norm_counts.var.index,
+                                worker_i)
 
     def _factorize_2d(self, jobs, run_params, norm_counts, nmf_kwargs,
                       mesh, worker_i, replicates_per_batch=None):
@@ -1002,17 +1307,47 @@ class cNMF:
     def combine_nmf(self, k, skip_missing_files=False):
         """Stack per-iter spectra into the merged (n_iter*k x genes) matrix
         with ``iter%d_topic%d`` row labels (``cnmf.py:895-920``); tolerates
-        dead-worker gaps when ``skip_missing_files``."""
+        dead-worker gaps when ``skip_missing_files``.
+
+        Every loaded file is VALIDATED (loadable zip, k x n_genes shape,
+        finite values — ``runtime.resilience.load_spectra_checked``): a
+        torn npz from a killed pre-atomic-write worker, or any corrupt
+        copy, is treated exactly like a missing file under
+        ``skip_missing_files`` (warn + skip) instead of crashing
+        mid-combine; without the flag it raises with the reason up front.
+        Replicates the factorize guard QUARANTINED (resilience ledgers)
+        are deliberately absent and skip silently — no flag needed."""
         import concurrent.futures
         import errno
+
+        from ..runtime import resilience
 
         run_params = load_df_from_npz(self.paths["nmf_replicate_parameters"])
         print("Combining factorizations for k=%d." % k)
         subset = run_params[run_params.n_components == k].sort_values("iter")
 
+        quarantined = resilience.load_quarantined_tasks(
+            self.paths["resilience_ledger"])
+        n_genes = None
+        try:
+            with open(self.paths["nmf_genes_list"]) as f:
+                n_genes = len([ln for ln in f.read().split("\n") if ln])
+        except OSError:
+            pass  # factorize-only dirs may lack the genes list; shape-only
+
         def load_one(it):
             fn = self.paths["iter_spectra"] % (k, it)
+            # quarantine records can outlive the run that wrote them
+            # (worker-count changes leave other workers' ledgers behind):
+            # a record only suppresses the missing/invalid artifact it
+            # explains — a VALID artifact from a later healthy re-run
+            # always wins (one load doubles as that validation)
+            quarantined_here = (int(k), int(it)) in quarantined
             if not os.path.exists(fn):
+                if quarantined_here:
+                    print("Skipping quarantined replicate k=%d iter=%d "
+                          "(see the resilience ledger)." % (k, it))
+                    return None
                 if not skip_missing_files:
                     print("Missing file: %s, run with skip_missing=True to "
                           "override" % fn)
@@ -1020,7 +1355,23 @@ class cNMF:
                                             os.strerror(errno.ENOENT), fn)
                 print("Missing file: %s. Skipping." % fn)
                 return None
-            spectra = load_df_from_npz(fn)
+            try:
+                spectra = resilience.load_spectra_checked(fn, k=int(k),
+                                                          n_genes=n_genes)
+            except resilience.TornArtifactError as exc:
+                if quarantined_here:
+                    print("Skipping quarantined replicate k=%d iter=%d "
+                          "(see the resilience ledger)." % (k, it))
+                    return None
+                self._events.emit("fault", kind="torn_artifact",
+                                  context={"path": fn, "reason": str(exc)})
+                if not skip_missing_files:
+                    raise resilience.TornArtifactError(
+                        "%s — rerun `factorize --skip-completed-runs` to "
+                        "regenerate it, or combine with "
+                        "skip_missing_files=True to drop it" % exc) from exc
+                print("Corrupt file: %s. Skipping. (%s)" % (fn, exc))
+                return None
             spectra.index = ["iter%d_topic%d" % (it, t + 1)
                              for t in range(k)]
             return spectra

@@ -69,6 +69,7 @@ __all__ = [
     "fit_h",
     "beta_divergence",
     "init_factors",
+    "lane_health",
     "nndsvd_init",
     "BETA_LOSS",
     "SolverTelemetry",
@@ -109,6 +110,37 @@ class SolverTelemetry(typing.NamedTuple):
     trace: Any
     iters: Any
     nonfinite: Any
+
+
+def lane_health(errs, nonfinite=None, spectra=None):
+    """Per-lane solver health bitmap (True = healthy) — the always-on
+    promotion of the telemetry-only nonfinite latch (ISSUE 5).
+
+    Derived ON HOST from outputs every solver already returns: the final
+    per-lane objective recompute (``errs``). A lane whose factor state
+    went nonfinite cannot produce a finite final objective — NaN/inf
+    propagate absorbingly through the MU ratio chains and every
+    beta-divergence form touches every factor entry — so ``isfinite``
+    on the returned objective IS the health bit, with zero extra device
+    ops or transfers: the telemetry-off factorize programs stay
+    byte-identical to a build without this function.
+
+    ``nonfinite``: the :class:`SolverTelemetry` latch array, when the
+    sweep was traced with ``telemetry=True`` — folds in transient
+    mid-solve nonfinites that happened to recover by the final
+    evaluation. ``spectra``: optional (R, ...) stacked factor output for
+    a belt-and-braces host-side finiteness sweep over what will actually
+    be written to disk. Both accept device arrays (fetched here).
+    """
+    errs = np.asarray(errs, dtype=np.float64).reshape(-1)
+    health = np.isfinite(errs)
+    if nonfinite is not None:
+        health = health & ~np.asarray(nonfinite).astype(bool).reshape(-1)
+    if spectra is not None:
+        S = np.asarray(spectra)
+        health = health & np.isfinite(
+            S.reshape(S.shape[0], -1)).all(axis=1)
+    return health
 
 
 def beta_loss_to_float(beta_loss) -> float:

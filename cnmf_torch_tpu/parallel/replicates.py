@@ -30,6 +30,7 @@ from ..ops.nmf import (
     _chunk_rows,
     beta_loss_to_float,
     bundle_width,
+    lane_health,
     nmf_fit_batch,
     nmf_fit_batch_bundled,
     nmf_fit_online,
@@ -76,7 +77,12 @@ def _telemetry_requested(telemetry_sink) -> bool:
 
 __all__ = ["replicate_sweep", "replicate_sweep_packed", "worker_filter",
            "default_mesh", "auto_replicates_per_batch", "clear_sweep_cache",
-           "warm_sweep_programs"]
+           "warm_sweep_programs", "lane_health"]
+# lane_health (ops/nmf.py) is re-exported here as the sweep-level health
+# surface: callers grade the per-replicate outputs of replicate_sweep /
+# replicate_sweep_packed with it (errs + optional telemetry latch) —
+# computed on host from outputs the sweeps already fetch, so the
+# telemetry-off programs stay byte-identical (ISSUE 5).
 
 
 def worker_filter(iterable, worker_index: int, total_workers: int):

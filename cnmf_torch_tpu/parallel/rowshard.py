@@ -37,6 +37,7 @@ from .streaming import (
 from ..ops.nmf import (
     EPS,
     TRACE_LEN,
+    lane_health,  # noqa: F401  (re-export: per-solve health surface)
     resolve_online_schedule,
     _apply_rate,
     mu_gamma,
@@ -60,7 +61,7 @@ from ..ops.sparse import (
 
 __all__ = ["nmf_fit_rowsharded", "fit_h_rowsharded", "refit_w_rowsharded",
            "pad_rows_to_mesh", "stream_rows_to_mesh", "stream_ell_to_mesh",
-           "prepare_rowsharded"]
+           "prepare_rowsharded", "lane_health"]
 
 
 def pad_rows_to_mesh(X, multiple: int):
@@ -101,6 +102,9 @@ def stream_rows_to_mesh(X, mesh: Mesh, axis: str, dtype=jnp.float32,
     ``CNMF_TPU_STREAM_DEPTH``. Pass ``stats`` to collect per-phase
     host_prep/H2D/device walls and bytes.
     """
+    from ..runtime.faults import maybe_fail
+
+    maybe_fail("upload", context="stream_rows_to_mesh")
     n_shards = dict(mesh.shape)[axis]
     multiple = int(pad_multiple) if pad_multiple else n_shards
     if multiple % n_shards:

@@ -62,6 +62,8 @@ import jax.numpy as jnp
 import numpy as np
 import scipy.sparse as sp
 
+from ..runtime.faults import maybe_fail as _maybe_fail_fault
+
 __all__ = ["StreamStats", "SlabBufferPool", "run_pipeline", "nnz_bucket",
            "stream_threads", "stream_depth", "stream_to_device",
            "stream_put_leaves", "DENSIFY_SLAB_ROWS"]
@@ -594,6 +596,9 @@ def stream_to_device(X, device=None, dtype=jnp.float32,
     and densify on device (the full dense matrix never exists on host —
     the ``cNMF._stage_dense`` contract at atlas sparsity), dense inputs
     upload slab-wise with conversion off the caller thread."""
+    # fault-injection hook (runtime/faults.py): an `upload` clause makes
+    # this staging entry raise, exercising failed-transfer containment
+    _maybe_fail_fault("upload", context="stream_to_device")
     if device is None:
         device = jax.local_devices()[0]
     sharding = jax.sharding.SingleDeviceSharding(device)

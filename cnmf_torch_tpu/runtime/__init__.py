@@ -1,0 +1,15 @@
+"""Execution-resilience runtime: fault injection + quarantine/retry.
+
+Two modules, imported explicitly by their consumers (this package pulls
+in no heavy dependencies at import time):
+
+  * :mod:`.faults` — the deterministic fault-injection harness behind
+    ``CNMF_TPU_FAULT_SPEC`` (NaN replicate lanes, worker SIGKILL, torn
+    artifact files, failed device uploads). Stdlib-only; every hook is a
+    no-op when the spec is unset.
+  * :mod:`.resilience` — the recovery layer: per-replicate health
+    evaluation, quarantine + reseeded retry bookkeeping
+    (``ReplicateGuard``), torn-artifact validation for resume/combine,
+    and the ``CNMF_TPU_MAX_RETRIES`` / ``CNMF_TPU_MIN_HEALTHY_FRAC``
+    policy knobs.
+"""

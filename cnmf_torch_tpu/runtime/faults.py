@@ -1,0 +1,304 @@
+"""Deterministic fault injection behind ``CNMF_TPU_FAULT_SPEC``.
+
+Every failure mode the resilience layer claims to survive must be
+reproducible on demand, or the recovery paths rot untested (the
+chaos-engineering argument MPI-FAUN-scale NMF deployments make for
+first-class failure containment, PAPERS.md). This module turns an env
+spec into injected faults at fixed hook points in the pipeline:
+
+  * ``nonfinite`` — poison replicate lanes with NaN after a sweep
+    returns (exercises quarantine + reseeded retry);
+  * ``kill`` — SIGKILL this process at a stage hook (exercises launcher
+    respawn + torn-artifact-proof resume);
+  * ``torn`` — truncate an artifact file AFTER its atomic write lands
+    (exercises reader-side validation: resume and combine must detect
+    the damage rather than trust the file);
+  * ``upload`` — raise from a host→device staging entry point.
+
+Spec grammar (semicolon-separated clauses)::
+
+    CNMF_TPU_FAULT_SPEC="nonfinite:k=5,iter=2;kill:stage=factorize,worker=1;torn:artifact=iter_"
+
+Each clause is ``kind`` or ``kind:key=val[,key=val...]``. Selector keys
+(``k``, ``iter``, ``attempt``, ``stage``, ``worker``, ``artifact``,
+``context``) narrow where the fault fires; control keys modulate it:
+``after=N`` skips the first N matching hook hits, ``limit=N`` caps
+injections per process (torn only; default 1), and ``once=PATH`` claims
+a filesystem sentinel with O_CREAT|O_EXCL so exactly ONE process ever
+injects the clause (a respawned worker must not re-kill itself).
+
+Unset/empty spec: every hook returns immediately after one cached dict
+lookup — zero allocation, no behavior or trace changes anywhere. The
+module is stdlib-only (no jax/numpy at import) so IO-layer hooks stay
+cheap to import.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+
+__all__ = [
+    "FAULT_SPEC_ENV",
+    "FaultClause",
+    "parse_fault_spec",
+    "active_spec",
+    "maybe_poison_lanes",
+    "maybe_kill",
+    "maybe_tear",
+    "maybe_fail",
+]
+
+FAULT_SPEC_ENV = "CNMF_TPU_FAULT_SPEC"
+
+_KINDS = ("nonfinite", "kill", "torn", "upload")
+_CONTROL_KEYS = ("after", "limit", "once")
+
+
+class FaultClause:
+    """One parsed clause: ``kind`` + params + per-process hit counters.
+    Counter state lives on the clause object, and parsed specs are cached
+    per raw string, so ``after``/``limit`` semantics survive repeated
+    hook calls without re-parsing the env on every hit."""
+
+    __slots__ = ("kind", "params", "hits", "injected")
+
+    def __init__(self, kind: str, params: dict):
+        self.kind = kind
+        self.params = params
+        self.hits = 0
+        self.injected = 0
+
+    def __repr__(self):
+        return f"FaultClause({self.kind!r}, {self.params!r})"
+
+
+def parse_fault_spec(raw: str) -> list[FaultClause]:
+    """Parse a spec string; raises ``ValueError`` on malformed input so a
+    typo'd chaos run fails loudly instead of silently injecting nothing."""
+    clauses = []
+    for part in raw.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        kind, _, rest = part.partition(":")
+        kind = kind.strip()
+        if kind not in _KINDS:
+            raise ValueError(
+                f"{FAULT_SPEC_ENV}: unknown fault kind {kind!r} in "
+                f"{part!r} (known: {', '.join(_KINDS)})")
+        params: dict = {}
+        for kv in rest.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            key, sep, val = kv.partition("=")
+            if not sep or not key:
+                raise ValueError(
+                    f"{FAULT_SPEC_ENV}: expected key=value, got {kv!r} "
+                    f"in clause {part!r}")
+            val = val.strip()
+            params[key.strip()] = int(val) if val.lstrip("-").isdigit() \
+                else val
+        clauses.append(FaultClause(kind, params))
+    return clauses
+
+
+# parsed-spec cache keyed on the raw env value: hook sites call
+# active_spec() on every hit, so toggling the env mid-process (tests,
+# chaos drivers) re-parses exactly once per distinct value while the
+# steady state costs one getenv + one string compare
+_cache: tuple[str, list[FaultClause]] | None = None
+
+
+def active_spec() -> list[FaultClause] | None:
+    global _cache
+    raw = os.environ.get(FAULT_SPEC_ENV, "")
+    if not raw.strip():
+        return None
+    if _cache is None or _cache[0] != raw:
+        _cache = (raw, parse_fault_spec(raw))
+    return _cache[1]
+
+
+def _take_once(params: dict) -> bool:
+    """Claim the clause's ``once`` sentinel; True when this process may
+    inject. A single O_CREAT|O_EXCL open is the atomic cross-process
+    claim — the second claimant (e.g. a respawned worker) loses."""
+    path = params.get("once")
+    if path is None:
+        return True
+    try:
+        os.close(os.open(str(path), os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+        return True
+    except FileExistsError:
+        return False
+    except OSError:
+        return False
+
+
+def _selector_match(params: dict, ctx: dict) -> bool:
+    for key, want in params.items():
+        if key in _CONTROL_KEYS:
+            continue
+        if key not in ctx:
+            return False
+        have = ctx[key]
+        if isinstance(want, int):
+            try:
+                if int(have) != want:
+                    return False
+            except (TypeError, ValueError):
+                return False
+        elif str(want) != str(have):
+            return False
+    return True
+
+
+def maybe_poison_lanes(k, iters, spectra, errs, attempt: int = 0,
+                       seeds=None):
+    """NaN-poison replicate lanes matching a ``nonfinite`` clause.
+
+    ``spectra``/``errs`` are the fetched numpy results of one sweep (lane
+    axis first); matching lanes get both set to NaN, exactly what a
+    diverged MU chain produces. Clause selectors: ``k`` (sweep K),
+    ``iter`` (a single ledger iter; omitted = every lane), ``attempt``
+    (default 0 — retries run clean so recovery is observable). Returns
+    possibly-copied ``(spectra, errs)``; the unset-spec path returns the
+    inputs untouched."""
+    spec = active_spec()
+    if spec is None:
+        return spectra, errs
+    import numpy as np
+
+    lanes = []
+    for clause in spec:
+        if clause.kind != "nonfinite":
+            continue
+        params = clause.params
+        if int(params.get("attempt", 0)) != int(attempt):
+            continue
+        if "k" in params and int(params["k"]) != int(k):
+            continue
+        if "iter" in params:
+            clause_lanes = [j for j, it in enumerate(iters)
+                            if int(it) == int(params["iter"])]
+        elif "seed" in params:
+            # a seed selector at a hook site without seed info is a
+            # NO-MATCH, not match-everything: poisoning every lane would
+            # misattribute a whole-sweep failure to a one-lane spec
+            clause_lanes = ([] if seeds is None else
+                            [j for j, s in enumerate(seeds)
+                             if int(s) == int(params["seed"])])
+        else:
+            clause_lanes = list(range(len(iters)))
+        if not clause_lanes:
+            continue
+        # the shared control keys apply here like every other hook: one
+        # matching sweep observation = one hit; `limit` caps injections
+        # per process (default unbounded — a spec without controls keeps
+        # poisoning every matching sweep), `once` is the cross-process
+        # single-injection sentinel
+        clause.hits += 1
+        if clause.hits <= int(params.get("after", 0)):
+            continue
+        if "limit" in params and clause.injected >= int(params["limit"]):
+            continue
+        if not _take_once(params):
+            continue
+        clause.injected += 1
+        lanes.extend(clause_lanes)
+    if not lanes:
+        return spectra, errs
+    spectra = np.array(spectra, dtype=np.float32, copy=True)
+    errs = np.array(errs, dtype=np.float64, copy=True)
+    for j in set(lanes):
+        spectra[j] = np.nan
+        errs[j] = np.nan
+    return spectra, errs
+
+
+def maybe_kill(stage: str, worker=None) -> None:
+    """SIGKILL this process when a ``kill`` clause matches the hook —
+    the real preemption signal, not an exception anything can catch.
+    Hooks sit AFTER artifact writes land, so the torn/partial state a
+    kill leaves behind is exactly what a real preemption leaves."""
+    spec = active_spec()
+    if spec is None:
+        return
+    for clause in spec:
+        if clause.kind != "kill":
+            continue
+        if not _selector_match(clause.params,
+                               {"stage": stage, "worker": worker}):
+            continue
+        clause.hits += 1
+        if clause.hits <= int(clause.params.get("after", 0)):
+            continue
+        if not _take_once(clause.params):
+            continue
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_tear(path) -> bool:
+    """Truncate ``path`` (to ~1/3 of its bytes) when a ``torn`` clause's
+    ``artifact`` substring matches its basename — a simulated mid-write
+    kill that predates the atomic-write layer, kept injectable so the
+    READER-side validation (resume probing, combine) stays tested.
+    ``limit`` caps injections per clause (default 1). Returns True when
+    the file was torn."""
+    spec = active_spec()
+    if spec is None:
+        return False
+    name = os.path.basename(os.fspath(path))
+    for clause in spec:
+        if clause.kind != "torn":
+            continue
+        sub = str(clause.params.get("artifact", ""))
+        if sub and sub not in name:
+            continue
+        clause.hits += 1
+        if clause.hits <= int(clause.params.get("after", 0)):
+            continue
+        if clause.injected >= int(clause.params.get("limit", 1)):
+            continue
+        if not _take_once(clause.params):
+            continue
+        try:
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.truncate(max(1, size // 3))
+            clause.injected += 1
+            return True
+        except OSError:
+            return False
+    return False
+
+
+def maybe_fail(kind: str, **ctx) -> None:
+    """Raise ``RuntimeError`` when a clause of ``kind`` matches ``ctx``
+    (used for the ``upload`` fault class at staging entry points)."""
+    spec = active_spec()
+    if spec is None:
+        return
+    for clause in spec:
+        if clause.kind != kind:
+            continue
+        params = clause.params
+        # `context` selects by substring so one clause can target e.g.
+        # every rowshard staging call without naming each site
+        sub = params.get("context")
+        if sub is not None and str(sub) not in str(ctx.get("context", "")):
+            continue
+        rest = {key: val for key, val in params.items()
+                if key not in _CONTROL_KEYS and key != "context"}
+        if not _selector_match(rest, ctx):
+            continue
+        clause.hits += 1
+        if clause.hits <= int(params.get("after", 0)):
+            continue
+        if not _take_once(params):
+            continue
+        raise RuntimeError(
+            f"cnmf-tpu injected fault: {kind} "
+            f"({', '.join(f'{key}={val}' for key, val in sorted(ctx.items()))})")

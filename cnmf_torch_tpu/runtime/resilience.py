@@ -1,0 +1,418 @@
+"""Replicate quarantine, reseeded retry, and torn-artifact validation.
+
+cNMF's statistical robustness (n_iter seeded replicates per K, consensus
+over their spectra — PAPER.md) assumed operational robustness it never
+had: a replicate whose MU chain went nonfinite used to pollute the merged
+spectra silently, and a preempted worker could leave truncated artifact
+files that resume then trusted. This module is the recovery policy layer:
+
+  * :func:`lane_health` (re-exported from ``ops.nmf``) grades every
+    replicate of a sweep from outputs the solvers already return — no
+    program changes when telemetry is off.
+  * :class:`ReplicateGuard` books unhealthy lanes for retry with
+    deterministically derived seeds (:func:`derive_retry_seed`:
+    ``seed XOR attempt`` — reproducible on resume without any state),
+    quarantines lanes that exhaust ``CNMF_TPU_MAX_RETRIES``, writes the
+    per-worker resilience ledger, emits telemetry ``fault`` events, and
+    enforces ``CNMF_TPU_MIN_HEALTHY_FRAC`` per K (degrade gracefully
+    above it, hard-fail with a clear error below).
+  * :func:`load_spectra_checked` / :func:`probe_spectra_file` — the ONE
+    definition of "is this replicate artifact trustworthy", shared by
+    ``--skip-completed-runs`` resume and ``combine_nmf`` so a torn npz
+    can never be mistaken for a completed run on either path.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import warnings
+
+import numpy as np
+
+from ..ops.nmf import lane_health  # noqa: F401  (re-export: ONE definition)
+
+__all__ = [
+    "MAX_RETRIES_ENV",
+    "MIN_HEALTHY_FRAC_ENV",
+    "max_retries",
+    "min_healthy_frac",
+    "derive_retry_seed",
+    "lane_health",
+    "TornArtifactError",
+    "UnhealthySweepError",
+    "UNHEALTHY_EXIT_CODE",
+    "load_spectra_checked",
+    "probe_spectra_file",
+    "ReplicateGuard",
+    "load_quarantined_tasks",
+    "load_quarantine_records",
+    "sweep_stale_ledgers",
+]
+
+MAX_RETRIES_ENV = "CNMF_TPU_MAX_RETRIES"
+MIN_HEALTHY_FRAC_ENV = "CNMF_TPU_MIN_HEALTHY_FRAC"
+
+_DEFAULT_MAX_RETRIES = 2
+_DEFAULT_MIN_HEALTHY_FRAC = 0.8
+
+
+class TornArtifactError(RuntimeError):
+    """A replicate artifact exists but cannot be trusted (unreadable,
+    truncated, wrong shape, or nonfinite)."""
+
+
+class UnhealthySweepError(RuntimeError):
+    """Too few healthy replicates survived for a K after retries —
+    consensus over the remainder would be statistically meaningless."""
+
+
+# process exit code the CLI uses for UnhealthySweepError: the launcher
+# must distinguish "below the min-healthy-frac floor" (deterministic
+# policy failure — respawning reruns the same derived seeds and fails
+# identically, and falling back to skip-missing combine would produce
+# exactly the degraded consensus the floor exists to prevent) from a
+# crash/preemption (respawn + degrade is right). 1 is any uncaught
+# exception, 2 is argparse's usage-error code.
+UNHEALTHY_EXIT_CODE = 3
+
+
+def max_retries() -> int:
+    """Retry budget per unhealthy replicate (``CNMF_TPU_MAX_RETRIES``,
+    default 2; 0 disables retries — unhealthy lanes quarantine
+    immediately)."""
+    try:
+        return max(0, int(os.environ.get(MAX_RETRIES_ENV,
+                                         _DEFAULT_MAX_RETRIES)))
+    except ValueError:
+        raise ValueError(
+            f"{MAX_RETRIES_ENV}={os.environ[MAX_RETRIES_ENV]!r}: "
+            "expected a non-negative integer")
+
+
+def min_healthy_frac() -> float:
+    """Per-K survival floor (``CNMF_TPU_MIN_HEALTHY_FRAC``, default 0.8):
+    consensus proceeds while at least this fraction of a K's replicates
+    end healthy; below it factorize hard-fails.
+
+    Scope: evaluated over the replicates THIS WORKER's ledger shard owns
+    (workers are independent processes and cannot see each other's
+    outcomes until combine). With one worker — the common case — shard
+    and global coincide; with many thin shards, size the floor against
+    the per-shard replicate count (e.g. a 3-replicate shard quantizes to
+    thirds)."""
+    raw = os.environ.get(MIN_HEALTHY_FRAC_ENV)
+    if raw is None:
+        return _DEFAULT_MIN_HEALTHY_FRAC
+    try:
+        val = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{MIN_HEALTHY_FRAC_ENV}={raw!r}: expected a float in [0, 1]")
+    if not 0.0 <= val <= 1.0:
+        raise ValueError(
+            f"{MIN_HEALTHY_FRAC_ENV}={raw!r}: expected a float in [0, 1]")
+    return val
+
+
+def derive_retry_seed(seed: int, attempt: int) -> int:
+    """Deterministic retry seed for attempt N >= 1: ``seed XOR attempt``,
+    masked to the ledger's 31-bit seed domain. Derivable from the ledger
+    seed alone, so an interrupted-and-resumed run retries with the exact
+    seeds the uninterrupted run would have used (the ledger sidecar
+    records them anyway, for auditability). Under the threefry PRNG two
+    keys differing in one bit yield statistically independent streams, so
+    the retried replicate is a genuinely fresh draw."""
+    if int(attempt) < 1:
+        raise ValueError(f"retry attempts start at 1, got {attempt}")
+    return (int(seed) ^ int(attempt)) & 0x7FFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# artifact validation (shared by resume and combine)
+# ---------------------------------------------------------------------------
+
+def load_spectra_checked(path, k: int | None = None,
+                         n_genes: int | None = None):
+    """Load a per-replicate spectra npz, validating it is COMPLETE: the
+    zip opens, all three members parse, the matrix is 2-D with ``k`` rows
+    (and ``n_genes`` columns when known), labels match the data shape,
+    and every value is finite. Raises :class:`TornArtifactError`
+    otherwise — a SIGKILL mid-write, a truncated copy, or a quarantine-
+    worthy nonfinite replicate all land here. Returns the DataFrame."""
+    import pandas as pd
+
+    try:
+        with np.load(path, allow_pickle=True) as f:
+            data = np.asarray(f["data"])
+            index = np.asarray(f["index"])
+            columns = np.asarray(f["columns"])
+    except Exception as exc:
+        raise TornArtifactError(
+            f"{path}: unreadable replicate artifact "
+            f"({type(exc).__name__}: {exc})")
+    if data.ndim != 2:
+        raise TornArtifactError(
+            f"{path}: expected a 2-D spectra matrix, got ndim={data.ndim}")
+    if k is not None and data.shape[0] != int(k):
+        raise TornArtifactError(
+            f"{path}: expected {int(k)} component rows, got {data.shape[0]}")
+    if n_genes is not None and data.shape[1] != int(n_genes):
+        raise TornArtifactError(
+            f"{path}: expected {int(n_genes)} gene columns, "
+            f"got {data.shape[1]}")
+    if len(index) != data.shape[0] or len(columns) != data.shape[1]:
+        raise TornArtifactError(
+            f"{path}: label arrays ({len(index)}, {len(columns)}) do not "
+            f"match the data shape {data.shape}")
+    try:
+        finite = bool(np.isfinite(data).all())
+    except (TypeError, ValueError) as exc:
+        raise TornArtifactError(f"{path}: non-numeric spectra data ({exc})")
+    if not finite:
+        raise TornArtifactError(f"{path}: nonfinite spectra values")
+    return pd.DataFrame(data, index=index, columns=columns)
+
+
+def probe_spectra_file(path, k: int | None = None,
+                       n_genes: int | None = None) -> str | None:
+    """Resume-side probe: ``None`` when the artifact is present AND
+    valid, ``"missing"`` when absent, else the torn-artifact reason
+    string. ``--skip-completed-runs`` treats anything non-None as
+    incomplete — a half-written file is rerun, never trusted."""
+    if not os.path.exists(path):
+        return "missing"
+    try:
+        load_spectra_checked(path, k=k, n_genes=n_genes)
+        return None
+    except TornArtifactError as exc:
+        return str(exc)
+
+
+# ---------------------------------------------------------------------------
+# quarantine + retry bookkeeping
+# ---------------------------------------------------------------------------
+
+class ReplicateGuard:
+    """Per-factorize health bookkeeping: observe sweep results, queue
+    retries, quarantine exhausted lanes, persist the resilience ledger,
+    and enforce the min-healthy-frac floor.
+
+    The guard is execution-path-agnostic: every factorize path (batched
+    per-K, packed, ELL, row-sharded, sequential) reports through
+    :meth:`observe` and the retry waves re-solve through a caller-
+    supplied ``rerun`` closure, so quarantine/retry semantics cannot
+    drift between solver families. Accounting is per worker — each
+    worker only ever sees (and can only rerun) its own ledger shard.
+    """
+
+    def __init__(self, events=None, ledger_path: str | None = None,
+                 max_retries_: int | None = None,
+                 min_healthy_frac_: float | None = None):
+        self.events = events
+        self.ledger_path = ledger_path
+        self.max_retries = (max_retries() if max_retries_ is None
+                            else int(max_retries_))
+        self.min_healthy_frac = (min_healthy_frac()
+                                 if min_healthy_frac_ is None
+                                 else float(min_healthy_frac_))
+        self._totals: dict[int, int] = {}
+        self._healthy: dict[int, int] = {}
+        self._pending: list[dict] = []
+        self.retries: list[dict] = []
+        self.quarantined: list[dict] = []
+
+    def _emit(self, kind: str, context: dict):
+        if self.events is not None:
+            self.events.emit("fault", kind=kind, context=context)
+
+    def observe(self, k: int, iters, seeds, health, attempt: int = 0,
+                derived_seeds=None) -> np.ndarray:
+        """Record one sweep's (or retry wave's) per-lane health. Returns
+        the boolean healthy mask (callers write artifacts for healthy
+        lanes only). Unhealthy lanes enqueue a retry at ``attempt + 1``
+        while the budget lasts, else quarantine. ``seeds`` are always the
+        ORIGINAL ledger seeds — retry seeds are re-derived from them, so
+        resume retries reproduce interrupted ones."""
+        k = int(k)
+        health = np.asarray(health, dtype=bool).reshape(-1)
+        if len(health) != len(list(iters)):
+            raise ValueError(
+                f"health mask has {len(health)} lanes for {len(list(iters))}"
+                " tasks")
+        if attempt == 0:
+            self._totals[k] = self._totals.get(k, 0) + len(health)
+        for j, ok in enumerate(health):
+            it, seed = int(iters[j]), int(seeds[j])
+            if attempt > 0:
+                rec = {"k": k, "iter": it, "seed": seed,
+                       "attempt": int(attempt),
+                       "derived_seed": int(derived_seeds[j]),
+                       "healthy": bool(ok)}
+                self.retries.append(rec)
+                self._emit("retry", rec)
+            if ok:
+                self._healthy[k] = self._healthy.get(k, 0) + 1
+                continue
+            ctx = {"k": k, "iter": it, "seed": seed, "attempt": int(attempt)}
+            self._emit("nonfinite_replicate", ctx)
+            if attempt < self.max_retries:
+                self._pending.append({"k": k, "iter": it, "seed": seed,
+                                      "attempt": int(attempt) + 1})
+            else:
+                rec = dict(ctx, attempts=int(attempt))
+                self.quarantined.append(rec)
+                self._emit("quarantine", rec)
+                warnings.warn(
+                    "replicate k=%d iter=%d (seed %d) quarantined after "
+                    "%d attempt(s): solver output nonfinite. It is excluded "
+                    "from combine; raise %s to retry more."
+                    % (k, it, seed, int(attempt) + 1, MAX_RETRIES_ENV),
+                    RuntimeWarning, stacklevel=2)
+        return health
+
+    def take_pending(self) -> list[dict]:
+        """Pop the queued retry tasks (one wave — all share one attempt
+        number, since waves are processed synchronously)."""
+        pending, self._pending = self._pending, []
+        return pending
+
+    def credit_existing(self, k: int, n: int):
+        """Count ``n`` replicates of K that are already VALID on disk and
+        skipped by a resume. Without this credit the min-healthy-frac
+        floor would be evaluated over only the session's rerun subset —
+        a resume that reruns 1 of 10 replicates and quarantines it would
+        spuriously hard-fail at 0/1 when the K is really 9/10 healthy."""
+        k = int(k)
+        self._totals[k] = self._totals.get(k, 0) + int(n)
+        self._healthy[k] = self._healthy.get(k, 0) + int(n)
+
+    def carry_quarantined(self, k: int, it: int, seed: int,
+                          attempts: int | None = None):
+        """Re-record a still-unresolved quarantine from a previous run's
+        ledger during a resume that does NOT rerun the lane: it counts
+        toward the K's total (not healthy) so the floor reflects the true
+        state, and it rides into this session's ledger rewrite so the
+        quarantine record (and combine's exclusion) survives the resume.
+        ``attempts`` preserves the original record's exhausted budget, so
+        a later resume under a RAISED ``CNMF_TPU_MAX_RETRIES`` can still
+        tell the lane has retries left."""
+        k = int(k)
+        self._totals[k] = self._totals.get(k, 0) + 1
+        rec = {"k": k, "iter": int(it), "seed": int(seed), "carried": True}
+        if attempts is not None:
+            rec["attempts"] = int(attempts)
+        self.quarantined.append(rec)
+
+    def record_torn(self, path: str, reason: str):
+        self._emit("torn_artifact", {"path": str(path), "reason": reason})
+
+    def finalize(self):
+        """Persist the resilience ledger (when anything happened) and
+        enforce the per-K survival floor. Raises
+        :class:`UnhealthySweepError` when any K ends below
+        ``min_healthy_frac`` — consensus over too few replicates is
+        worse than a loud failure."""
+        if self._pending:
+            # defensive: a caller that skipped the retry waves must not
+            # silently drop unhealthy lanes on the floor
+            for t in self.take_pending():
+                rec = {"k": t["k"], "iter": t["iter"], "seed": t["seed"],
+                       "attempts": t["attempt"] - 1}
+                self.quarantined.append(rec)
+                self._emit("quarantine", rec)
+        if self.ledger_path:
+            if self.retries or self.quarantined:
+                from ..utils.anndata_lite import atomic_artifact
+
+                payload = {"schema": 1,
+                           "max_retries": self.max_retries,
+                           "min_healthy_frac": self.min_healthy_frac,
+                           "retries": self.retries,
+                           "quarantined": self.quarantined}
+                with atomic_artifact(self.ledger_path) as tmp:
+                    with open(tmp, "w") as f:
+                        json.dump(payload, f, indent=1)
+            elif os.path.exists(self.ledger_path):
+                # a clean pass supersedes any previous run's quarantine
+                # records for this worker's shard — a stale ledger would
+                # make combine silently drop now-healthy replicates
+                os.unlink(self.ledger_path)
+        bad = []
+        for k, total in sorted(self._totals.items()):
+            frac = self._healthy.get(k, 0) / max(total, 1)
+            if frac < self.min_healthy_frac:
+                bad.append((k, frac, total))
+        if bad:
+            detail = "; ".join(
+                "k=%d: %.0f%% of %d replicates healthy" % (k, 100 * f, t)
+                for k, f, t in bad)
+            raise UnhealthySweepError(
+                "factorize: too few healthy replicates after %d retry "
+                "attempt(s) — %s (floor %s=%.2f, evaluated over this "
+                "worker's ledger shard). Consensus over so few survivors "
+                "would be unreliable; inspect the solver inputs "
+                "(nonfinite counts? pathological scaling?), or lower the "
+                "floor explicitly to accept the degraded sweep."
+                % (self.max_retries, detail, MIN_HEALTHY_FRAC_ENV,
+                   self.min_healthy_frac))
+
+
+def load_quarantine_records(
+        ledger_path_template: str) -> dict[tuple[int, int], int | None]:
+    """Quarantined ``(k, iter) -> exhausted attempt count`` across every
+    worker's resilience ledger (``...resilience.w*.json``); ``None`` when
+    a record carries no attempt count. Resume uses the attempts to honor
+    a RAISED ``CNMF_TPU_MAX_RETRIES`` (a record exhausted at 2 attempts
+    is not final under a budget of 5)."""
+    out: dict[tuple[int, int], int | None] = {}
+    for path in glob.glob(str(ledger_path_template).replace("%d", "*")):
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+            for rec in payload.get("quarantined", []):
+                key = (int(rec["k"]), int(rec["iter"]))
+                att = rec.get("attempts")
+                att = None if att is None else int(att)
+                # several ledgers may mention one lane: a known attempt
+                # count beats unknown, larger beats smaller
+                if key not in out:
+                    out[key] = att
+                elif att is not None and (out[key] is None
+                                          or att > out[key]):
+                    out[key] = att
+        except (OSError, ValueError, KeyError, TypeError):
+            warnings.warn(
+                f"unreadable resilience ledger {path}; its quarantine "
+                "records are ignored", RuntimeWarning, stacklevel=2)
+    return out
+
+
+def load_quarantined_tasks(ledger_path_template: str) -> set[tuple[int, int]]:
+    """Union of quarantined ``(k, iter)`` pairs across every worker's
+    resilience ledger: combine treats these as deliberately absent — no
+    warning, no skip flag needed — instead of crashing on their missing
+    artifacts."""
+    return set(load_quarantine_records(ledger_path_template))
+
+
+def sweep_stale_ledgers(ledger_path_template: str, total_workers: int):
+    """Delete resilience ledgers whose worker index is outside the
+    current fleet (a previous run with more workers left them; no live
+    process owns those indices, and in-range ledgers are rewritten or
+    removed by their own worker's finalize). Called by worker 0 at the
+    start of a FRESH (non-resume) factorize — a fresh run recomputes
+    every replicate, so prior quarantine records are void."""
+    import re
+
+    pattern = str(ledger_path_template).replace("%d", "*")
+    rx = re.compile(re.escape(str(ledger_path_template)).replace(
+        re.escape("%d"), r"(\d+)") + "$")
+    for path in glob.glob(pattern):
+        m = rx.match(path)
+        if m and int(m.group(1)) >= int(total_workers):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass

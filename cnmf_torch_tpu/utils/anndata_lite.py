@@ -14,13 +14,35 @@ Only the features the cNMF pipeline needs are implemented: ``X``, ``obs``,
 
 from __future__ import annotations
 
+import contextlib
 import os
 
 import numpy as np
 import pandas as pd
 import scipy.sparse as sp
 
-__all__ = ["AnnDataLite", "read_h5ad", "write_h5ad"]
+__all__ = ["AnnDataLite", "read_h5ad", "write_h5ad", "atomic_artifact"]
+
+
+@contextlib.contextmanager
+def atomic_artifact(filename):
+    """Crash-safe artifact write: yield a same-directory temp path for the
+    caller to write, then ``os.replace`` it onto ``filename`` — readers
+    see either the old complete file or the new complete file, never a
+    torn intermediate (the invariant ``--skip-completed-runs`` and
+    ``combine`` rely on). A SIGKILL mid-write costs only an orphaned
+    pid-suffixed temp file — never picked up by any reader, and swept by
+    the launcher's ``--clean`` pass (a successor process has a different
+    pid, so it does NOT overwrite the orphan). On any exception the temp
+    file is removed and nothing is renamed."""
+    filename = os.fspath(filename)
+    tmp = filename + ".tmp-%d" % os.getpid()
+    try:
+        yield tmp
+        os.replace(tmp, filename)
+    finally:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
 
 
 class AnnDataLite:
@@ -205,20 +227,26 @@ def _write_X(parent, X):
 def write_h5ad(filename: str, adata: AnnDataLite):
     import h5py
 
-    with h5py.File(filename, "w") as f:
-        f.attrs["encoding-type"] = "anndata"
-        f.attrs["encoding-version"] = "0.1.0"
-        _write_X(f, adata.X)
-        _write_dataframe(f, "obs", adata.obs)
-        _write_dataframe(f, "var", adata.var)
-        for aux in ("uns", "obsm", "varm", "obsp", "varp", "layers"):
-            g = f.create_group(aux)
-            g.attrs["encoding-type"] = "dict"
-            g.attrs["encoding-version"] = "0.1.0"
-        for key, val in getattr(adata, "obsm", {}).items():
-            ds = f["obsm"].create_dataset(key, data=np.asarray(val))
-            ds.attrs["encoding-type"] = "array"
-            ds.attrs["encoding-version"] = "0.2.0"
+    from ..runtime.faults import maybe_tear
+
+    # atomic (temp + os.replace): a worker killed mid-write must never
+    # leave a truncated HDF5 that a later pipeline stage half-reads
+    with atomic_artifact(filename) as tmp:
+        with h5py.File(tmp, "w") as f:
+            f.attrs["encoding-type"] = "anndata"
+            f.attrs["encoding-version"] = "0.1.0"
+            _write_X(f, adata.X)
+            _write_dataframe(f, "obs", adata.obs)
+            _write_dataframe(f, "var", adata.var)
+            for aux in ("uns", "obsm", "varm", "obsp", "varp", "layers"):
+                g = f.create_group(aux)
+                g.attrs["encoding-type"] = "dict"
+                g.attrs["encoding-version"] = "0.1.0"
+            for key, val in getattr(adata, "obsm", {}).items():
+                ds = f["obsm"].create_dataset(key, data=np.asarray(val))
+                ds.attrs["encoding-type"] = "array"
+                ds.attrs["encoding-version"] = "0.2.0"
+    maybe_tear(filename)  # fault harness: no-op unless CNMF_TPU_FAULT_SPEC
 
 
 def _decode(v):

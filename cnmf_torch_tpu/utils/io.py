@@ -21,12 +21,14 @@ import numpy as np
 import pandas as pd
 import scipy.sparse as sp
 
-from .anndata_lite import AnnDataLite, read_h5ad, write_h5ad
+from ..runtime.faults import maybe_tear
+from .anndata_lite import AnnDataLite, atomic_artifact, read_h5ad, write_h5ad
 
 __all__ = [
     "save_df_to_npz",
     "save_df_to_text",
     "load_df_from_npz",
+    "atomic_artifact",
     "check_dir_exists",
     "read_10x_mtx",
     "load_counts",
@@ -46,20 +48,32 @@ def save_df_to_npz(obj: pd.DataFrame, filename: str, compress: bool | None = Non
     reference but STORES matrices over 2 MB: single-threaded deflate on a
     merged-spectra matrix costs ~20x its write time for ~6% size (dense
     f64 spectra barely compress), and combine's wall was mostly zlib.
+
+    Atomic: the bytes land in a same-directory temp file and ``os.replace``
+    onto ``filename`` — a worker killed mid-write leaves no half-written
+    artifact that ``--skip-completed-runs`` or ``combine`` could mistake
+    for a completed run (the provenance-YAML pattern, models/cnmf.py).
     """
     if compress is None:
         compress = obj.values.nbytes <= (2 << 20)
     writer = np.savez_compressed if compress else np.savez
-    writer(
-        filename,
-        data=obj.values,
-        index=obj.index.values,
-        columns=obj.columns.values,
-    )
+    with atomic_artifact(filename) as tmp:
+        # an open file object: np.savez must not append '.npz' to the
+        # extension-less temp name
+        with open(tmp, "wb") as fh:
+            writer(
+                fh,
+                data=obj.values,
+                index=obj.index.values,
+                columns=obj.columns.values,
+            )
+    maybe_tear(filename)  # fault harness: no-op unless CNMF_TPU_FAULT_SPEC
 
 
 def save_df_to_text(obj: pd.DataFrame, filename: str):
-    obj.to_csv(filename, sep="\t")
+    with atomic_artifact(filename) as tmp:
+        obj.to_csv(tmp, sep="\t")
+    maybe_tear(filename)
 
 
 def load_df_from_npz(filename: str) -> pd.DataFrame:

@@ -66,4 +66,10 @@ def build_paths(output_dir: str, name: str, create: bool = True) -> dict:
         # on worker index: fleet workers must not clobber each other's
         # records (same write-disjointness rule as iter_spectra).
         "factorize_provenance": os.path.join(tmp, name + ".factorize_provenance.w%d.yaml"),
+
+        # TPU-build addition (ISSUE 5): per-worker resilience ledger —
+        # reseeded-retry records (original seed, attempt, derived seed,
+        # outcome) and quarantined (k, iter) pairs that combine must
+        # treat as deliberately absent. Worker-templated like provenance.
+        "resilience_ledger": os.path.join(tmp, name + ".resilience.w%d.json"),
     }

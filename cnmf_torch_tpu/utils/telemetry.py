@@ -71,6 +71,11 @@ EVENT_TYPES = {
     "replicates": {"k", "beta", "records"},
     "stream": {"context", "wall_s", "nbytes", "overlap_fraction"},
     "memory": {"stage", "devices"},
+    # resilience events (runtime/resilience.py): nonfinite_replicate /
+    # retry / quarantine / torn_artifact detections, with the (k, iter,
+    # seed, attempt) or (path, reason) context needed to audit a
+    # degraded run
+    "fault": {"kind", "context"},
 }
 
 # per-record required fields inside a "replicates" event's records list

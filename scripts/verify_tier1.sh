@@ -40,4 +40,19 @@ if [ "$rc" -eq 0 ]; then
     exit 1
   fi
 fi
+
+# chaos smoke: run the mini pipeline once per injected fault class
+# (nonfinite lane, killed worker, torn artifact — scripts/chaos_smoke.py)
+# and assert degraded-mode accounting: quarantine + derived-seed retry,
+# respawn + bit-identical resumed consensus, torn-artifact detection
+if [ "$rc" -eq 0 ]; then
+  echo "[tier1] chaos smoke (fault injection: nonfinite/kill/torn) ..."
+  if timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      python scripts/chaos_smoke.py; then
+    echo CHAOS_SMOKE=ok
+  else
+    echo CHAOS_SMOKE=fail
+    exit 1
+  fi
+fi
 exit $rc

@@ -1,0 +1,587 @@
+"""Fault-tolerant sweep execution (ISSUE 5): quarantine + reseeded retry,
+torn-artifact-proof resume, launcher self-healing, and the fault-injection
+harness itself.
+
+The integration tests inject faults through ``CNMF_TPU_FAULT_SPEC``
+(runtime/faults.py) — the same deterministic harness the chaos smoke gate
+uses — so every recovery path here exercises the production code, not a
+mock of it."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cnmf_torch_tpu import cNMF, load_df_from_npz, save_df_to_npz
+from cnmf_torch_tpu.runtime import faults, resilience
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# unit: seed derivation, spec parsing, health grading
+# ---------------------------------------------------------------------------
+
+def test_derive_retry_seed_deterministic_and_masked():
+    assert resilience.derive_retry_seed(1234567, 1) == (1234567 ^ 1)
+    assert resilience.derive_retry_seed(1234567, 2) == (1234567 ^ 2)
+    # stays in the ledger's 31-bit seed domain even at the boundary
+    assert 0 <= resilience.derive_retry_seed(0x7FFFFFFF, 3) <= 0x7FFFFFFF
+    with pytest.raises(ValueError):
+        resilience.derive_retry_seed(7, 0)
+
+
+def test_parse_fault_spec():
+    clauses = faults.parse_fault_spec(
+        "nonfinite:k=5,iter=2;kill:stage=factorize,worker=1;"
+        "torn:artifact=iter_spectra;upload")
+    assert [c.kind for c in clauses] == ["nonfinite", "kill", "torn",
+                                        "upload"]
+    assert clauses[0].params == {"k": 5, "iter": 2}
+    assert clauses[1].params == {"stage": "factorize", "worker": 1}
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faults.parse_fault_spec("explode:k=1")
+    with pytest.raises(ValueError, match="key=value"):
+        faults.parse_fault_spec("kill:stage")
+
+
+def test_lane_health_grades_err_latch_and_spectra():
+    errs = np.asarray([1.0, np.nan, np.inf, 2.0])
+    h = resilience.lane_health(errs)
+    assert h.tolist() == [True, False, False, True]
+    # the telemetry latch catches a transient nonfinite that recovered
+    h2 = resilience.lane_health(errs,
+                                nonfinite=[True, False, False, False])
+    assert h2.tolist() == [False, False, False, True]
+    spectra = np.ones((4, 2, 3), np.float32)
+    spectra[3, 1, 2] = np.nan
+    h3 = resilience.lane_health(errs, spectra=spectra)
+    assert h3.tolist() == [True, False, False, False]
+
+
+def test_maybe_poison_lanes_matches_and_copies(monkeypatch):
+    monkeypatch.setenv(faults.FAULT_SPEC_ENV, "nonfinite:k=4,iter=1")
+    spectra = np.ones((3, 2, 2), np.float32)
+    errs = np.ones(3)
+    sp2, er2 = faults.maybe_poison_lanes(4, [0, 1, 2], spectra, errs)
+    assert np.isnan(sp2[1]).all() and np.isnan(er2[1])
+    assert np.isfinite(spectra).all() and np.isfinite(errs).all()  # copies
+    # wrong K, wrong attempt: untouched (and same objects back)
+    sp3, _ = faults.maybe_poison_lanes(5, [0, 1, 2], spectra, errs)
+    assert sp3 is spectra
+    sp4, _ = faults.maybe_poison_lanes(4, [0, 1, 2], spectra, errs,
+                                       attempt=1)
+    assert sp4 is spectra
+    monkeypatch.delenv(faults.FAULT_SPEC_ENV)
+    sp5, _ = faults.maybe_poison_lanes(4, [0, 1, 2], spectra, errs)
+    assert sp5 is spectra
+
+
+def test_maybe_poison_lanes_honors_controls(tmp_path, monkeypatch):
+    """`after`/`limit`/`once` apply to the nonfinite hook like every
+    other fault hook — a chaos spec meant to poison one sweep must not
+    poison every matching sweep in every process."""
+    spectra = np.ones((2, 2, 2), np.float32)
+    errs = np.ones(2)
+    monkeypatch.setenv(faults.FAULT_SPEC_ENV, "nonfinite:k=3,after=1")
+    first, _ = faults.maybe_poison_lanes(3, [0, 1], spectra, errs)
+    assert first is spectra  # hit 1 skipped by after=1
+    second, _ = faults.maybe_poison_lanes(3, [0, 1], spectra, errs)
+    assert np.isnan(second).all()
+    monkeypatch.setenv(faults.FAULT_SPEC_ENV, "nonfinite:k=3,limit=1")
+    a, _ = faults.maybe_poison_lanes(3, [0, 1], spectra, errs)
+    b, _ = faults.maybe_poison_lanes(3, [0, 1], spectra, errs)
+    assert np.isnan(a).all() and b is spectra
+    sentinel = tmp_path / "poison.done"
+    monkeypatch.setenv(faults.FAULT_SPEC_ENV,
+                       f"nonfinite:k=3,once={sentinel}")
+    c, _ = faults.maybe_poison_lanes(3, [0, 1], spectra, errs)
+    d, _ = faults.maybe_poison_lanes(3, [0, 1], spectra, errs)
+    assert np.isnan(c).all() and d is spectra and sentinel.exists()
+
+
+def test_upload_fault_raises_from_staging(monkeypatch):
+    from cnmf_torch_tpu.parallel.streaming import stream_to_device
+
+    monkeypatch.setenv(faults.FAULT_SPEC_ENV,
+                       "upload:context=stream_to_device")
+    with pytest.raises(RuntimeError, match="injected fault: upload"):
+        stream_to_device(np.ones((4, 4), np.float32))
+    monkeypatch.delenv(faults.FAULT_SPEC_ENV)
+    assert np.asarray(
+        stream_to_device(np.ones((2, 2), np.float32))).shape == (2, 2)
+
+
+def test_maybe_kill_sigkills_once_per_sentinel(tmp_path, monkeypatch):
+    """The kill fault is a real SIGKILL, and the `once` sentinel ensures a
+    respawned worker does not re-kill itself (run in a subprocess — the
+    harness must not take the test runner down)."""
+    script = tmp_path / "killme.py"
+    script.write_text(
+        "import sys\n"
+        f"sys.path.insert(0, {REPO_ROOT!r})\n"
+        "from cnmf_torch_tpu.runtime import faults\n"
+        "faults.maybe_kill('factorize', 0)\n"
+        "print('alive')\n")
+    sentinel = tmp_path / "kill.done"
+    env = dict(os.environ, CNMF_TPU_FAULT_SPEC=
+               f"kill:stage=factorize,worker=0,once={sentinel}")
+    p1 = subprocess.run([sys.executable, str(script)], env=env,
+                        capture_output=True, timeout=120)
+    assert p1.returncode == -signal.SIGKILL, p1.stderr.decode()
+    assert sentinel.exists()
+    p2 = subprocess.run([sys.executable, str(script)], env=env,
+                        capture_output=True, timeout=120)
+    assert p2.returncode == 0 and b"alive" in p2.stdout
+
+
+# ---------------------------------------------------------------------------
+# unit: atomic writes + torn-artifact detection
+# ---------------------------------------------------------------------------
+
+def test_save_df_to_npz_atomic_failure_preserves_old_file(tmp_path,
+                                                          monkeypatch):
+    fn = tmp_path / "a.df.npz"
+    df = pd.DataFrame(np.ones((2, 3)), index=["a", "b"],
+                      columns=["x", "y", "z"])
+    save_df_to_npz(df, fn, compress=False)
+    before = fn.read_bytes()
+
+    def boom(fh, **kwargs):
+        fh.write(b"partial garbage")
+        raise OSError("disk full")
+
+    monkeypatch.setattr(np, "savez", boom)
+    with pytest.raises(OSError, match="disk full"):
+        save_df_to_npz(df * 2, fn, compress=False)
+    monkeypatch.undo()
+    # the reader-visible file is the OLD complete artifact, untouched,
+    # and the failed temp file is cleaned up
+    assert fn.read_bytes() == before
+    assert not [f for f in os.listdir(tmp_path) if ".tmp-" in f]
+    pd.testing.assert_frame_equal(load_df_from_npz(fn), df)
+
+
+def test_write_h5ad_atomic_no_temp_leftovers(tmp_path):
+    from cnmf_torch_tpu.utils.anndata_lite import (AnnDataLite, read_h5ad,
+                                                   write_h5ad)
+
+    fn = tmp_path / "m.h5ad"
+    write_h5ad(str(fn), AnnDataLite(np.ones((3, 4), np.float64)))
+    assert not [f for f in os.listdir(tmp_path) if ".tmp-" in f]
+    assert read_h5ad(str(fn)).shape == (3, 4)
+
+
+def test_probe_and_load_detect_torn_artifacts(tmp_path):
+    fn = str(tmp_path / "s.df.npz")
+    df = pd.DataFrame(np.ones((3, 5)), index=np.arange(1, 4),
+                      columns=[f"g{j}" for j in range(5)])
+    save_df_to_npz(df, fn, compress=False)
+    assert resilience.probe_spectra_file(fn, k=3, n_genes=5) is None
+    # wrong expectations are torn-equivalent
+    assert "component rows" in resilience.probe_spectra_file(fn, k=4)
+    assert "gene columns" in resilience.probe_spectra_file(fn, k=3,
+                                                           n_genes=9)
+    # nonfinite values must not be trusted either
+    dfn = df.copy()
+    dfn.iloc[1, 2] = np.nan
+    save_df_to_npz(dfn, fn, compress=False)
+    assert "nonfinite" in resilience.probe_spectra_file(fn, k=3)
+    # a truncated zip (SIGKILL mid-write on the pre-atomic layer)
+    save_df_to_npz(df, fn, compress=False)
+    size = os.path.getsize(fn)
+    with open(fn, "r+b") as f:
+        f.truncate(size // 3)
+    assert "unreadable" in resilience.probe_spectra_file(fn, k=3)
+    with pytest.raises(resilience.TornArtifactError):
+        resilience.load_spectra_checked(fn, k=3)
+    assert resilience.probe_spectra_file(str(tmp_path / "no.npz")) \
+        == "missing"
+
+
+def test_torn_injection_hits_matching_artifact_once(tmp_path, monkeypatch):
+    monkeypatch.setenv(faults.FAULT_SPEC_ENV,
+                       "torn:artifact=spectra,limit=1")
+    df = pd.DataFrame(np.ones((2, 3)), index=[1, 2], columns=list("abc"))
+    fn1 = str(tmp_path / "x.spectra.k_3.iter_0.df.npz")
+    fn2 = str(tmp_path / "x.spectra.k_3.iter_1.df.npz")
+    save_df_to_npz(df, fn1, compress=False)
+    save_df_to_npz(df, fn2, compress=False)
+    assert resilience.probe_spectra_file(fn1, k=2) is not None  # torn
+    assert resilience.probe_spectra_file(fn2, k=2) is None      # limit=1
+
+
+# ---------------------------------------------------------------------------
+# combine validation + quarantine exclusion
+# ---------------------------------------------------------------------------
+
+def _fabricate_run(tmp_path, name, k=3, n_iter=4, g=20):
+    """A run directory with a hand-built ledger + replicate artifacts, so
+    combine-layer behavior is testable without a factorize pass."""
+    obj = cNMF(output_dir=str(tmp_path), name=name)
+    rp = pd.DataFrame({
+        "n_components": [k] * n_iter, "iter": list(range(n_iter)),
+        "nmf_seed": [100 + i for i in range(n_iter)],
+        "completed": [False] * n_iter})
+    save_df_to_npz(rp, obj.paths["nmf_replicate_parameters"])
+    genes = [f"g{j}" for j in range(g)]
+    with open(obj.paths["nmf_genes_list"], "w") as f:
+        f.write("\n".join(genes))
+    rng = np.random.default_rng(0)
+    for it in range(n_iter):
+        df = pd.DataFrame(rng.random((k, g)), index=np.arange(1, k + 1),
+                          columns=genes)
+        save_df_to_npz(df, obj.paths["iter_spectra"] % (k, it),
+                       compress=False)
+    return obj
+
+
+def test_combine_treats_corrupt_like_missing_under_skip(tmp_path):
+    obj = _fabricate_run(tmp_path, "torncomb")
+    fn = obj.paths["iter_spectra"] % (3, 2)
+    with open(fn, "r+b") as f:
+        f.truncate(os.path.getsize(fn) // 3)
+    # without the flag: a clear torn-artifact error, not a zipfile
+    # traceback from deep inside pandas
+    with pytest.raises(resilience.TornArtifactError,
+                       match="skip_missing_files"):
+        obj.combine_nmf(3, skip_missing_files=False)
+    merged = obj.combine_nmf(3, skip_missing_files=True)
+    assert merged.shape == (3 * 3, 20)  # torn replicate dropped
+    assert not any(lbl.startswith("iter2_") for lbl in merged.index)
+
+
+def test_combine_skips_quarantined_without_flag(tmp_path):
+    obj = _fabricate_run(tmp_path, "quarcomb")
+    os.remove(obj.paths["iter_spectra"] % (3, 1))
+    with open(obj.paths["resilience_ledger"] % 0, "w") as f:
+        json.dump({"schema": 1, "retries": [],
+                   "quarantined": [{"k": 3, "iter": 1, "seed": 101,
+                                    "attempts": 2},
+                                   {"k": 3, "iter": 0, "seed": 100,
+                                    "attempts": 2}]}, f)
+    # quarantined replicates are deliberately absent: no skip flag
+    # needed. But a quarantine record only suppresses the invalid
+    # artifact it explains — iter 0's artifact is VALID on disk (a stale
+    # record from an older run / different worker topology), so it is
+    # trusted and included.
+    merged = obj.combine_nmf(3)
+    assert merged.shape == (3 * 3, 20)
+    assert any(lbl.startswith("iter0_") for lbl in merged.index)
+    assert not any(lbl.startswith("iter1_") for lbl in merged.index)
+
+
+# ---------------------------------------------------------------------------
+# launcher self-healing (unit, monkeypatched worker command)
+# ---------------------------------------------------------------------------
+
+def test_sweep_stale_ledgers_removes_out_of_range_workers(tmp_path):
+    obj = _fabricate_run(tmp_path, "sweepled")
+    for w in (0, 3):
+        with open(obj.paths["resilience_ledger"] % w, "w") as f:
+            json.dump({"schema": 1, "retries": [], "quarantined": []}, f)
+    resilience.sweep_stale_ledgers(obj.paths["resilience_ledger"], 2)
+    assert os.path.exists(obj.paths["resilience_ledger"] % 0)  # in range
+    assert not os.path.exists(obj.paths["resilience_ledger"] % 3)
+
+
+def test_launcher_worker_timeout_kills_and_reports(tmp_path, monkeypatch):
+    from cnmf_torch_tpu import launcher
+
+    monkeypatch.setattr(
+        launcher, "_worker_cmd",
+        lambda od, nm, extra: [sys.executable, "-c",
+                               "import time; time.sleep(60)"])
+    monkeypatch.setenv("CNMF_TPU_WORKER_TIMEOUT", "0.5")
+    monkeypatch.setenv("CNMF_TPU_WORKER_RESPAWNS", "0")
+    t0 = time.monotonic()
+    with pytest.warns(RuntimeWarning, match="CNMF_TPU_WORKER_TIMEOUT"):
+        failed, unhealthy = launcher._run_subprocess_workers(
+            str(tmp_path), "x", 1, [], dict(os.environ))
+    assert failed == {0} and unhealthy == set()
+    assert time.monotonic() - t0 < 30  # the hung worker was killed
+
+
+def test_launcher_respawns_dead_worker_with_resume_flag(tmp_path,
+                                                        monkeypatch):
+    from cnmf_torch_tpu import launcher
+
+    flaky = tmp_path / "flaky.py"
+    sentinel = tmp_path / "first_attempt"
+    flaky.write_text(
+        "import os, sys\n"
+        f"p = {str(sentinel)!r}\n"
+        "if os.path.exists(p):\n"
+        "    sys.exit(0)\n"
+        "open(p, 'w').close()\n"
+        "sys.exit(5)\n")  # generic crash (3 is the reserved unhealthy code)
+    spawned = []
+
+    def fake_cmd(od, nm, extra):
+        spawned.append(list(extra))
+        return [sys.executable, str(flaky)]
+
+    monkeypatch.setattr(launcher, "_worker_cmd", fake_cmd)
+    monkeypatch.setenv("CNMF_TPU_WORKER_RESPAWNS", "1")
+    monkeypatch.setenv("CNMF_TPU_WORKER_BACKOFF_S", "0.05")
+    monkeypatch.delenv("CNMF_TPU_WORKER_TIMEOUT", raising=False)
+    with pytest.warns(RuntimeWarning, match="respawning onto its "
+                                            "unfinished ledger shard"):
+        failed, unhealthy = launcher._run_subprocess_workers(
+            str(tmp_path), "x", 1, [], dict(os.environ))
+    assert failed == set() and unhealthy == set()  # respawn succeeded
+    assert len(spawned) == 2
+    assert "--skip-completed-runs" not in spawned[0]
+    assert "--skip-completed-runs" in spawned[1]  # resumes its own shard
+
+
+def test_launcher_unhealthy_exit_is_fatal_not_respawned(tmp_path,
+                                                        monkeypatch):
+    """A worker below the min-healthy-frac floor exits with the distinct
+    code: the launcher must neither respawn it (the derived retry seeds
+    are deterministic — it would fail identically) nor degrade around it
+    with skip-missing combine."""
+    from cnmf_torch_tpu import launcher
+
+    spawned = []
+
+    def fake_cmd(od, nm, extra):
+        spawned.append(list(extra))
+        return [sys.executable, "-c",
+                f"import sys; sys.exit({resilience.UNHEALTHY_EXIT_CODE})"]
+
+    monkeypatch.setattr(launcher, "_worker_cmd", fake_cmd)
+    monkeypatch.setenv("CNMF_TPU_WORKER_RESPAWNS", "2")
+    monkeypatch.delenv("CNMF_TPU_WORKER_TIMEOUT", raising=False)
+    failed, unhealthy = launcher._run_subprocess_workers(
+        str(tmp_path), "x", 1, [], dict(os.environ))
+    assert unhealthy == {0} and failed == set()
+    assert len(spawned) == 1  # no respawn burned on a policy failure
+
+
+# ---------------------------------------------------------------------------
+# integration: quarantine + reseeded retry through factorize
+# ---------------------------------------------------------------------------
+
+def _prepare_mini(tmp_path, name, components=(3,), n_iter=3, seed=1):
+    counts = np.random.default_rng(2).binomial(
+        40, 0.02, size=(60, 100)).astype(np.float64)
+    counts[counts.sum(axis=1) == 0, 0] = 1.0
+    df = pd.DataFrame(counts, index=[f"c{i}" for i in range(60)],
+                      columns=[f"g{j}" for j in range(100)])
+    counts_fn = str(tmp_path / f"{name}_counts.df.npz")
+    save_df_to_npz(df, counts_fn)
+    obj = cNMF(output_dir=str(tmp_path), name=name)
+    obj.prepare(counts_fn, components=list(components), n_iter=n_iter,
+                seed=seed, num_highvar_genes=50, batch_size=64,
+                max_NMF_iter=50)
+    return obj, counts_fn
+
+
+def test_factorize_retries_nonfinite_lane_with_derived_seed(tmp_path,
+                                                            monkeypatch):
+    """An injected NaN lane is detected by the always-on health pass,
+    rerun with seed XOR 1, recorded in the resilience ledger, and emitted
+    as schema-valid fault telemetry — and the retried artifact lands."""
+    obj, _ = _prepare_mini(tmp_path, "retry")
+    monkeypatch.setenv(faults.FAULT_SPEC_ENV, "nonfinite:k=3,iter=1")
+    monkeypatch.setenv("CNMF_TPU_TELEMETRY", "1")
+    obj.factorize()
+    assert os.path.exists(obj.paths["iter_spectra"] % (3, 1))
+    with open(obj.paths["resilience_ledger"] % 0) as f:
+        ledger = json.load(f)
+    assert ledger["quarantined"] == []
+    (rec,) = ledger["retries"]
+    assert rec["k"] == 3 and rec["iter"] == 1 and rec["healthy"]
+    assert rec["attempt"] == 1
+    assert rec["derived_seed"] == resilience.derive_retry_seed(
+        rec["seed"], 1) == (rec["seed"] ^ 1)
+    # the retried lane's artifact is a genuinely different draw from the
+    # poisoned seed's would-have-been spectra, and is finite
+    vals = load_df_from_npz(obj.paths["iter_spectra"] % (3, 1)).values
+    assert np.isfinite(vals).all()
+
+    from cnmf_torch_tpu.utils.telemetry import (read_events,
+                                                validate_events_file)
+
+    ev_path = os.path.join(str(tmp_path), "retry", "cnmf_tmp",
+                           "retry.events.jsonl")
+    validate_events_file(ev_path)  # fault events are schema-valid
+    kinds = [e["kind"] for e in read_events(ev_path) if e["t"] == "fault"]
+    assert "nonfinite_replicate" in kinds and "retry" in kinds
+    # consensus proceeds on the healthy + recovered set
+    merged = obj.combine_nmf(3)
+    assert merged.shape[0] == 3 * 3
+
+
+def test_factorize_quarantines_and_degrades_above_floor(tmp_path,
+                                                        monkeypatch):
+    obj, _ = _prepare_mini(tmp_path, "quar")
+    monkeypatch.setenv(faults.FAULT_SPEC_ENV, "nonfinite:k=3,iter=0")
+    monkeypatch.setenv(resilience.MAX_RETRIES_ENV, "0")
+    monkeypatch.setenv(resilience.MIN_HEALTHY_FRAC_ENV, "0.5")
+    with pytest.warns(RuntimeWarning, match="quarantined"):
+        obj.factorize()
+    assert not os.path.exists(obj.paths["iter_spectra"] % (3, 0))
+    with open(obj.paths["resilience_ledger"] % 0) as f:
+        ledger = json.load(f)
+    assert [(q["k"], q["iter"]) for q in ledger["quarantined"]] == [(3, 0)]
+    # combine excludes the quarantined replicate WITHOUT any skip flag
+    merged = obj.combine_nmf(3)
+    assert merged.shape[0] == 2 * 3
+    # resume is idempotent after an accepted degraded run: the
+    # quarantined lane is deliberately absent, so a resume has nothing
+    # to do and the quarantine ledger survives
+    obj.factorize(skip_completed_runs=True)
+    assert os.path.exists(obj.paths["resilience_ledger"] % 0)
+    assert merged.shape[0] == obj.combine_nmf(3).shape[0]
+    # raising CNMF_TPU_MAX_RETRIES un-finalizes the quarantine: the lane
+    # reruns on resume (clean now), heals, and the ledger clears — the
+    # remedy the quarantine warning prescribes actually works
+    monkeypatch.delenv(faults.FAULT_SPEC_ENV)
+    monkeypatch.setenv(resilience.MAX_RETRIES_ENV, "2")
+    obj.factorize(skip_completed_runs=True)
+    assert not os.path.exists(obj.paths["resilience_ledger"] % 0)
+    assert obj.combine_nmf(3).shape[0] == 3 * 3
+    monkeypatch.setenv(faults.FAULT_SPEC_ENV, "nonfinite:k=3,iter=0")
+    monkeypatch.setenv(resilience.MAX_RETRIES_ENV, "0")
+    with pytest.warns(RuntimeWarning, match="quarantined"):
+        obj.factorize()  # restore the quarantined state
+    monkeypatch.delenv(faults.FAULT_SPEC_ENV)
+    monkeypatch.delenv(resilience.MAX_RETRIES_ENV)
+    # a clean re-run supersedes the quarantine: the stale ledger is
+    # removed, so combine must not silently drop the now-healthy lane
+    obj.factorize()
+    assert not os.path.exists(obj.paths["resilience_ledger"] % 0)
+    merged = obj.combine_nmf(3)
+    assert merged.shape[0] == 3 * 3
+
+
+def test_factorize_hard_fails_below_min_healthy_frac(tmp_path, monkeypatch):
+    obj, _ = _prepare_mini(tmp_path, "floor")
+    monkeypatch.setenv(faults.FAULT_SPEC_ENV, "nonfinite:k=3,iter=0")
+    monkeypatch.setenv(resilience.MAX_RETRIES_ENV, "0")
+    # default floor 0.8 > 2/3 healthy -> loud failure, not silent degrade
+    monkeypatch.delenv(resilience.MIN_HEALTHY_FRAC_ENV, raising=False)
+    with pytest.warns(RuntimeWarning, match="quarantined"):
+        with pytest.raises(resilience.UnhealthySweepError,
+                           match="too few healthy replicates"):
+            obj.factorize()
+    # the CLI maps the floor violation to the distinct exit code the
+    # launcher treats as fatal (no respawn, no skip-missing fallback)
+    from cnmf_torch_tpu import cli
+
+    with pytest.warns(RuntimeWarning, match="quarantined"):
+        with pytest.raises(SystemExit) as exc_info:
+            cli.main(["factorize", "--output-dir", str(tmp_path),
+                      "--name", "floor"])
+    assert exc_info.value.code == resilience.UNHEALTHY_EXIT_CODE
+    # resume must not bypass the floor: the quarantined lane carries into
+    # the accounting, so even the nothing-to-rerun path re-fails instead
+    # of exiting 0 and letting combine run on the below-floor sweep
+    with pytest.raises(resilience.UnhealthySweepError):
+        obj.factorize(skip_completed_runs=True)
+
+
+def test_resume_credits_existing_healthy_replicates(tmp_path, monkeypatch):
+    """The min-healthy-frac floor is judged against the K's FULL replicate
+    count: a resume that reruns 1 of 4 lanes and quarantines it is 3/4
+    healthy (degrade), not 0/1 (spurious hard failure)."""
+    obj, _ = _prepare_mini(tmp_path, "credit", n_iter=4)
+    obj.factorize(batched=False)
+    os.remove(obj.paths["iter_spectra"] % (3, 2))
+    monkeypatch.setenv(faults.FAULT_SPEC_ENV, "nonfinite:k=3,iter=2")
+    monkeypatch.setenv(resilience.MAX_RETRIES_ENV, "0")
+    monkeypatch.setenv(resilience.MIN_HEALTHY_FRAC_ENV, "0.7")
+    with pytest.warns(RuntimeWarning, match="quarantined"):
+        obj.factorize(batched=False, skip_completed_runs=True)
+    with open(obj.paths["resilience_ledger"] % 0) as f:
+        ledger = json.load(f)
+    assert [(q["k"], q["iter"]) for q in ledger["quarantined"]] == [(3, 2)]
+    merged = obj.combine_nmf(3)
+    assert merged.shape[0] == 3 * 3
+
+
+def test_resume_reruns_torn_artifact(tmp_path):
+    """--skip-completed-runs must validate, not just stat: a truncated
+    artifact is rerun (atomically overwritten), not trusted."""
+    obj, _ = _prepare_mini(tmp_path, "tornres")
+    obj.factorize()
+    fn = obj.paths["iter_spectra"] % (3, 1)
+    good = load_df_from_npz(fn).values
+    with open(fn, "r+b") as f:
+        f.truncate(os.path.getsize(fn) // 3)
+    with pytest.warns(RuntimeWarning, match="failed validation"):
+        obj.factorize(skip_completed_runs=True)
+    repaired = load_df_from_npz(fn).values
+    assert np.isfinite(repaired).all()
+    # the whole-K rerun reproduces the uninterrupted sweep bit-for-bit
+    np.testing.assert_array_equal(repaired, good)
+
+
+# ---------------------------------------------------------------------------
+# integration: kill–resume parity through the launcher
+# ---------------------------------------------------------------------------
+
+def test_kill_resume_parity_end_to_end(tmp_path, monkeypatch):
+    """SIGKILL a subprocess-engine worker mid-factorize (fault harness),
+    let the launcher respawn it onto its unfinished shard, and assert the
+    resumed run's merged spectra AND consensus artifacts match an
+    uninterrupted run bit-for-bit (sweep-granular resume keeps batch
+    composition identical)."""
+    from cnmf_torch_tpu.launcher import run_pipeline
+
+    counts = np.random.default_rng(1).binomial(
+        40, 0.02, size=(60, 100)).astype(np.float64)
+    counts[counts.sum(axis=1) == 0, 0] = 1.0
+    df = pd.DataFrame(counts, index=[f"c{i}" for i in range(60)],
+                      columns=[f"g{j}" for j in range(100)])
+    counts_fn = str(tmp_path / "counts.df.npz")
+    save_df_to_npz(df, counts_fn)
+
+    monkeypatch.setenv("CNMF_TPU_WORKER_RESPAWNS", "2")
+    monkeypatch.setenv("CNMF_TPU_WORKER_BACKOFF_S", "0.1")
+    common = dict(components=[3, 4], n_iter=3, total_workers=1, seed=4,
+                  numgenes=50, k_selection=False)
+    run_pipeline(counts_fn, str(tmp_path), "uninterrupted",
+                 env_extra={"CNMF_SIM_CPU_DEVICES": "2"}, **common)
+
+    sentinel = tmp_path / "kill.done"
+    run_pipeline(counts_fn, str(tmp_path), "killed",
+                 env_extra={"CNMF_SIM_CPU_DEVICES": "2",
+                            "CNMF_TPU_FAULT_SPEC":
+                            "kill:stage=factorize,worker=0,"
+                            f"once={sentinel}"},
+                 **common)
+    assert sentinel.exists()  # the SIGKILL fired in the first worker
+
+    for k in (3, 4):
+        a = load_df_from_npz(os.path.join(
+            str(tmp_path), "uninterrupted", "cnmf_tmp",
+            f"uninterrupted.spectra.k_{k}.merged.df.npz"))
+        b = load_df_from_npz(os.path.join(
+            str(tmp_path), "killed", "cnmf_tmp",
+            f"killed.spectra.k_{k}.merged.df.npz"))
+        np.testing.assert_array_equal(a.values, b.values)
+        assert list(a.index) == list(b.index)
+
+    # consensus over the resumed artifacts is bit-identical too
+    outs = []
+    for name in ("uninterrupted", "killed"):
+        obj = cNMF(output_dir=str(tmp_path), name=name)
+        # local_neighborhood_size widened: 9 merged spectra at k=3 give
+        # int(0.3 * 9 / 3) = 0 neighbors under the default
+        obj.consensus(3, density_threshold=2.0,
+                      local_neighborhood_size=0.7, show_clustering=False,
+                      build_ref=False)
+        outs.append({key: load_df_from_npz(
+            obj.paths[key] % (3, "2_0")).values
+            for key in ("consensus_spectra", "consensus_usages")})
+    for key in outs[0]:
+        np.testing.assert_array_equal(outs[0][key], outs[1][key])
