@@ -11,10 +11,14 @@ Two engines replace GNU parallel:
     a worker that dies (or exceeds ``CNMF_TPU_WORKER_TIMEOUT`` seconds and
     is killed) is respawned onto its own unfinished ledger shard with
     ``--skip-completed-runs`` — resume rides the eager, atomic per-replicate
-    artifacts — after an exponential backoff, up to
-    ``CNMF_TPU_WORKER_RESPAWNS`` times (default 1). Only when the respawn
-    budget is exhausted does the run fall back to the reference's
-    dead-worker tolerance: combine with ``skip_missing_files=True``.
+    artifacts AND, on the rowsharded path, the newest valid mid-run pass
+    checkpoint (``runtime/checkpoint.py``), so a worker killed 40 passes
+    into a multi-hour replicate restarts mid-run, not from scratch — after
+    an exponential backoff with deterministic per-worker jitter
+    (:func:`respawn_delay`), up to ``CNMF_TPU_WORKER_RESPAWNS`` times
+    (default 1). Only when the respawn budget is exhausted does the run
+    fall back to the reference's dead-worker tolerance: combine with
+    ``skip_missing_files=True``.
   * ``multihost`` — ONE single-controller JAX program spanning N processes
     stitched by ``jax.distributed`` (``parallel/multihost.py``); factorize
     runs over the 2-D (replicates x cells) mesh, with the cells-psum on ICI
@@ -36,7 +40,21 @@ import subprocess
 import sys
 import warnings
 
-__all__ = ["run_pipeline"]
+__all__ = ["run_pipeline", "respawn_delay"]
+
+
+def respawn_delay(backoff_s: float, attempt: int, worker_i: int) -> float:
+    """Respawn backoff for a dead worker: exponential base
+    (``backoff_s * 2^(attempt-1)``) times a deterministic per-worker
+    jitter factor in [1, 1.5). The jitter derives from the worker index
+    alone (Knuth multiplicative hash — no RNG, so resume/replay timing is
+    reproducible): when a whole fleet dies at once (node preemption,
+    shared-filesystem blip), the respawns fan out across half a backoff
+    period instead of restarting in lockstep and re-stampeding whatever
+    killed them."""
+    base = float(backoff_s) * (2 ** (max(int(attempt), 1) - 1))
+    jitter = ((int(worker_i) * 2654435761) & 0xFFFFFFFF) % 1024 / 2048.0
+    return base * (1.0 + jitter)
 
 
 def _free_port() -> int:
@@ -125,7 +143,7 @@ def _run_subprocess_workers(
                 continue
             if attempts[i] < respawn_limit:
                 attempts[i] += 1
-                delay = backoff_s * (2 ** (attempts[i] - 1))
+                delay = respawn_delay(backoff_s, attempts[i], i)
                 warnings.warn(
                     "factorize worker %d died (rc=%s); respawning onto its "
                     "unfinished ledger shard in %.1fs (attempt %d/%d)"
@@ -268,6 +286,10 @@ def run_pipeline(counts: str, output_dir: str, name: str,
         # so none are live.
         run_dir = os.path.join(output_dir, name)
         for pattern in (os.path.join("cnmf_tmp", "*.iter_*.df.npz"),
+                        # pass checkpoints are normally discarded when
+                        # their replicate's artifact lands; a worker that
+                        # exhausted its respawn budget can leave one behind
+                        os.path.join("cnmf_tmp", "*.ckpt.k_*.npz"),
                         # atomic-write temp orphans land wherever their
                         # artifact lives: intermediates in cnmf_tmp/, the
                         # txt/stats finals in the run dir itself

@@ -187,7 +187,8 @@ class cNMF:
         if ent is not None and ent[0] == token:
             return ent[1]
         stats = StreamStats()
-        Xd = jax.block_until_ready(stream_to_device(X, stats=stats))
+        Xd = jax.block_until_ready(
+            stream_to_device(X, stats=stats, events=self._events))
         stats.record_to(self._timer, f"stage_dense:{key}")
         self._events.emit_stream(f"stage_dense:{key}", stats)
         self._dev_cache[key] = (token, Xd)
@@ -657,7 +658,8 @@ class cNMF:
             _credit_completed(jobs)
             self._factorize_rowsharded(jobs, run_params, norm_counts,
                                        _nmf_kwargs, mesh, worker_i,
-                                       guard=guard)
+                                       guard=guard,
+                                       resume=skip_completed_runs)
             return
 
         if not batched:
@@ -689,7 +691,7 @@ class cNMF:
                                              norm_counts.var.index)
                 faults.maybe_kill("factorize", worker_i)
 
-            def rerun_seq(k_r, seeds_r):
+            def rerun_seq(k_r, seeds_r, iters=None, attempt=0):
                 outs = [_solve_seq(k_r, s) for s in seeds_r]
                 return (np.stack([o[0] for o in outs]),
                         np.asarray([o[1] for o in outs], np.float64))
@@ -866,7 +868,7 @@ class cNMF:
                  mesh_devices=(1 if mesh is None
                                else int(np.prod(mesh.devices.shape)))))
 
-        def rerun_batched(k_r, seeds_r):
+        def rerun_batched(k_r, seeds_r, iters=None, attempt=0):
             # quarantine-retry solver for the batched paths: a fresh per-K
             # sweep over the staged X with the derived seeds (the packed
             # program's K_max padding is irrelevant for a retry — bit
@@ -1084,14 +1086,15 @@ class cNMF:
     def _finish_resilience(self, guard, rerun, columns, worker_i=0):
         """Retry waves + final accounting for one factorize call.
 
-        ``rerun(k, seeds) -> (spectra (R,k',g) numpy, errs (R,) numpy)``
-        re-solves a list of replicates at one K (each path supplies its
-        own solver family; ``k' >= k`` for K_max-padded outputs). Seeds
-        are derived per attempt (``resilience.derive_retry_seed``), so an
-        interrupted run resumed later retries with identical seeds; the
-        guard's ledger records every (seed, attempt, derived_seed,
-        outcome) and the final quarantine set, then enforces the per-K
-        min-healthy-frac floor."""
+        ``rerun(k, seeds, iters=, attempt=) -> (spectra (R,k',g) numpy,
+        errs (R,) numpy)`` re-solves a list of replicates at one K (each
+        path supplies its own solver family; ``k' >= k`` for K_max-padded
+        outputs; ``iters``/``attempt`` carry the lanes' ledger identity so
+        the rowsharded path can checkpoint retries too). Seeds are derived
+        per attempt (``resilience.derive_retry_seed``), so an interrupted
+        run resumed later retries with identical seeds; the guard's ledger
+        records every (seed, attempt, derived_seed, outcome) and the final
+        quarantine set, then enforces the per-K min-healthy-frac floor."""
         from ..runtime import faults, resilience
 
         attempt = 1
@@ -1111,7 +1114,8 @@ class cNMF:
                       "k=%d with derived seeds (attempt %d/%d)."
                       % (worker_i, len(tasks), k, attempt,
                          guard.max_retries))
-                spectra, errs = rerun(k, derived)
+                spectra, errs = rerun(k, derived, iters=iters,
+                                      attempt=attempt)
                 spectra, errs = faults.maybe_poison_lanes(
                     k, iters, spectra, errs, attempt=attempt,
                     seeds=orig_seeds)
@@ -1127,11 +1131,22 @@ class cNMF:
         guard.finalize()
 
     def _factorize_rowsharded(self, jobs, run_params, norm_counts,
-                              nmf_kwargs, mesh, worker_i, guard=None):
+                              nmf_kwargs, mesh, worker_i, guard=None,
+                              resume=False):
         """Atlas-scale factorize: cells sharded over the mesh, replicates
         sequential. X streams host→HBM once (shard-sized CSR blocks, no host
         dense copy) and is reused by every replicate; padded rows contribute
-        nothing to the psum'd W statistics (rowshard.py)."""
+        nothing to the psum'd W statistics (rowshard.py).
+
+        Mid-run checkpointing (ISSUE 6, ``runtime/checkpoint.py``): under
+        ``CNMF_TPU_CKPT_EVERY_PASSES`` (default 1) each replicate's pass
+        state persists atomically per pass, and a ``resume``
+        (``--skip-completed-runs``) continues an interrupted replicate
+        from its newest valid checkpoint instead of re-deriving from
+        scratch; ``=0`` keeps the fused pre-checkpoint programs,
+        byte-identical. Shard staging failures flow into the resilience
+        ledger (``ReplicateGuard.record_shard_fault``) before the run
+        aborts cleanly."""
         from ..parallel import default_mesh
         from ..parallel.rowshard import nmf_fit_rowsharded, prepare_rowsharded
 
@@ -1143,11 +1158,32 @@ class cNMF:
 
             mesh = Mesh(np.asarray(jax.devices()[:1]), ("cells",))
 
-        from ..parallel.streaming import StreamStats
+        from ..parallel.streaming import (ShardStallError, ShardUploadError,
+                                          StreamStats)
+        from ..runtime import checkpoint as ckpt_mod
+        from ..runtime import faults, resilience
+
+        if guard is None:
+            guard = resilience.ReplicateGuard(
+                events=self._events,
+                ledger_path=self.paths["resilience_ledger"] % int(worker_i))
 
         stage_stats = StreamStats() if self._events.enabled else None
-        Xd, n_orig = prepare_rowsharded(norm_counts.X, mesh,
-                                        stats=stage_stats)
+        try:
+            Xd, n_orig = prepare_rowsharded(norm_counts.X, mesh,
+                                            stats=stage_stats,
+                                            events=self._events)
+        except (ShardUploadError, ShardStallError) as exc:
+            # exhausted/stalled shards land in the PR-4 ledger before the
+            # abort: the staged array cannot be completed, so there is no
+            # degraded mode here — but the audit trail (and the launcher's
+            # respawn, which re-stages) must see WHY the worker died
+            guard.record_shard_fault(
+                "shard_stall" if isinstance(exc, ShardStallError)
+                else "shard_upload_failed",
+                {"stage": "rowshard_stage_x", "error": str(exc)})
+            guard.finalize()
+            raise
         if stage_stats is not None:
             self._events.emit_stream("rowshard_stage_x", stage_stats)
         _, n_passes_eff, _ = resolve_online_schedule(
@@ -1169,9 +1205,63 @@ class cNMF:
              "alpha_H": nmf_kwargs.get("alpha_H", 0.0),
              "mesh_devices": int(np.prod(mesh.devices.shape)),
              "ledger_keys_ignored": ["mode", "online_chunk_size"]})
-        from ..runtime import faults, resilience
 
-        def _solve_rowshard(k_r, seed_r):
+        # mid-run checkpoint policy: cadence from the env (0 disables —
+        # the solver then compiles the exact pre-checkpoint fused
+        # programs); the input digest pins a checkpoint to THIS matrix
+        ckpt_every = ckpt_mod.ckpt_every_passes()
+        beta_val = beta_loss_to_float(nmf_kwargs["beta_loss"])
+        digest = (ckpt_mod.input_digest(norm_counts.X) if ckpt_every > 0
+                  else None)
+        # resolved-solver-recipe signature: pins the checkpoint to the
+        # SETTINGS it was computed under, not just the matrix — a
+        # re-prepare with different iteration caps/regularization must
+        # restart the replicate, never splice two recipes' trajectories
+        params_sig = repr(sorted({
+            "init": str(nmf_kwargs.get("init", "random")),
+            "tol": float(nmf_kwargs.get("tol", 1e-4)),
+            "n_passes": int(n_passes_eff),
+            "chunk_max_iter": int(nmf_kwargs.get(
+                "online_chunk_max_iter", _DEFAULT_CHUNK_MAX_ITER)),
+            "alpha_W": float(nmf_kwargs.get("alpha_W", 0.0)),
+            "l1_ratio_W": float(nmf_kwargs.get("l1_ratio_W", 0.0)),
+            "alpha_H": float(nmf_kwargs.get("alpha_H", 0.0)),
+            "l1_ratio_H": float(nmf_kwargs.get("l1_ratio_H", 0.0)),
+        }.items()))
+
+        def _make_ckpt(k_c, it_c, seed_c, attempt=0):
+            """Checkpoint policy for one (k, iter) solve. Retry attempts
+            (``attempt >= 1``) checkpoint too — exactly the lanes that
+            just burned a multi-hour solve — under an attempt-suffixed
+            path with the DERIVED seed in the identity, and always load
+            with ``resume=True``: the retry ladder is deterministic
+            (identical derived seeds on relaunch), so a matching
+            checkpoint can only be this retry's own interrupted state."""
+            if ckpt_every <= 0:
+                return None
+            path = self.paths["pass_checkpoint"] % (int(k_c), int(it_c))
+            if int(attempt) > 0:
+                assert path.endswith(".npz")
+                path = path[:-4] + ".a%d.npz" % int(attempt)
+            elif not resume:
+                # fresh runs void prior retry cursors along with the
+                # base one (PassCheckpointer only discards its own path)
+                import glob as _glob
+
+                for stale in _glob.glob(path[:-4] + ".a*.npz"):
+                    try:
+                        os.unlink(stale)
+                    except OSError:
+                        pass
+            return ckpt_mod.PassCheckpointer(
+                path, ckpt_every,
+                meta={"k": int(k_c), "iter": int(it_c), "seed": int(seed_c),
+                      "attempt": int(attempt), "digest": digest,
+                      "beta": float(beta_val), "params": params_sig},
+                events=self._events, worker=worker_i,
+                resume=(resume if int(attempt) == 0 else True))
+
+        def _solve_rowshard(k_r, seed_r, ckpt=None):
             _H, spectra, err = nmf_fit_rowsharded(
                 Xd, int(k_r), mesh,
                 beta_loss=nmf_kwargs["beta_loss"],
@@ -1185,17 +1275,15 @@ class cNMF:
                 alpha_H=nmf_kwargs.get("alpha_H", 0.0),
                 l1_ratio_H=nmf_kwargs.get("l1_ratio_H", 0.0),
                 n_orig=n_orig,
-                telemetry_sink=self._emit_replicates_event)
+                telemetry_sink=self._emit_replicates_event,
+                checkpoint=ckpt)
             return np.asarray(spectra), err
 
-        if guard is None:
-            guard = resilience.ReplicateGuard(
-                events=self._events,
-                ledger_path=self.paths["resilience_ledger"] % int(worker_i))
         for idx in jobs:
             p = run_params.iloc[idx, :]
             k, it = int(p["n_components"]), int(p["iter"])
-            spectra, err = _solve_rowshard(k, p["nmf_seed"])
+            ckpt = _make_ckpt(k, it, p["nmf_seed"])
+            spectra, err = _solve_rowshard(k, p["nmf_seed"], ckpt=ckpt)
             sp3, errs = faults.maybe_poison_lanes(
                 k, [it], spectra[None], np.asarray([err]),
                 seeds=[int(p["nmf_seed"])])
@@ -1205,10 +1293,26 @@ class cNMF:
             if healthy[0]:
                 self._write_iter_spectra(k, it, sp3[0],
                                          norm_counts.var.index)
+            if ckpt is not None:
+                # the replicate's durable artifact (or its quarantine
+                # record, for unhealthy lanes whose retries run with
+                # derived seeds) supersedes the mid-run cursor. Discarded
+                # AFTER the artifact write: a kill in between still
+                # resumes from the final checkpoint instead of rerunning
+                ckpt.discard()
             faults.maybe_kill("factorize", worker_i)
 
-        def rerun_rowshard(k_r, seeds_r):
-            outs = [_solve_rowshard(k_r, s) for s in seeds_r]
+        def rerun_rowshard(k_r, seeds_r, iters=None, attempt=0):
+            # retries checkpoint too (review finding): these are exactly
+            # the multi-hour replicates that just failed once — a
+            # preemption mid-retry must not also lose the retry's passes
+            outs = []
+            for j, s in enumerate(seeds_r):
+                ckpt = (None if iters is None else
+                        _make_ckpt(k_r, iters[j], s, attempt=attempt))
+                outs.append(_solve_rowshard(k_r, s, ckpt=ckpt))
+                if ckpt is not None:
+                    ckpt.discard()
             return (np.stack([o[0] for o in outs]),
                     np.asarray([o[1] for o in outs], np.float64))
 
@@ -1230,7 +1334,7 @@ class cNMF:
         from ..parallel import is_coordinator, sync_hosts
         from ..parallel.multihost import replicate_sweep_2d, stage_x_2d
 
-        Xd = stage_x_2d(norm_counts.X, mesh)
+        Xd = stage_x_2d(norm_counts.X, mesh, events=self._events)
         _, n_passes_eff, _ = resolve_online_schedule(
             beta_loss_to_float(nmf_kwargs["beta_loss"]), 0.05,
             nmf_kwargs.get("n_passes"))

@@ -1,4 +1,5 @@
 from .multihost import (
+    HostBarrierTimeout,
     initialize_distributed,
     is_coordinator,
     mesh_2d,
@@ -16,12 +17,17 @@ from .replicates import (
 )
 from .rowshard import fit_h_rowsharded, nmf_fit_rowsharded, pad_rows_to_mesh
 from .streaming import (
+    ShardStallError,
+    ShardUploadError,
     StreamStats,
     stream_put_leaves,
     stream_to_device,
 )
 
 __all__ = [
+    "HostBarrierTimeout",
+    "ShardStallError",
+    "ShardUploadError",
     "StreamStats",
     "stream_put_leaves",
     "stream_to_device",

@@ -61,12 +61,71 @@ __all__ = [
     "mesh_2d",
     "replicate_sweep_2d",
     "sync_hosts",
+    "HostBarrierTimeout",
+    "barrier_timeout_s",
 ]
 
 _ENV_COORD = "CNMF_COORDINATOR_ADDRESS"
 _ENV_NPROC = "CNMF_NUM_PROCESSES"
 _ENV_PID = "CNMF_PROCESS_ID"
+BARRIER_TIMEOUT_ENV = "CNMF_TPU_BARRIER_TIMEOUT_S"
 _initialized = False
+
+
+class HostBarrierTimeout(RuntimeError):
+    """A cross-host barrier did not complete within
+    ``CNMF_TPU_BARRIER_TIMEOUT_S`` — a peer host is dead or wedged. The
+    single-controller program cannot make progress without it, so this
+    converts the distributed hang into a clean abort: relaunch the SAME
+    command on every host and factorize resumes from its per-replicate
+    artifacts and the newest valid pass checkpoint."""
+
+
+def barrier_timeout_s() -> float:
+    """Cross-host barrier watchdog in seconds
+    (``CNMF_TPU_BARRIER_TIMEOUT_S``, default 0 = wait forever — the
+    pre-watchdog behavior). Non-numeric or negative values reject at
+    parse time with a one-line message (``utils/envknobs.py``)."""
+    from ..utils.envknobs import env_float
+
+    return env_float(BARRIER_TIMEOUT_ENV, 0.0, lo=0.0)
+
+
+def _wait_with_timeout(fn, timeout_s: float, name: str):
+    """Run a (blocking, uninterruptible) collective with a wall-clock
+    watchdog: the collective runs on a daemon thread and the caller waits
+    ``timeout_s`` for it. On expiry the thread is abandoned (a wedged
+    collective cannot be cancelled, only diagnosed) and
+    :class:`HostBarrierTimeout` raises so the process exits cleanly
+    instead of hanging the whole mesh forever. ``timeout_s <= 0`` runs
+    inline, unchanged."""
+    if not timeout_s or timeout_s <= 0:
+        fn()
+        return
+    import threading
+
+    done = threading.Event()
+    errs: list[BaseException] = []
+
+    def run():
+        try:
+            fn()
+        except BaseException as exc:  # surfaced to the caller below
+            errs.append(exc)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, name=f"cnmf-barrier-{name}",
+                         daemon=True)
+    t.start()
+    if not done.wait(timeout_s):
+        raise HostBarrierTimeout(
+            "barrier %r did not complete within %gs (%s) — a peer host is "
+            "likely dead. Aborting with state checkpointed; relaunch the "
+            "same command on every host to resume from the newest valid "
+            "checkpoint." % (name, timeout_s, BARRIER_TIMEOUT_ENV))
+    if errs:
+        raise errs[0]
 
 
 def initialize_distributed(coordinator_address: str | None = None,
@@ -155,15 +214,22 @@ def is_coordinator() -> bool:
     return jax.process_index() == 0
 
 
-def sync_hosts(name: str = "cnmf") -> None:
+def sync_hosts(name: str = "cnmf", timeout_s: float | None = None) -> None:
     """Barrier across hosts (no-op single-process). Used around artifact
     writes so non-coordinator hosts don't race ahead and read files the
     coordinator hasn't written yet — the same write-then-read discipline the
-    reference gets from stage boundaries (SURVEY.md §5.2)."""
+    reference gets from stage boundaries (SURVEY.md §5.2).
+
+    Bounded (ISSUE 6): under ``CNMF_TPU_BARRIER_TIMEOUT_S`` (or an
+    explicit ``timeout_s``) a barrier a dead host can never join raises
+    :class:`HostBarrierTimeout` — a clean, checkpoint-resumable abort —
+    instead of wedging every surviving host forever."""
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(name)
+        timeout = barrier_timeout_s() if timeout_s is None else timeout_s
+        _wait_with_timeout(
+            lambda: multihost_utils.sync_global_devices(name), timeout, name)
 
 
 def _balanced_rc(n_dev: int, n_proc: int) -> tuple[int, int]:
@@ -369,9 +435,10 @@ def replicate_sweep_2d(X, seeds, k: int, mesh: Mesh, beta_loss="frobenius",
     return spectra, errs
 
 
-def stage_x_2d(X, mesh: Mesh, dtype=jnp.float32):
+def stage_x_2d(X, mesh: Mesh, dtype=jnp.float32, events=None):
     """Stage a host matrix for repeated 2-D sweeps: rows sharded over the
     cells axis, replicated over the replicate axis; one shard-sized CSR
     block densifies at a time (no whole-matrix host densify)."""
-    Xd, _pad = stream_rows_to_mesh(X, mesh, mesh.axis_names[1], dtype=dtype)
+    Xd, _pad = stream_rows_to_mesh(X, mesh, mesh.axis_names[1], dtype=dtype,
+                                   events=events)
     return Xd

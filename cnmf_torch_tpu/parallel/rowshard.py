@@ -81,7 +81,7 @@ def pad_rows_to_mesh(X, multiple: int):
 
 def stream_rows_to_mesh(X, mesh: Mesh, axis: str, dtype=jnp.float32,
                         pad_multiple: int | None = None,
-                        stats: StreamStats | None = None):
+                        stats: StreamStats | None = None, events=None):
     """Out-of-core host→HBM transfer: build the row-sharded device array
     straight from a host CSR (or dense) matrix. Sparse inputs densify
     slab-by-slab (on device via ``streaming._csr_densify``, or on host per
@@ -115,14 +115,14 @@ def stream_rows_to_mesh(X, mesh: Mesh, axis: str, dtype=jnp.float32,
     sharding = NamedSharding(mesh, P(axis, None))
     if sp.issparse(X):
         return _stream_csr_sharded(X.tocsr(), sharding, dtype,
-                                   stats=stats), pad
+                                   stats=stats, events=events), pad
     return _stream_dense_sharded(np.asarray(X), sharding, dtype,
-                                 stats=stats), pad
+                                 stats=stats, events=events), pad
 
 
 def stream_ell_to_mesh(X, mesh: Mesh, axis: str, width: int | None = None,
                        pad_multiple: int | None = None,
-                       stats: StreamStats | None = None):
+                       stats: StreamStats | None = None, events=None):
     """Row-shard a host CSR matrix as fixed-width ELL — the beta != 2
     sparse staging path. The CSR buffers are already what crosses the wire
     on this path (``_stream_csr_sharded``); instead of densifying into an
@@ -206,7 +206,8 @@ def stream_ell_to_mesh(X, mesh: Mesh, axis: str, width: int | None = None,
         leaf_arrs[dev] = parts
 
     t_wall = time.perf_counter()
-    run_pipeline(devs, prep, commit, depth=ell_depth, threads=ell_threads)
+    run_pipeline(devs, prep, commit, depth=ell_depth, threads=ell_threads,
+                 fault_context="stream_ell", events=events)
 
     def assemble(shape, leaf_i, leaf_shard):
         arrs = [leaf_arrs[dev][leaf_i] for dev in devs]
@@ -227,12 +228,14 @@ def stream_ell_to_mesh(X, mesh: Mesh, axis: str, width: int | None = None,
     return EllMatrix(vals, cols, g, rows_t, perm_t), pad
 
 
-def prepare_rowsharded(X, mesh: Mesh, stats: StreamStats | None = None):
+def prepare_rowsharded(X, mesh: Mesh, stats: StreamStats | None = None,
+                       events=None):
     """Stage a counts matrix for repeated row-sharded solves (one transfer,
     many replicates). Returns ``(X_device, n_orig)`` to pass to
     :func:`nmf_fit_rowsharded` / :func:`fit_h_rowsharded`."""
     n_orig = int(X.shape[0])
-    Xd, _ = stream_rows_to_mesh(X, mesh, mesh.axis_names[0], stats=stats)
+    Xd, _ = stream_rows_to_mesh(X, mesh, mesh.axis_names[0], stats=stats,
+                                events=events)
     return Xd, n_orig
 
 
@@ -242,7 +245,16 @@ def _rowsharded_pass(X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
 
     Runs identically on every device; `psum` makes the W statistics global,
     so the replicated W stays bit-identical across shards.
+
+    Returns ``(H_local, W, err, A, B)``. For beta=2, ``(A, B)`` are the
+    pass's psum'd sufficient statistics (``H^T X``, ``H^T H``) — already
+    computed for the W-subproblem, and exactly what the mid-run
+    checkpoint persists (runtime/checkpoint.py; they are also the seed of
+    ROADMAP item 4's incremental updates). For beta != 2 the W step has
+    no cross-pass statistics, so ``(A, B)`` are ``None`` (callers inside
+    while_loops drop them; the checkpoint stores zeros).
     """
+    A = B = None
     WWT = W @ W.T if beta == 2.0 else None
     H_local = _chunk_h_solve(X_local, H_local, W, WWT, beta, l1_H, l2_H,
                              chunk_max_iter, h_tol)
@@ -264,7 +276,7 @@ def _rowsharded_pass(X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
             denom = jax.lax.psum(denom, axis)
         W = _apply_rate(W, numer, denom, l1_W, l2_W, gamma=mu_gamma(beta))
         err = jax.lax.psum(ell_beta_err(X_local, H_local, W, beta), axis)
-        return H_local, W, err
+        return H_local, W, err, A, B
     else:
         WH = jnp.maximum(H_local @ W, EPS)
         if beta == 1.0:
@@ -280,7 +292,7 @@ def _rowsharded_pass(X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
     # near-convergence terms to fp32 cancellation, breaking the pass-loop
     # convergence test)
     err = jax.lax.psum(_beta_div_dense(X_local, H_local @ W, beta), axis)
-    return H_local, W, err
+    return H_local, W, err, A, B
 
 
 def _rowsharded_solve_local(X_local, H_local, W, axis, beta, tol, h_tol,
@@ -303,7 +315,7 @@ def _rowsharded_solve_local(X_local, H_local, W, axis, beta, tol, h_tol,
             H_local, W, err_prev, err, it, trace, nonfin = carry
         else:
             H_local, W, err_prev, err, it = carry
-        H_local, W, err_new = _rowsharded_pass(
+        H_local, W, err_new, _, _ = _rowsharded_pass(
             X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
             l1_H, l2_H, l1_W, l2_W)
         if telemetry:
@@ -319,7 +331,7 @@ def _rowsharded_solve_local(X_local, H_local, W, axis, beta, tol, h_tol,
         rel = (err_prev - err) / jnp.maximum(err_prev, EPS)
         return (it < n_passes) & ((it < 2) | (rel >= tol))
 
-    H_local, W, err0 = _rowsharded_pass(
+    H_local, W, err0, _, _ = _rowsharded_pass(
         X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
         l1_H, l2_H, l1_W, l2_W)
     init = (H_local, W, err0 * (1.0 + 2.0 * tol) + 1.0, err0, jnp.int32(1))
@@ -333,6 +345,143 @@ def _rowsharded_solve_local(X_local, H_local, W, axis, beta, tol, h_tol,
         return H_local, W, err, trace, it, nonfin | ~jnp.isfinite(err)
     H_local, W, _, err, _ = out
     return H_local, W, err
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "axis", "beta", "chunk_max_iter",
+                     "l1_H", "l2_H", "l1_W", "l2_W"),
+)
+def _rowshard_pass_jit(X, H, W, mesh, axis, beta, h_tol, chunk_max_iter,
+                       l1_H, l2_H, l1_W, l2_W):
+    """ONE block-coordinate pass as its own dispatch — the unit of the
+    checkpointed host-driven loop (``_fit_rowsharded_checkpointed``). The
+    per-device program is exactly the ``_rowsharded_pass`` body the fused
+    while_loop runs, so per-pass results match the fused program's pass
+    steps. Returns ``(H, W, err)`` plus, at beta=2, the pass's psum'd
+    sufficient statistics ``(A, B)`` for the checkpoint."""
+    with_stats = beta == 2.0
+    out_specs = ((P(axis, None), P(), P(), P(), P()) if with_stats
+                 else (P(axis, None), P(), P()))
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), P()), out_specs=out_specs,
+    )
+    def run(X_local, H_local, W):
+        H_local, W, err, A, B = _rowsharded_pass(
+            X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
+            l1_H, l2_H, l1_W, l2_W)
+        if with_stats:
+            return H_local, W, err[None], A, B
+        return H_local, W, err[None]
+
+    out = run(X, H, W)
+    if with_stats:
+        H, W, err, A, B = out
+        return H, W, err[0], A, B
+    H, W, err = out
+    return H, W, err[0], None, None
+
+
+def _fit_rowsharded_checkpointed(Xd, H0, W0, mesh, axis, beta, tol, h_tol,
+                                 n_passes, chunk_max_iter,
+                                 l1_H, l2_H, l1_W, l2_W, ckpt):
+    """Host-driven pass loop with mid-run checkpoints — the checkpointed
+    twin of :func:`_fit_rowsharded_jit`'s fused while_loop (same per-pass
+    program, same f32 convergence test, same stopping rule; the loop
+    merely lives on host so state can be persisted between dispatches).
+
+    Every ``ckpt.every`` completed passes the replicated ``W``, the pass
+    statistics, the cursor, and (under the byte budget) ``H`` land on
+    disk atomically; ``ckpt.load()`` on a resume restores them and the
+    loop continues mid-run instead of re-deriving from scratch. With H in
+    the checkpoint the resumed trajectory is bit-identical; without it, H
+    re-derives from the restored W (one tightly solved block-coordinate
+    pass — the sufficient-statistics trade, runtime/checkpoint.py).
+
+    Returns ``(H, W, err, trace (TRACE_LEN,) np, passes, nonfinite)``.
+    """
+    row_sh = NamedSharding(mesh, P(axis, None))
+    rep_sh = NamedSharding(mesh, P())
+    k, g = int(W0.shape[0]), int(W0.shape[1])
+    n_pad = int(Xd.shape[0])
+    h_tol_j = jnp.float32(h_tol)
+    f32 = np.float32
+
+    def one_pass(H, W):
+        return _rowshard_pass_jit(
+            Xd, H, W, mesh, axis, beta, h_tol_j, int(chunk_max_iter),
+            l1_H, l2_H, l1_W, l2_W)
+
+    trace = np.full((TRACE_LEN,), np.nan, np.float32)
+    A = B = None
+    ran_pass = False
+
+    state = ckpt.load(n_rows=n_pad, n_genes=g)
+    if state is not None:
+        W = jax.device_put(jnp.asarray(state["W"]), rep_sh)
+        H = (jax.device_put(jnp.asarray(state["H"]), row_sh)
+             if state["H"] is not None else H0)
+        resumed_without_h = state["H"] is None
+        it = int(state["pass_idx"])
+        err_prev, err = f32(state["err_prev"]), f32(state["err"])
+        n_tr = min(len(state["trace"]), TRACE_LEN)
+        trace[:n_tr] = state["trace"][:n_tr]
+        A, B = state["A"], state["B"]
+    else:
+        resumed_without_h = False
+        H, W, err0, A, B = one_pass(H0, W0)
+        ran_pass = True
+        err = f32(err0)
+        # same f32 arithmetic as the fused loop's init, so the resumed
+        # convergence test sees bit-identical operands
+        err_prev = f32(err * f32(1.0 + 2.0 * tol) + f32(1.0))
+        it = 1
+        trace[0] = err
+
+    def _save():
+        # the H byte budget gates the device->host gather itself (shape is
+        # known up front) — an over-budget atlas-scale H must not cross
+        # the host link every pass just to be discarded by the saver
+        h_np = (np.asarray(H) if n_pad * k * 4 <= ckpt.h_budget else None)
+        ckpt.save(pass_idx=it, err_prev=err_prev, err=err, trace=trace,
+                  W=np.asarray(W),
+                  A=(np.asarray(A) if A is not None
+                     else np.zeros((k, g), np.float32)),
+                  B=(np.asarray(B) if B is not None
+                     else np.zeros((k, k), np.float32)),
+                  H=h_np)
+
+    if ran_pass and ckpt.every and it % ckpt.every == 0 and ckpt.due():
+        _save()
+
+    def active() -> bool:
+        # the fused loop's cond, in the same f32 arithmetic
+        if it >= int(n_passes):
+            return False
+        if it < 2:
+            return True
+        rel = (f32(err_prev) - f32(err)) / max(f32(err_prev), f32(EPS))
+        return bool(rel >= f32(tol))
+
+    while active():
+        H, W, err_new, A, B = one_pass(H, W)
+        ran_pass = True
+        err_prev, err = err, f32(err_new)
+        it += 1
+        trace[min(it - 1, TRACE_LEN - 1)] = err
+        if ckpt.every and it % ckpt.every == 0 and ckpt.due():
+            _save()
+
+    if resumed_without_h and not ran_pass:
+        # already-converged checkpoint without H: the spectra (W) are
+        # final, but the caller also gets usages — re-derive them from W
+        # with one fixed-W solve (W untouched, solver-tolerance H)
+        H = _fit_h_rowsharded_jit(Xd, H0, W, mesh, axis, beta,
+                                  int(chunk_max_iter), h_tol_j, l1_H, l2_H)
+    nonfin = not bool(np.isfinite(f32(err)))
+    return H, W, float(err), trace, it, nonfin
 
 
 @functools.partial(
@@ -377,7 +526,7 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
                        alpha_W: float = 0.0, l1_ratio_W: float = 0.0,
                        alpha_H: float = 0.0, l1_ratio_H: float = 0.0,
                        n_orig: int | None = None, init: str = "random",
-                       telemetry_sink=None):
+                       telemetry_sink=None, checkpoint=None):
     """Factorize a cells-sharded X over ``mesh`` (1-D). Returns
     ``(H (n,k), W (k,g), err)`` as numpy arrays.
 
@@ -385,6 +534,14 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
     record dict (per-pass objective trace, passes run, capped/nonfinite
     flags) — active only under ``CNMF_TPU_TELEMETRY``; the telemetry-off
     program is unchanged.
+
+    ``checkpoint``: optional
+    :class:`~cnmf_torch_tpu.runtime.checkpoint.PassCheckpointer` — the
+    solve then runs the checkpointed host-driven pass loop
+    (:func:`_fit_rowsharded_checkpointed`): pass state persists every
+    ``checkpoint.every`` passes and a valid checkpoint resumes mid-run.
+    ``None`` (or ``every <= 0``) keeps the fused single-dispatch
+    while_loop program, byte-identical to the pre-checkpoint build.
 
     ``X`` may be a host matrix (dense or CSR — streamed shard-by-shard to
     HBM without a host dense copy) or a device array already staged by
@@ -461,6 +618,20 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
         from ..utils.telemetry import telemetry_enabled
 
         want_telem = telemetry_enabled()
+    if checkpoint is not None and getattr(checkpoint, "every", 0) > 0:
+        H, W, err, trace_np, passes, nonfin = _fit_rowsharded_checkpointed(
+            Xd, H0, W0, mesh, axis, beta, float(tol), float(h_tol),
+            int(n_passes), int(chunk_max_iter), l1_H, l2_H, l1_W, l2_W,
+            checkpoint)
+        if want_telem:
+            telemetry_sink({
+                "k": int(k), "beta": float(beta), "mode": "rowshard",
+                "seeds": [int(seed)], "cap": int(n_passes),
+                "cadence": "pass", "trace": trace_np[None],
+                "iters": np.asarray([passes]),
+                "nonfinite": np.asarray([nonfin]),
+                "errs": np.asarray([err], np.float64)})
+        return (np.asarray(H)[:n_orig], np.asarray(W), float(err))
     out = _fit_rowsharded_jit(
         Xd, H0, W0, mesh, axis, beta, jnp.float32(tol), jnp.float32(h_tol),
         int(n_passes), int(chunk_max_iter), l1_H, l2_H, l1_W, l2_W,

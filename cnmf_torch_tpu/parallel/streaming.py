@@ -38,6 +38,16 @@ This module provides the general machinery:
     :func:`_csr_transport`; the full dense matrix never exists on host),
     used by ``cNMF._stage_dense`` and the replicate-sweep staging sites.
 
+Shard-granular fault containment (ISSUE 6): a failed slab prep/transfer
+retries with bounded exponential backoff (``CNMF_TPU_SHARD_RETRIES``)
+instead of failing the whole staging call on a transient error, raising
+:class:`ShardUploadError` only when the budget is exhausted; a transfer
+that stops making progress for ``CNMF_TPU_STREAM_STALL_S`` seconds is
+converted into a diagnosable :class:`ShardStallError` by the commit-side
+watchdog instead of hanging the factorize (and, downstream, the whole
+mesh) forever. Both emit telemetry ``fault`` events when the caller
+threads an event log through.
+
 Env knobs
 ---------
 ``CNMF_TPU_STREAM_DEPTH``    max prepared-but-uncommitted slabs in flight
@@ -47,6 +57,18 @@ Env knobs
 ``CNMF_TPU_STREAM_BYTES``    host bytes budget for in-flight slab buffers
                              (default 4 GiB) — depth is clamped so
                              ``depth * slab_bytes`` stays under it
+``CNMF_TPU_SHARD_RETRIES``   per-slab upload retry budget (default 2;
+                             0 disables retries)
+``CNMF_TPU_SHARD_BACKOFF_S`` retry backoff base: attempt N waits
+                             ``base * 2^(N-1)`` seconds (default 0.1)
+``CNMF_TPU_STREAM_STALL_S``  per-slab wall-clock watchdog on the
+                             pipelined path (default 0 = off): a slab
+                             whose prep+transfer exceeds it raises
+                             ``ShardStallError``
+
+All knobs are validated at parse time — a negative/zero-where-invalid or
+non-numeric value raises immediately with a one-line message naming the
+knob, instead of falling through to a confusing downstream error.
 """
 
 from __future__ import annotations
@@ -56,6 +78,7 @@ import functools
 import os
 import threading
 import time
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -66,7 +89,9 @@ from ..runtime.faults import maybe_fail as _maybe_fail_fault
 
 __all__ = ["StreamStats", "SlabBufferPool", "run_pipeline", "nnz_bucket",
            "stream_threads", "stream_depth", "stream_to_device",
-           "stream_put_leaves", "DENSIFY_SLAB_ROWS"]
+           "stream_put_leaves", "DENSIFY_SLAB_ROWS",
+           "ShardStallError", "ShardUploadError",
+           "shard_retries", "stream_stall_s"]
 
 # rows per on-device scatter / dense slab. TPU scatter materializes
 # sort/workspace temporaries proportional to its OUTPUT, so densifying a
@@ -86,27 +111,52 @@ DEPTH_ENV = "CNMF_TPU_STREAM_DEPTH"
 THREADS_ENV = "CNMF_TPU_STREAM_THREADS"
 BYTES_ENV = "CNMF_TPU_STREAM_BYTES"
 TRANSPORT_ENV = "CNMF_TPU_STREAM_TRANSPORT"
+SHARD_RETRIES_ENV = "CNMF_TPU_SHARD_RETRIES"
+SHARD_BACKOFF_ENV = "CNMF_TPU_SHARD_BACKOFF_S"
+STALL_ENV = "CNMF_TPU_STREAM_STALL_S"
 
 _DEFAULT_BYTES_BUDGET = 4 << 30
 
 
-def _env_int(name: str, default: int) -> int:
-    raw = os.environ.get(name, "")
-    if not raw:
-        return default
-    try:
-        return int(raw)
-    except ValueError:
-        return default
+class ShardUploadError(RuntimeError):
+    """A shard/slab upload kept failing after the CNMF_TPU_SHARD_RETRIES
+    budget — the staged array cannot be completed."""
+
+
+class ShardStallError(RuntimeError):
+    """A shard/slab transfer made no progress for CNMF_TPU_STREAM_STALL_S
+    seconds — converted from a silent distributed hang into a diagnosable
+    failure (abort cleanly, then relaunch to resume from the newest
+    checkpoint)."""
+
+
+# strict parsers (utils/envknobs.py — the ONE definition): bad values
+# reject at parse time with a one-line message naming the knob
+from ..utils.envknobs import env_float as _env_float, env_int as _env_int
+
+
+def shard_retries() -> int:
+    """Per-slab upload retry budget (``CNMF_TPU_SHARD_RETRIES``, default
+    2; 0 disables retries — the first failure raises)."""
+    return _env_int(SHARD_RETRIES_ENV, 2, lo=0)
+
+
+def stream_stall_s() -> float:
+    """Per-slab progress watchdog in seconds (``CNMF_TPU_STREAM_STALL_S``,
+    default 0 = disabled). Enforced on the pipelined path, where the
+    commit thread awaits worker futures; the serial fallback has no
+    independent thread to watch."""
+    return _env_float(STALL_ENV, 0.0, lo=0.0)
 
 
 def stream_threads() -> int:
     """Host-prep worker count. 0 disables the pipeline (serial staging).
     Default leaves one core for the caller thread's commit dispatch and
     the XLA runtime (measured faster than cpu_count workers on small
-    hosts, where an extra worker just contends for memory bandwidth)."""
-    return max(0, _env_int(THREADS_ENV,
-                           max(1, min(4, (os.cpu_count() or 2) - 1))))
+    hosts, where an extra worker just contends for memory bandwidth).
+    Negative or non-numeric values reject at parse time."""
+    return _env_int(THREADS_ENV,
+                    max(1, min(4, (os.cpu_count() or 2) - 1)), lo=0)
 
 
 def stream_depth(slab_bytes: int | None = None,
@@ -120,9 +170,9 @@ def stream_depth(slab_bytes: int | None = None,
     per-window)."""
     if threads is None:
         threads = stream_threads()
-    depth = _env_int(DEPTH_ENV, max(2 * threads + 1, 3))
+    depth = _env_int(DEPTH_ENV, max(2 * threads + 1, 3), lo=1)
     if slab_bytes and slab_bytes > 0:
-        budget = max(_env_int(BYTES_ENV, _DEFAULT_BYTES_BUDGET), 1)
+        budget = _env_int(BYTES_ENV, _DEFAULT_BYTES_BUDGET, lo=1)
         depth = min(depth,
                     max(budget // (int(slab_bytes) * max(windows, 1)), 1))
     return max(depth, 1)
@@ -247,8 +297,81 @@ def nnz_bucket(nnz: int, cap: int, floor: int = 1024) -> int:
     return min(b, cap)
 
 
+def _emit_fault(events, kind: str, context: dict):
+    """Best-effort telemetry ``fault`` event — ``events`` is an optional
+    EventLog-shaped object (``emit`` never raises there, but stay safe
+    against foreign sinks: telemetry must not take staging down)."""
+    if events is None:
+        return
+    try:
+        events.emit("fault", kind=kind, context=context)
+    except Exception:
+        pass
+
+
+def _retrying(prep, context: str | None, events, heartbeat: dict | None = None):
+    """Wrap a slab prep with the shard-granular retry policy: transient
+    prep/transfer failures retry with bounded exponential backoff
+    (``CNMF_TPU_SHARD_RETRIES`` / ``CNMF_TPU_SHARD_BACKOFF_S``) before
+    the exhausted slab fails the staging call as
+    :class:`ShardUploadError`. Also hosts the ``stall`` fault-injection
+    hook (runtime/faults.py), which sits where a real wire hang would.
+
+    ``heartbeat`` (threaded path): the wrapper stamps
+    ``heartbeat[id(task)]`` at the start of every attempt — including
+    after each backoff sleep — so the stall watchdog measures PER-ATTEMPT
+    progress and legitimate retry/backoff time never masquerades as a
+    hang (the two knobs compose instead of conflicting)."""
+    retries = shard_retries()
+    backoff = _env_float(SHARD_BACKOFF_ENV, 0.1, lo=0.0)
+
+    from ..runtime.faults import maybe_stall as _maybe_stall
+
+    def wrapped(task):
+        attempt = 0
+        while True:
+            if heartbeat is not None:
+                heartbeat[id(task)] = time.monotonic()
+            if attempt == 0:
+                _maybe_stall(context=context)
+            try:
+                return prep(task)
+            except (ShardStallError, ShardUploadError, KeyboardInterrupt,
+                    SystemExit):
+                raise
+            except Exception as exc:
+                attempt += 1
+                ctx = {"context": str(context), "task": str(task),
+                       "attempt": attempt,
+                       "error": f"{type(exc).__name__}: {exc}"}
+                if attempt > retries:
+                    _emit_fault(events, "shard_upload_failed", ctx)
+                    raise ShardUploadError(
+                        "shard upload failed after %d attempt(s) "
+                        "(context=%s, task=%s): %s: %s — raise %s to retry "
+                        "transient transfer faults more"
+                        % (attempt, context, task, type(exc).__name__, exc,
+                           SHARD_RETRIES_ENV)) from exc
+                _emit_fault(events, "shard_retry", ctx)
+                delay = backoff * (2 ** (attempt - 1))
+                warnings.warn(
+                    "shard upload attempt %d/%d failed (%s: %s); retrying "
+                    "in %.2gs" % (attempt, retries, type(exc).__name__, exc,
+                                  delay),
+                    RuntimeWarning, stacklevel=2)
+                if heartbeat is not None:
+                    # stamp the backoff window FORWARD: the sleep is the
+                    # retry policy working, not a hang — the stall budget
+                    # starts counting again when the next attempt begins
+                    heartbeat[id(task)] = time.monotonic() + delay
+                time.sleep(delay)
+
+    return wrapped
+
+
 def run_pipeline(tasks, prep, commit, *, depth: int | None = None,
-                 threads: int | None = None):
+                 threads: int | None = None, fault_context: str | None = None,
+                 events=None):
     """Sliding-window pipeline: ``prep(task)`` on worker threads, with at
     most ``depth`` tasks prepared-but-uncommitted; ``commit(task,
     payload)`` on the caller thread in exact submission order (donated
@@ -256,36 +379,87 @@ def run_pipeline(tasks, prep, commit, *, depth: int | None = None,
 
     ``depth <= 1``, ``threads <= 0``, or a single task degrade to the
     serial loop — bit-identical behavior, no threads spawned.
+
+    Fault containment (ISSUE 6): every prep rides the shard-granular
+    retry wrapper (:func:`_retrying`); on the threaded path the commit
+    side additionally enforces the ``CNMF_TPU_STREAM_STALL_S`` watchdog —
+    a slab whose prep+transfer makes no progress for that long raises
+    :class:`ShardStallError` instead of hanging the caller forever (the
+    stalled worker thread is abandoned, not joined: a hung transfer
+    cannot be interrupted, only diagnosed and relaunched around).
+    ``fault_context`` names the staging site in fault events/errors;
+    ``events`` is an optional telemetry EventLog.
     """
     tasks = list(tasks)
     if threads is None:
         threads = stream_threads()
     if depth is None:
         depth = stream_depth(threads=threads)
+    stall_s = stream_stall_s()
     if depth <= 1 or threads <= 0 or len(tasks) <= 1:
+        serial_prep = _retrying(prep, fault_context, events)
         for t in tasks:
-            commit(t, prep(t))
+            commit(t, serial_prep(t))
         return
     import concurrent.futures
 
+    # per-attempt progress stamps from the retry wrapper: the watchdog
+    # measures time since the slab's LAST attempt started, so retry
+    # backoff sleeps (a different knob doing its job) never read as a hang
+    heartbeat: dict = {}
+    prep = _retrying(prep, fault_context, events, heartbeat=heartbeat)
+
+    def await_result(task, fut):
+        if stall_s <= 0:
+            return fut.result()
+        poll = min(max(stall_s / 10.0, 0.05), 1.0)
+        while True:
+            try:
+                return fut.result(timeout=poll)
+            except concurrent.futures.TimeoutError:
+                last = heartbeat.get(id(task))
+                if last is not None and time.monotonic() - last <= stall_s:
+                    continue  # attempt still within its progress budget
+                if last is None and not fut.running():
+                    continue  # still queued behind other slabs — not hung
+                ctx = {"context": str(fault_context), "task": str(task),
+                       "stall_s": stall_s}
+                _emit_fault(events, "shard_stall", ctx)
+                raise ShardStallError(
+                    "shard upload made no progress for %gs (%s; context=%s, "
+                    "task=%s) — the transfer is hung, not slow. Aborting "
+                    "this staging call cleanly; relaunch resumes from the "
+                    "newest valid checkpoint." % (stall_s, STALL_ENV,
+                                                  fault_context, task)) \
+                    from None
+
     pending = collections.deque()
-    with concurrent.futures.ThreadPoolExecutor(
-            max_workers=min(threads, len(tasks)),
-            thread_name_prefix="cnmf-stream") as ex:
-        try:
-            for t in tasks:
-                if len(pending) >= depth:
-                    tt, fut = pending.popleft()
-                    commit(tt, fut.result())
-                pending.append((t, ex.submit(prep, t)))
-            while pending:
+    ex = concurrent.futures.ThreadPoolExecutor(
+        max_workers=min(threads, len(tasks)),
+        thread_name_prefix="cnmf-stream")
+    try:
+        for t in tasks:
+            if len(pending) >= depth:
                 tt, fut = pending.popleft()
-                commit(tt, fut.result())
-        except BaseException:
-            # drain so workers never outlive a failed staging call
-            for _, fut in pending:
-                fut.cancel()
-            raise
+                commit(tt, await_result(tt, fut))
+            pending.append((t, ex.submit(prep, t)))
+        while pending:
+            tt, fut = pending.popleft()
+            commit(tt, await_result(tt, fut))
+    except ShardStallError:
+        # a genuinely stalled worker cannot be joined without re-inheriting
+        # the hang it was just converted from: abandon it (it finishes or
+        # dies with the relaunched process) and cancel the queue
+        ex.shutdown(wait=False, cancel_futures=True)
+        raise
+    except BaseException:
+        # every other failure drains cleanly: workers are alive, so waiting
+        # is safe and preserves the old invariant that no worker outlives a
+        # failed staging call (no zombie transfers racing a re-stage)
+        ex.shutdown(wait=True, cancel_futures=True)
+        raise
+    else:
+        ex.shutdown(wait=True)
 
 
 # ---------------------------------------------------------------------------
@@ -394,7 +568,8 @@ def _csr_transport(devices) -> str:
     return "dense" if all(d.platform == "cpu" for d in devices) else "csr"
 
 
-def _stream_csr_sharded(X, sharding, dtype, stats: StreamStats | None = None):
+def _stream_csr_sharded(X, sharding, dtype, stats: StreamStats | None = None,
+                        events=None):
     """Stage a host CSR matrix as a dense sharded device array through the
     pipeline: slab prep (CSR slicing + pad buffers, or host slab densify —
     :func:`_csr_transport`) on the stream thread pool, transfers issued
@@ -517,7 +692,8 @@ def _stream_csr_sharded(X, sharding, dtype, stats: StreamStats | None = None):
             stats.add(device_s=time.perf_counter() - t0)
 
     run_pipeline(tasks, prep_dense if transport == "dense" else prep_csr,
-                 commit, depth=depth, threads=threads)
+                 commit, depth=depth, threads=threads,
+                 fault_context=f"stream_csr:{transport}", events=events)
 
     t0 = time.perf_counter()
     while inflight:
@@ -532,7 +708,7 @@ def _stream_csr_sharded(X, sharding, dtype, stats: StreamStats | None = None):
 
 
 def _stream_dense_sharded(X, sharding, dtype,
-                          stats: StreamStats | None = None):
+                          stats: StreamStats | None = None, events=None):
     """Dense host matrix -> sharded device array, slab-pipelined: workers
     make each slab contiguous at the target dtype (a no-op view when the
     input already is) and upload it; the caller chains donated slab
@@ -577,7 +753,8 @@ def _stream_dense_sharded(X, sharding, dtype,
         if stats is not None:
             stats.add(device_s=time.perf_counter() - t0)
 
-    run_pipeline(tasks, prep, commit, depth=depth, threads=threads)
+    run_pipeline(tasks, prep, commit, depth=depth, threads=threads,
+                 fault_context="stream_dense", events=events)
 
     t0 = time.perf_counter()
     blocks = asm.blocks([dev for dev, _, _ in shards])
@@ -590,7 +767,7 @@ def _stream_dense_sharded(X, sharding, dtype,
 
 
 def stream_to_device(X, device=None, dtype=jnp.float32,
-                     stats: StreamStats | None = None):
+                     stats: StreamStats | None = None, events=None):
     """Stage one host matrix (dense or scipy-sparse) to ONE device as a
     dense f32 array, through the pipeline: sparse inputs ship CSR slabs
     and densify on device (the full dense matrix never exists on host —
@@ -603,9 +780,11 @@ def stream_to_device(X, device=None, dtype=jnp.float32,
         device = jax.local_devices()[0]
     sharding = jax.sharding.SingleDeviceSharding(device)
     if sp.issparse(X):
-        return _stream_csr_sharded(X.tocsr(), sharding, dtype, stats=stats)
+        return _stream_csr_sharded(X.tocsr(), sharding, dtype, stats=stats,
+                                   events=events)
     X = np.asarray(X)
-    return _stream_dense_sharded(X, sharding, dtype, stats=stats)
+    return _stream_dense_sharded(X, sharding, dtype, stats=stats,
+                                 events=events)
 
 
 def stream_put_leaves(arrays, shardings, stats: StreamStats | None = None):

@@ -1,15 +1,20 @@
-"""Execution-resilience runtime: fault injection + quarantine/retry.
+"""Execution-resilience runtime: fault injection, quarantine/retry, and
+mid-run checkpoints.
 
-Two modules, imported explicitly by their consumers (this package pulls
+Three modules, imported explicitly by their consumers (this package pulls
 in no heavy dependencies at import time):
 
   * :mod:`.faults` — the deterministic fault-injection harness behind
     ``CNMF_TPU_FAULT_SPEC`` (NaN replicate lanes, worker SIGKILL, torn
-    artifact files, failed device uploads). Stdlib-only; every hook is a
-    no-op when the spec is unset.
+    artifact files, failed device uploads, stalled transfers). Stdlib-only;
+    every hook is a no-op when the spec is unset.
   * :mod:`.resilience` — the recovery layer: per-replicate health
     evaluation, quarantine + reseeded retry bookkeeping
     (``ReplicateGuard``), torn-artifact validation for resume/combine,
-    and the ``CNMF_TPU_MAX_RETRIES`` / ``CNMF_TPU_MIN_HEALTHY_FRAC``
-    policy knobs.
+    shard-fault ledger records, and the ``CNMF_TPU_MAX_RETRIES`` /
+    ``CNMF_TPU_MIN_HEALTHY_FRAC`` policy knobs.
+  * :mod:`.checkpoint` — mid-run pass-statistics checkpoints for the
+    streaming/rowsharded solvers (``CNMF_TPU_CKPT_EVERY_PASSES``): tiny
+    ``(A, B)``/W/cursor state persisted atomically per replicate so an
+    interrupted multi-hour pass resumes mid-run instead of from scratch.
 """

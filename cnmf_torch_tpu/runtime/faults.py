@@ -13,7 +13,10 @@ spec into injected faults at fixed hook points in the pipeline:
   * ``torn`` — truncate an artifact file AFTER its atomic write lands
     (exercises reader-side validation: resume and combine must detect
     the damage rather than trust the file);
-  * ``upload`` — raise from a host→device staging entry point.
+  * ``upload`` — raise from a host→device staging entry point;
+  * ``stall`` — sleep inside a per-slab staging hook (``seconds=N``,
+    default 30), simulating a hung transfer so the
+    ``CNMF_TPU_STREAM_STALL_S`` watchdog path is testable on demand.
 
 Spec grammar (semicolon-separated clauses)::
 
@@ -47,11 +50,12 @@ __all__ = [
     "maybe_kill",
     "maybe_tear",
     "maybe_fail",
+    "maybe_stall",
 ]
 
 FAULT_SPEC_ENV = "CNMF_TPU_FAULT_SPEC"
 
-_KINDS = ("nonfinite", "kill", "torn", "upload")
+_KINDS = ("nonfinite", "kill", "torn", "upload", "stall")
 _CONTROL_KEYS = ("after", "limit", "once")
 
 
@@ -273,6 +277,39 @@ def maybe_tear(path) -> bool:
         except OSError:
             return False
     return False
+
+
+def maybe_stall(context=None) -> float:
+    """Sleep when a ``stall`` clause matches ``context`` (substring match,
+    like ``upload``'s) — the injectable form of a hung shard transfer.
+    ``seconds`` bounds the hang (default 30, so a stalled worker thread
+    eventually exits even after the watchdog gave up on it); ``limit``
+    defaults to 1 injection per clause. Returns the seconds slept (0.0
+    when nothing matched), so hook sites stay assertable."""
+    spec = active_spec()
+    if spec is None:
+        return 0.0
+    import time
+
+    for clause in spec:
+        if clause.kind != "stall":
+            continue
+        params = clause.params
+        sub = params.get("context")
+        if sub is not None and str(sub) not in str(context or ""):
+            continue
+        clause.hits += 1
+        if clause.hits <= int(params.get("after", 0)):
+            continue
+        if clause.injected >= int(params.get("limit", 1)):
+            continue
+        if not _take_once(params):
+            continue
+        clause.injected += 1
+        secs = float(params.get("seconds", 30.0))
+        time.sleep(secs)
+        return secs
+    return 0.0
 
 
 def maybe_fail(kind: str, **ctx) -> None:

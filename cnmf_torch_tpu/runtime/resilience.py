@@ -222,6 +222,7 @@ class ReplicateGuard:
         self._pending: list[dict] = []
         self.retries: list[dict] = []
         self.quarantined: list[dict] = []
+        self.shard_faults: list[dict] = []
 
     def _emit(self, kind: str, context: dict):
         if self.events is not None:
@@ -308,6 +309,17 @@ class ReplicateGuard:
     def record_torn(self, path: str, reason: str):
         self._emit("torn_artifact", {"path": str(path), "reason": reason})
 
+    def record_shard_fault(self, kind: str, context: dict):
+        """Book a shard-granular staging fault (ISSUE 6: exhausted upload
+        retries, stalled transfers) into the SAME ledger the replicate
+        quarantines live in, so a degraded run's audit trail covers every
+        recovery layer. ``kind`` is the fault class (``shard_upload_failed``
+        / ``shard_stall``); per-slab retry events are emitted by the
+        streaming engine itself."""
+        rec = dict(context, kind=str(kind))
+        self.shard_faults.append(rec)
+        self._emit(str(kind), dict(context))
+
     def finalize(self):
         """Persist the resilience ledger (when anything happened) and
         enforce the per-K survival floor. Raises
@@ -323,7 +335,7 @@ class ReplicateGuard:
                 self.quarantined.append(rec)
                 self._emit("quarantine", rec)
         if self.ledger_path:
-            if self.retries or self.quarantined:
+            if self.retries or self.quarantined or self.shard_faults:
                 from ..utils.anndata_lite import atomic_artifact
 
                 payload = {"schema": 1,
@@ -331,6 +343,8 @@ class ReplicateGuard:
                            "min_healthy_frac": self.min_healthy_frac,
                            "retries": self.retries,
                            "quarantined": self.quarantined}
+                if self.shard_faults:
+                    payload["shard_faults"] = self.shard_faults
                 with atomic_artifact(self.ledger_path) as tmp:
                     with open(tmp, "w") as f:
                         json.dump(payload, f, indent=1)

@@ -1,0 +1,44 @@
+"""Strict env-knob parsing — the ONE definition (ISSUE 6 satellite).
+
+Every numeric ``CNMF_TPU_*`` knob used to fall through to a confusing
+downstream error on a typo; these helpers reject at parse time with a
+one-line message naming the knob. Stdlib-only so the light runtime
+modules (``runtime/checkpoint.py``) can share them with the jax-heavy
+staging layer (``parallel/streaming.py``, ``parallel/multihost.py``)
+without import-order consequences.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["env_int", "env_float"]
+
+
+def env_int(name: str, default: int, lo: int | None = None) -> int:
+    """Parse an integer knob: empty/unset -> ``default``; non-numeric or
+    below the knob's floor raises ``ValueError`` naming the knob."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r}: expected an integer")
+    if lo is not None and val < lo:
+        raise ValueError(f"{name}={raw!r}: must be >= {lo}")
+    return val
+
+
+def env_float(name: str, default: float, lo: float | None = None) -> float:
+    """Parse a float knob with the same strictness as :func:`env_int`."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        val = float(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r}: expected a number")
+    if lo is not None and val < lo:
+        raise ValueError(f"{name}={raw!r}: must be >= {lo}")
+    return val

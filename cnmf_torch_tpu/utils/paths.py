@@ -72,4 +72,12 @@ def build_paths(output_dir: str, name: str, create: bool = True) -> dict:
         # outcome) and quarantined (k, iter) pairs that combine must
         # treat as deliberately absent. Worker-templated like provenance.
         "resilience_ledger": os.path.join(tmp, name + ".resilience.w%d.json"),
+
+        # TPU-build addition (ISSUE 6): per-replicate mid-run pass
+        # checkpoint (runtime/checkpoint.py) — (A, B)/W/cursor state the
+        # rowsharded factorize persists every CNMF_TPU_CKPT_EVERY_PASSES
+        # passes and discards once the replicate's spectra artifact
+        # lands. The basename contains "ckpt" so the torn:artifact=ckpt
+        # chaos clause can target it.
+        "pass_checkpoint": os.path.join(tmp, name + ".ckpt.k_%d.iter_%d.npz"),
     }

@@ -71,11 +71,17 @@ EVENT_TYPES = {
     "replicates": {"k", "beta", "records"},
     "stream": {"context", "wall_s", "nbytes", "overlap_fraction"},
     "memory": {"stage", "devices"},
-    # resilience events (runtime/resilience.py): nonfinite_replicate /
-    # retry / quarantine / torn_artifact detections, with the (k, iter,
-    # seed, attempt) or (path, reason) context needed to audit a
-    # degraded run
+    # resilience events (runtime/resilience.py + parallel/streaming.py):
+    # nonfinite_replicate / retry / quarantine / torn_artifact /
+    # shard_retry / shard_upload_failed / shard_stall detections, with
+    # the (k, iter, seed, attempt) or (path, reason) or (context, task)
+    # context needed to audit a degraded run
     "fault": {"kind", "context"},
+    # mid-run checkpoint lifecycle (runtime/checkpoint.py): action in
+    # {write, resume, discard} with the replicate identity + pass cursor
+    # — the audit trail the chaos gate uses to prove a relaunch resumed
+    # mid-run instead of from scratch
+    "checkpoint": {"action", "context"},
 }
 
 # per-record required fields inside a "replicates" event's records list
@@ -512,6 +518,45 @@ def summarize_events(events: list[dict]) -> dict:
     if convergence:
         summary["convergence"] = convergence
 
+    # faults & recoveries: per-class counts from the fault stream, plus
+    # the recovery outcomes derivable from it (a `retry` event's context
+    # carries the attempt's health) and the checkpoint lifecycle
+    fault_by_kind: dict = {}
+    retried = recovered = quarantined_n = 0
+    for e in events:
+        if e["t"] != "fault":
+            continue
+        kind = str(e.get("kind"))
+        fault_by_kind[kind] = fault_by_kind.get(kind, 0) + 1
+        if kind == "retry":
+            retried += 1
+            ctx = e.get("context")
+            if isinstance(ctx, dict) and ctx.get("healthy"):
+                recovered += 1
+        elif kind == "quarantine":
+            quarantined_n += 1
+    if fault_by_kind:
+        summary["faults"] = {"by_kind": dict(sorted(fault_by_kind.items())),
+                             "retried": retried, "recovered": recovered,
+                             "quarantined": quarantined_n}
+    ckpt_actions: dict = {}
+    max_resume_pass = None
+    for e in events:
+        if e["t"] != "checkpoint":
+            continue
+        action = str(e.get("action"))
+        ckpt_actions[action] = ckpt_actions.get(action, 0) + 1
+        if action == "resume":
+            ctx = e.get("context")
+            p = ctx.get("pass_idx") if isinstance(ctx, dict) else None
+            if isinstance(p, (int, float)):
+                max_resume_pass = max(int(p), max_resume_pass or 0)
+    if ckpt_actions:
+        ckpt_sum = {"actions": dict(sorted(ckpt_actions.items()))}
+        if max_resume_pass is not None:
+            ckpt_sum["max_resume_pass"] = max_resume_pass
+        summary["checkpoints"] = ckpt_sum
+
     mem_peak = 0
     mem_stage = None
     for e in events:
@@ -625,6 +670,30 @@ def render_report(run_dir: str) -> str:
                 f"{row['nonfinite']:>7d} {row['mean_iters']:>8.1f} "
                 f"{(f'{med:.5g}' if med is not None else '-'):>12s} "
                 f"{(f'{spread:.2e}' if spread is not None else '-'):>11s}")
+
+    if summary.get("faults") or summary.get("checkpoints"):
+        lines.append("")
+        lines.append("Faults & recoveries")
+        lines.append("-" * 19)
+        faults = summary.get("faults") or {}
+        by_kind = faults.get("by_kind") or {}
+        if by_kind:
+            lines.append(f"  {'class':<28s} {'events':>7s}")
+            for kind, n in by_kind.items():
+                lines.append(f"  {kind:<28s} {n:>7d}")
+            lines.append(
+                "  retried %d (recovered %d), quarantined %d"
+                % (faults.get("retried", 0), faults.get("recovered", 0),
+                   faults.get("quarantined", 0)))
+        ckpts = summary.get("checkpoints")
+        if ckpts:
+            actions = ckpts.get("actions", {})
+            parts = [f"{n} {a}" for a, n in actions.items()]
+            line = "  checkpoints: " + ", ".join(parts)
+            if ckpts.get("max_resume_pass") is not None:
+                line += (" (deepest resume: pass %d)"
+                         % ckpts["max_resume_pass"])
+            lines.append(line)
 
     lines.append("")
     lines.append("Device memory")

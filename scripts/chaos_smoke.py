@@ -13,6 +13,17 @@ and asserts the pipeline completes with correct degraded-mode accounting:
      bit-for-bit.
   3. ``torn`` — a truncated artifact is detected (never trusted) by
      combine, and ``--skip-completed-runs`` regenerates it.
+  4. ``stall`` — a hung shard upload trips the ``CNMF_TPU_STREAM_STALL_S``
+     watchdog as a ``ShardStallError`` within its deadline instead of
+     hanging the staging call forever.
+  5. ``kill:stage=pass`` — a rowsharded factorize worker is SIGKILLed
+     mid-pass (after a checkpoint write lands); the launcher respawns it
+     and the relaunch RESUMES from the pass checkpoint (asserted via the
+     telemetry ``checkpoint resume`` event, i.e. NOT from scratch) with
+     merged spectra bit-identical to an uninterrupted run.
+  6. ``torn:artifact=ckpt`` — a truncated pass checkpoint is detected on
+     resume, discarded, and the replicate restarts from scratch,
+     reproducing the clean result.
 
 Exits nonzero on any violated invariant, failing the gate.
 """
@@ -48,12 +59,13 @@ def _counts_file(workdir: str):
     return fn
 
 
-def _prepare(workdir: str, counts_fn: str, name: str):
+def _prepare(workdir: str, counts_fn: str, name: str, components=(3, 4),
+             n_iter: int = 3):
     from cnmf_torch_tpu import cNMF
 
     obj = cNMF(output_dir=workdir, name=name)
-    obj.prepare(counts_fn, components=[3, 4], n_iter=3, seed=4,
-                num_highvar_genes=50, batch_size=64, max_NMF_iter=50)
+    obj.prepare(counts_fn, components=list(components), n_iter=n_iter,
+                seed=4, num_highvar_genes=50, batch_size=64, max_NMF_iter=50)
     return obj
 
 
@@ -178,6 +190,166 @@ def scenario_torn(workdir: str, counts_fn: str) -> None:
           "regenerated by --skip-completed-runs (k=%d iter=%d)" % torn[0])
 
 
+def scenario_stall(workdir: str, counts_fn: str) -> None:
+    """A hung shard transfer must fail within CNMF_TPU_STREAM_STALL_S as a
+    diagnosable ShardStallError, not hang the whole staging call (and,
+    downstream, the mesh) forever."""
+    import time
+
+    import jax
+    import numpy as np
+    import scipy.sparse as sp
+    from jax.sharding import Mesh
+
+    import cnmf_torch_tpu.parallel.streaming as streaming
+    from cnmf_torch_tpu.parallel.rowshard import stream_rows_to_mesh
+    from cnmf_torch_tpu.parallel.streaming import ShardStallError
+
+    X = sp.random(64, 16, density=0.2, format="csr", dtype=np.float32,
+                  random_state=0)
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("cells",))
+    saved_rows = streaming.DENSIFY_SLAB_ROWS
+    streaming.DENSIFY_SLAB_ROWS = 8            # multi-slab staging
+    os.environ[FAULT_ENV] = "stall:context=stream,seconds=3"
+    os.environ["CNMF_TPU_STREAM_STALL_S"] = "0.5"
+    os.environ["CNMF_TPU_STREAM_THREADS"] = "2"
+    t0 = time.monotonic()
+    try:
+        try:
+            stream_rows_to_mesh(X, mesh, "cells")
+            raise AssertionError("stalled upload did not trip the watchdog")
+        except ShardStallError:
+            pass
+        dt = time.monotonic() - t0
+        assert dt < 2.5, f"watchdog fired late ({dt:.1f}s)"
+    finally:
+        streaming.DENSIFY_SLAB_ROWS = saved_rows
+        for key in (FAULT_ENV, "CNMF_TPU_STREAM_STALL_S",
+                    "CNMF_TPU_STREAM_THREADS"):
+            os.environ.pop(key, None)
+    # with the spec cleared, the same staging call succeeds
+    Xd, _pad = stream_rows_to_mesh(X, mesh, "cells")
+    assert np.array_equal(np.asarray(Xd)[:64], X.toarray())
+    print("chaos smoke [stall]: hung shard upload failed as ShardStallError "
+          "in %.2fs (watchdog 0.5s, injected hang 3s)" % dt)
+
+
+def scenario_ckpt_kill(workdir: str, counts_fn: str) -> None:
+    """Mid-pass kill + checkpoint resume through the LAUNCHER: a rowsharded
+    worker dies via kill:stage=pass (fires after a checkpoint write), the
+    launcher respawns it with --skip-completed-runs, and the relaunch
+    resumes from the checkpoint — proven by the telemetry `checkpoint
+    resume` event (pass counter >= 1, i.e. not from scratch) — with merged
+    spectra bit-identical to an uninterrupted run."""
+    import numpy as np
+
+    from cnmf_torch_tpu.launcher import run_pipeline
+    from cnmf_torch_tpu.utils.io import load_df_from_npz
+    from cnmf_torch_tpu.utils.telemetry import (read_events,
+                                                validate_events_file)
+
+    os.environ["CNMF_TPU_WORKER_RESPAWNS"] = "2"
+    os.environ["CNMF_TPU_WORKER_BACKOFF_S"] = "0.1"
+    common = dict(components=[3], n_iter=2, total_workers=1, seed=4,
+                  numgenes=50, k_selection=False,
+                  factorize_flags=["--rowshard"])
+    try:
+        run_pipeline(counts_fn, workdir, "ckclean", **common)
+        sentinel = os.path.join(workdir, "ckpt_kill.done")
+        run_pipeline(counts_fn, workdir, "ckkill",
+                     env_extra={"CNMF_TPU_TELEMETRY": "1",
+                                FAULT_ENV: "kill:stage=pass,after=3,"
+                                           f"once={sentinel}"},
+                     **common)
+    finally:
+        del os.environ["CNMF_TPU_WORKER_RESPAWNS"]
+        del os.environ["CNMF_TPU_WORKER_BACKOFF_S"]
+    assert os.path.exists(sentinel), "pass-stage kill never fired"
+    ev_path = os.path.join(workdir, "ckkill", "cnmf_tmp",
+                           "ckkill.events.jsonl")
+    validate_events_file(ev_path)              # raises on malformed lines
+    resumes = [e for e in read_events(ev_path)
+               if e["t"] == "checkpoint" and e["action"] == "resume"]
+    assert resumes, "relaunched worker did not resume from the checkpoint"
+    assert int(resumes[0]["context"]["pass_idx"]) >= 1
+    a = load_df_from_npz(os.path.join(
+        workdir, "ckclean", "cnmf_tmp",
+        "ckclean.spectra.k_3.merged.df.npz")).values
+    b = load_df_from_npz(os.path.join(
+        workdir, "ckkill", "cnmf_tmp",
+        "ckkill.spectra.k_3.merged.df.npz")).values
+    assert np.array_equal(a, b), "resumed spectra diverge from clean run"
+    import glob
+
+    assert not glob.glob(os.path.join(workdir, "ckkill", "cnmf_tmp",
+                                      "*.ckpt.*"))
+    print("chaos smoke [ckpt-kill]: worker SIGKILLed mid-pass, relaunch "
+          "resumed from checkpoint pass %d (not from scratch); merged "
+          "spectra bit-identical to the uninterrupted run"
+          % int(resumes[0]["context"]["pass_idx"]))
+
+
+def scenario_torn_ckpt(workdir: str, counts_fn: str) -> None:
+    """A pass checkpoint truncated mid-write is detected on resume,
+    discarded (surfaced as a torn_artifact fault event), and the
+    replicate restarts from scratch — reproducing the clean run's
+    artifact exactly, never trusting damaged state."""
+    import warnings
+
+    import numpy as np
+
+    from cnmf_torch_tpu.runtime import checkpoint as ck
+    from cnmf_torch_tpu.utils.anndata_lite import read_h5ad
+    from cnmf_torch_tpu.utils.io import load_df_from_npz
+    from cnmf_torch_tpu.utils.telemetry import read_events
+
+    obj = _prepare(workdir, counts_fn, "tornck", components=[3], n_iter=2)
+    os.environ["CNMF_TPU_TELEMETRY"] = "1"
+    try:
+        obj.factorize(rowshard=True)
+        orig = load_df_from_npz(obj.paths["iter_spectra"] % (3, 1)).values
+        os.unlink(obj.paths["iter_spectra"] % (3, 1))
+        # craft a mid-run checkpoint for the now-missing replicate, then
+        # tear it (the state a SIGKILL during the atomic rename's write
+        # phase — or a corrupt filesystem — would leave)
+        norm = read_h5ad(obj.paths["normalized_counts"])
+        run_params = load_df_from_npz(obj.paths["nmf_replicate_parameters"])
+        row = run_params[(run_params.n_components == 3)
+                         & (run_params.iter == 1)].iloc[0]
+        path = obj.paths["pass_checkpoint"] % (3, 1)
+        g = int(norm.X.shape[1])
+        rng = np.random.default_rng(0)
+        ck.save_pass_checkpoint(
+            path, k=3, it=1, seed=int(row["nmf_seed"]), attempt=0,
+            digest=ck.input_digest(norm.X), beta=2.0, pass_idx=3,
+            err_prev=np.float32(5.0), err=np.float32(4.0),
+            trace=np.zeros(4, np.float32),
+            W=np.abs(rng.normal(size=(3, g))).astype(np.float32),
+            A=np.zeros((3, g), np.float32), B=np.zeros((3, 3), np.float32))
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(max(1, size // 3))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            obj.factorize(rowshard=True, skip_completed_runs=True)
+        assert not os.path.exists(path), "torn checkpoint not discarded"
+        regen = load_df_from_npz(obj.paths["iter_spectra"] % (3, 1)).values
+        assert np.array_equal(regen, orig), \
+            "from-scratch restart diverged from the clean replicate"
+        ev_path = os.path.join(workdir, "tornck", "cnmf_tmp",
+                               "tornck.events.jsonl")
+        torn_faults = [
+            e for e in read_events(ev_path)
+            if e["t"] == "fault" and e["kind"] == "torn_artifact"
+            and "ckpt" in str(e["context"].get("path", ""))]
+        assert torn_faults, "torn checkpoint not surfaced as a fault event"
+    finally:
+        del os.environ["CNMF_TPU_TELEMETRY"]
+    print("chaos smoke [torn-ckpt]: truncated pass checkpoint detected on "
+          "resume, discarded, replicate regenerated from scratch "
+          "bit-identically")
+
+
 def main() -> int:
     workdir = tempfile.mkdtemp(prefix="chaos_smoke_")
     try:
@@ -185,6 +357,9 @@ def main() -> int:
         scenario_nonfinite(workdir, counts_fn)
         scenario_kill(workdir, counts_fn)
         scenario_torn(workdir, counts_fn)
+        scenario_stall(workdir, counts_fn)
+        scenario_ckpt_kill(workdir, counts_fn)
+        scenario_torn_ckpt(workdir, counts_fn)
         print("chaos smoke: all fault classes recovered")
         return 0
     finally:

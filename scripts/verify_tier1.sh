@@ -42,11 +42,15 @@ if [ "$rc" -eq 0 ]; then
 fi
 
 # chaos smoke: run the mini pipeline once per injected fault class
-# (nonfinite lane, killed worker, torn artifact — scripts/chaos_smoke.py)
-# and assert degraded-mode accounting: quarantine + derived-seed retry,
-# respawn + bit-identical resumed consensus, torn-artifact detection
+# (nonfinite lane, killed worker, torn artifact, stalled shard upload,
+# mid-pass kill + checkpoint resume, torn checkpoint —
+# scripts/chaos_smoke.py) and assert degraded-mode accounting:
+# quarantine + derived-seed retry, respawn + bit-identical resumed
+# consensus, torn-artifact detection, the stream stall watchdog, and
+# mid-run checkpoint resume (relaunch continues from the pass cursor,
+# not from scratch)
 if [ "$rc" -eq 0 ]; then
-  echo "[tier1] chaos smoke (fault injection: nonfinite/kill/torn) ..."
+  echo "[tier1] chaos smoke (fault injection: nonfinite/kill/torn/stall/ckpt-kill/torn-ckpt) ..."
   if timeout -k 10 600 env JAX_PLATFORMS=cpu \
       python scripts/chaos_smoke.py; then
     echo CHAOS_SMOKE=ok

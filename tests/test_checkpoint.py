@@ -1,0 +1,349 @@
+"""Mid-run pass-statistics checkpoints (runtime/checkpoint.py) and the
+checkpointed rowsharded executor (ISSUE 6): knob validation, save/load
+validation (torn/mismatched checkpoints are never trusted), interrupted+
+resumed parity against uninterrupted runs (bit-identical while H rides
+the checkpoint, solver-tolerance otherwise), the checkpoint-off fused
+path, and the launcher's deterministic-jitter respawn backoff.
+"""
+
+import os
+import warnings
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from cnmf_torch_tpu.parallel.rowshard import nmf_fit_rowsharded
+from cnmf_torch_tpu.runtime import checkpoint as ck
+
+
+@pytest.fixture()
+def mesh():
+    return Mesh(np.asarray(jax.devices()[:4]), ("cells",))
+
+
+@pytest.fixture()
+def X():
+    rng = np.random.default_rng(0)
+    return (rng.gamma(0.8, 1.0, size=(64, 24))
+            * rng.binomial(1, 0.4, size=(64, 24))).astype(np.float32)
+
+
+def _meta(digest, **kw):
+    meta = dict(k=3, iter=0, seed=7, attempt=0, digest=digest, beta=2.0)
+    meta.update(kw)
+    return meta
+
+
+class _Interrupt(Exception):
+    """Stands in for SIGKILL in-process: raised AFTER a checkpoint write
+    lands, which is exactly the state a mid-run preemption leaves."""
+
+
+class _KillAt(ck.PassCheckpointer):
+    def __init__(self, *a, kill_pass, **kw):
+        super().__init__(*a, **kw)
+        self._kill_pass = kill_pass
+
+    def save(self, *, pass_idx, **kw):
+        super().save(pass_idx=pass_idx, **kw)
+        if pass_idx == self._kill_pass:
+            raise _Interrupt
+
+
+class _Events:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, t, **kw):
+        self.events.append(dict(kw, t=t))
+
+
+# ---------------------------------------------------------------------------
+# knobs + digest + file validation
+# ---------------------------------------------------------------------------
+
+def test_ckpt_knob_validation(monkeypatch):
+    monkeypatch.delenv(ck.CKPT_EVERY_ENV, raising=False)
+    assert ck.ckpt_every_passes() == 1            # default: every pass
+    monkeypatch.setenv(ck.CKPT_EVERY_ENV, "0")
+    assert ck.ckpt_every_passes() == 0            # 0 disables
+    monkeypatch.setenv(ck.CKPT_EVERY_ENV, "3")
+    assert ck.ckpt_every_passes() == 3
+    for bad in ("-1", "often"):
+        monkeypatch.setenv(ck.CKPT_EVERY_ENV, bad)
+        with pytest.raises(ValueError, match=ck.CKPT_EVERY_ENV):
+            ck.ckpt_every_passes()
+    monkeypatch.setenv(ck.CKPT_H_BUDGET_ENV, "x")
+    with pytest.raises(ValueError, match=ck.CKPT_H_BUDGET_ENV):
+        ck.ckpt_h_budget_bytes()
+
+
+def test_input_digest_distinguishes_inputs(X):
+    import scipy.sparse as sp
+
+    assert ck.input_digest(X) == ck.input_digest(X.copy())
+    Y = X.copy()
+    Y[5, 3] += 1.0
+    assert ck.input_digest(X) != ck.input_digest(Y)
+    # sparse and dense encodings of the same values hash consistently
+    # with themselves (they need not match each other)
+    S = sp.csr_matrix(X)
+    assert ck.input_digest(S) == ck.input_digest(S.copy())
+
+
+def test_checkpoint_roundtrip_and_validation(tmp_path):
+    path = str(tmp_path / "a.ckpt.npz")
+    W = np.abs(np.random.default_rng(1).normal(size=(3, 24))).astype(
+        np.float32)
+    ck.save_pass_checkpoint(
+        path, k=3, it=0, seed=7, attempt=0, digest="d1", beta=2.0,
+        pass_idx=4, err_prev=np.float32(5.5), err=np.float32(4.5),
+        trace=np.zeros(8, np.float32), W=W, A=np.zeros((3, 24), np.float32),
+        B=np.zeros((3, 3), np.float32))
+    state = ck.load_pass_checkpoint(path, expect=_meta("d1"), n_genes=24)
+    assert state["pass_idx"] == 4 and state["H"] is None
+    np.testing.assert_array_equal(state["W"], W)
+    assert state["err"] == np.float32(4.5)
+
+    # identity mismatches are torn, not trusted
+    for bad in ({"seed": 8}, {"k": 4}, {"beta": 1.0}):
+        with pytest.raises(ck.TornCheckpointError):
+            ck.load_pass_checkpoint(path, expect=_meta("d1", **bad))
+    with pytest.raises(ck.TornCheckpointError, match="digest"):
+        ck.load_pass_checkpoint(path, expect=_meta("other"))
+    # a different resolved solver recipe is a different solve
+    with pytest.raises(ck.TornCheckpointError, match="params"):
+        ck.load_pass_checkpoint(
+            path, expect=dict(_meta("d1"), params="tol=1e-5"))
+    with pytest.raises(ck.TornCheckpointError, match="gene"):
+        ck.load_pass_checkpoint(path, expect=_meta("d1"), n_genes=25)
+
+    # a truncated file (mid-write kill) is torn
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(1, size // 3))
+    state2, reason = ck.probe_pass_checkpoint(path, expect=_meta("d1"))
+    assert state2 is None and "unreadable" in reason
+    assert ck.probe_pass_checkpoint(str(tmp_path / "nope.npz"))[1] == \
+        "missing"
+
+
+def test_min_interval_floors_write_rate(tmp_path, monkeypatch):
+    """The wall-clock floor skips back-to-back saves (and tells the
+    solver up front via due(), so the device->host gather is skipped
+    too); default 0 persists every eligible pass."""
+    saves = []
+    orig = ck.save_pass_checkpoint
+    monkeypatch.setattr(ck, "save_pass_checkpoint",
+                        lambda path, **kw: saves.append(kw["pass_idx"]))
+    kw = dict(err_prev=1.0, err=0.5, trace=np.zeros(2, np.float32),
+              W=np.ones((3, 4), np.float32), A=np.zeros((3, 4), np.float32),
+              B=np.zeros((3, 3), np.float32))
+    c = ck.PassCheckpointer(str(tmp_path / "m.ckpt.npz"), 1,
+                            meta=_meta("d"), min_interval_s=3600.0)
+    assert c.due()
+    c.save(pass_idx=1, **kw)
+    assert not c.due()
+    c.save(pass_idx=2, **kw)        # dropped by the floor
+    assert saves == [1]
+    c0 = ck.PassCheckpointer(str(tmp_path / "n.ckpt.npz"), 1,
+                             meta=_meta("d"), min_interval_s=0.0)
+    c0.save(pass_idx=1, **kw)
+    assert c0.due()
+    c0.save(pass_idx=2, **kw)
+    assert saves == [1, 1, 2]
+    monkeypatch.setattr(ck, "save_pass_checkpoint", orig)
+
+
+def test_fresh_run_discards_stale_checkpoint(tmp_path):
+    path = str(tmp_path / "b.ckpt.npz")
+    with open(path, "wb") as f:
+        f.write(b"stale")
+    ck.PassCheckpointer(path, 1, meta=_meta("d"), resume=False)
+    assert not os.path.exists(path)
+
+
+# ---------------------------------------------------------------------------
+# checkpointed executor parity
+# ---------------------------------------------------------------------------
+
+def test_checkpointed_matches_fused_and_off_path(tmp_path, X, mesh):
+    """The checkpointed host loop must reproduce the fused while_loop
+    program (same per-pass body, same f32 convergence test), and
+    checkpoint=None must still BE the fused program."""
+    H0, W0, e0 = nmf_fit_rowsharded(X, 3, mesh, seed=7, n_passes=12)
+    ckpt = ck.PassCheckpointer(str(tmp_path / "c.ckpt.npz"), 1,
+                               meta=_meta(ck.input_digest(X)), resume=False)
+    H1, W1, e1 = nmf_fit_rowsharded(X, 3, mesh, seed=7, n_passes=12,
+                                    checkpoint=ckpt)
+    np.testing.assert_allclose(W1, W0, rtol=2e-3, atol=1e-5)
+    assert abs(e1 - e0) / max(e0, 1e-9) < 1e-3
+    H2, W2, e2 = nmf_fit_rowsharded(X, 3, mesh, seed=7, n_passes=12)
+    np.testing.assert_array_equal(W2, W0)   # off path byte-stable
+
+
+def test_interrupt_resume_bit_identical(tmp_path, X, mesh):
+    """Kill-after-checkpoint at pass 4, relaunch, resume: while H rides
+    the checkpoint the resumed run is BIT-IDENTICAL to the uninterrupted
+    checkpointed run, and the telemetry trail shows write -> resume."""
+    dig = ck.input_digest(X)
+    path = str(tmp_path / "d.ckpt.npz")
+    ck_full = ck.PassCheckpointer(str(tmp_path / "full.ckpt.npz"), 1,
+                                  meta=_meta(dig), resume=False)
+    H1, W1, e1 = nmf_fit_rowsharded(X, 3, mesh, seed=7, n_passes=12,
+                                    checkpoint=ck_full)
+
+    killer = _KillAt(path, 1, meta=_meta(dig), resume=False, kill_pass=4)
+    with pytest.raises(_Interrupt):
+        nmf_fit_rowsharded(X, 3, mesh, seed=7, n_passes=12,
+                           checkpoint=killer)
+    assert os.path.exists(path)
+
+    events = _Events()
+    resumer = ck.PassCheckpointer(path, 1, meta=_meta(dig), resume=True,
+                                  events=events)
+    H2, W2, e2 = nmf_fit_rowsharded(X, 3, mesh, seed=7, n_passes=12,
+                                    checkpoint=resumer)
+    np.testing.assert_array_equal(W2, W1)
+    np.testing.assert_array_equal(H2, H1)
+    assert e2 == e1
+    resumes = [e for e in events.events
+               if e["t"] == "checkpoint" and e["action"] == "resume"]
+    assert len(resumes) == 1 and resumes[0]["context"]["pass_idx"] == 4
+
+
+def test_resume_without_h_within_tolerance(tmp_path, X, mesh):
+    """Above the H byte budget only (A, B)/W ride the checkpoint; the
+    resumed trajectory re-derives H from W and must land within solver
+    tolerance of the uninterrupted run (the sufficient-statistics trade
+    the out-of-core designs make)."""
+    dig = ck.input_digest(X)
+    meta = _meta(dig, beta=1.0)
+    ck_full = ck.PassCheckpointer(str(tmp_path / "f.ckpt.npz"), 1,
+                                  meta=meta, resume=False, h_budget_bytes=0)
+    _, W1, e1 = nmf_fit_rowsharded(X, 3, mesh,
+                                   beta_loss="kullback-leibler", seed=7,
+                                   n_passes=8, checkpoint=ck_full)
+    path = str(tmp_path / "g.ckpt.npz")
+    killer = _KillAt(path, 1, meta=meta, resume=False, h_budget_bytes=0,
+                     kill_pass=3)
+    with pytest.raises(_Interrupt):
+        nmf_fit_rowsharded(X, 3, mesh, beta_loss="kullback-leibler",
+                           seed=7, n_passes=8, checkpoint=killer)
+    resumer = ck.PassCheckpointer(path, 1, meta=meta, resume=True,
+                                  h_budget_bytes=0)
+    _, W2, e2 = nmf_fit_rowsharded(X, 3, mesh,
+                                   beta_loss="kullback-leibler", seed=7,
+                                   n_passes=8, checkpoint=resumer)
+    assert abs(e2 - e1) / max(e1, 1e-9) < 0.05
+    assert np.isfinite(W2).all() and (W2 >= 0).all()
+
+
+def test_torn_checkpoint_restarts_from_scratch(tmp_path, X, mesh):
+    """A checkpoint truncated mid-write is detected on resume, discarded,
+    and the replicate restarts from scratch — producing the exact
+    uninterrupted result, never trusting damaged state."""
+    dig = ck.input_digest(X)
+    ck_full = ck.PassCheckpointer(str(tmp_path / "h.ckpt.npz"), 1,
+                                  meta=_meta(dig), resume=False)
+    _, W1, e1 = nmf_fit_rowsharded(X, 3, mesh, seed=7, n_passes=12,
+                                   checkpoint=ck_full)
+    path = str(tmp_path / "i.ckpt.npz")
+    killer = _KillAt(path, 1, meta=_meta(dig), resume=False, kill_pass=4)
+    with pytest.raises(_Interrupt):
+        nmf_fit_rowsharded(X, 3, mesh, seed=7, n_passes=12,
+                           checkpoint=killer)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(1, size // 3))
+    events = _Events()
+    resumer = ck.PassCheckpointer(path, 1, meta=_meta(dig), resume=True,
+                                  events=events)
+    with pytest.warns(RuntimeWarning, match="restarts from scratch"):
+        _, W2, e2 = nmf_fit_rowsharded(X, 3, mesh, seed=7, n_passes=12,
+                                       checkpoint=resumer)
+    np.testing.assert_array_equal(W2, W1)
+    kinds = [e for e in events.events if e["t"] == "fault"]
+    assert kinds and kinds[0]["kind"] == "torn_artifact"
+    # no stray resume event — the run restarted
+    assert not any(e["t"] == "checkpoint" and e["action"] == "resume"
+                   for e in events.events)
+
+
+# ---------------------------------------------------------------------------
+# factorize wiring + launcher jitter
+# ---------------------------------------------------------------------------
+
+def test_factorize_rowshard_checkpoint_lifecycle(tmp_path, monkeypatch):
+    """Pipeline-level wiring: under the default cadence every replicate
+    writes pass checkpoints and discards them once its spectra artifact
+    lands (no litter); CNMF_TPU_CKPT_EVERY_PASSES=0 never touches the
+    checkpoint layer (byte-identical pre-checkpoint programs)."""
+    import glob
+
+    import pandas as pd
+    import scipy.sparse as sp
+
+    from cnmf_torch_tpu.models.cnmf import cNMF
+    from cnmf_torch_tpu.utils.io import save_df_to_npz
+
+    rng = np.random.default_rng(3)
+    counts = sp.csr_matrix(
+        rng.binomial(40, 0.02, size=(60, 100)).astype(np.float64))
+    df = pd.DataFrame(counts.toarray(),
+                      index=[f"c{i}" for i in range(60)],
+                      columns=[f"g{j}" for j in range(100)])
+    counts_fn = str(tmp_path / "counts.df.npz")
+    save_df_to_npz(df, counts_fn)
+
+    saves = []
+    orig_save = ck.save_pass_checkpoint
+
+    def spy(path, **kw):
+        saves.append(kw["pass_idx"])
+        return orig_save(path, **kw)
+
+    monkeypatch.setattr(ck, "save_pass_checkpoint", spy)
+
+    obj = cNMF(output_dir=str(tmp_path), name="ckpl")
+    obj.prepare(counts_fn, components=[3], n_iter=2, seed=4,
+                num_highvar_genes=50, total_workers=1)
+    obj.factorize(rowshard=True)
+    assert saves, "checkpoints never written under the default cadence"
+    for it in range(2):
+        assert os.path.exists(obj.paths["iter_spectra"] % (3, it))
+    assert not glob.glob(str(tmp_path / "ckpl" / "cnmf_tmp" / "*.ckpt.*"))
+
+    saves.clear()
+    monkeypatch.setenv(ck.CKPT_EVERY_ENV, "0")
+    obj2 = cNMF(output_dir=str(tmp_path), name="ckoff")
+    obj2.prepare(counts_fn, components=[3], n_iter=2, seed=4,
+                 num_highvar_genes=50, total_workers=1)
+    obj2.factorize(rowshard=True)
+    assert not saves, "checkpoint layer touched with cadence 0"
+    # the two runs share ledger seeds; spectra must agree across the
+    # fused and checkpointed executors
+    from cnmf_torch_tpu.utils.io import load_df_from_npz
+
+    a = load_df_from_npz(obj.paths["iter_spectra"] % (3, 0)).values
+    b = load_df_from_npz(obj2.paths["iter_spectra"] % (3, 0)).values
+    np.testing.assert_allclose(a, b, rtol=2e-3, atol=1e-5)
+
+
+def test_launcher_respawn_jitter():
+    from cnmf_torch_tpu.launcher import respawn_delay
+
+    # deterministic: same inputs, same delay (resume/replay reproducible)
+    assert respawn_delay(0.5, 1, 3) == respawn_delay(0.5, 1, 3)
+    # exponential in the attempt
+    assert respawn_delay(0.5, 2, 3) == pytest.approx(
+        2.0 * respawn_delay(0.5, 1, 3))
+    # jitter factor stays in [1, 1.5) of the exponential base
+    for i in range(16):
+        d = respawn_delay(1.0, 1, i)
+        assert 1.0 <= d < 1.5
+    # simultaneous deaths fan out: worker delays are not all equal
+    delays = {round(respawn_delay(1.0, 1, i), 6) for i in range(8)}
+    assert len(delays) > 4, delays

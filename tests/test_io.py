@@ -121,13 +121,16 @@ def test_load_counts_10x_mtx(tmp_path, rng):
 def test_paths_registry(tmp_path):
     paths = build_paths(str(tmp_path), "run1")
     # every key of the reference registry (cnmf.py:423-455) plus
-    # factorize_provenance (records the engaged solver path) and
-    # resilience_ledger (quarantine/retry records, ISSUE 5)
-    assert len(paths) == 26
+    # factorize_provenance (records the engaged solver path),
+    # resilience_ledger (quarantine/retry records, ISSUE 5), and
+    # pass_checkpoint (mid-run pass-statistics checkpoint, ISSUE 6)
+    assert len(paths) == 27
     assert "factorize_provenance" in paths
     assert "resilience_ledger" in paths
     assert paths["resilience_ledger"] % 2 == str(
         tmp_path / "run1" / "cnmf_tmp" / "run1.resilience.w2.json")
+    assert paths["pass_checkpoint"] % (7, 3) == str(
+        tmp_path / "run1" / "cnmf_tmp" / "run1.ckpt.k_7.iter_3.npz")
     assert paths["iter_spectra"] % (7, 3) == str(
         tmp_path / "run1" / "cnmf_tmp" / "run1.spectra.k_7.iter_3.df.npz"
     )

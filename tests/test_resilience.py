@@ -525,6 +525,59 @@ def test_resume_reruns_torn_artifact(tmp_path):
     np.testing.assert_array_equal(repaired, good)
 
 
+def test_guard_records_shard_faults_in_ledger(tmp_path):
+    """Exhausted shard uploads / stalls flow into the PR-4 resilience
+    ledger (ISSUE 6): record_shard_fault books the fault, emits a
+    schema-valid telemetry event, and finalize persists it alongside the
+    quarantine records."""
+    class Rec:
+        def __init__(self):
+            self.events = []
+
+        def emit(self, t, **kw):
+            self.events.append(dict(kw, t=t))
+
+    rec = Rec()
+    ledger_path = str(tmp_path / "resilience.w0.json")
+    guard = resilience.ReplicateGuard(events=rec, ledger_path=ledger_path)
+    guard.record_shard_fault("shard_stall",
+                             {"stage": "rowshard_stage_x", "error": "hung"})
+    guard.finalize()
+    with open(ledger_path) as f:
+        ledger = json.load(f)
+    assert ledger["shard_faults"] == [
+        {"stage": "rowshard_stage_x", "error": "hung",
+         "kind": "shard_stall"}]
+    (ev,) = rec.events
+    assert ev["t"] == "fault" and ev["kind"] == "shard_stall"
+
+    from cnmf_torch_tpu.utils.telemetry import validate_event
+
+    validate_event({"v": 1, "t": "fault", "ts": 0.0, "kind": ev["kind"],
+                    "context": ev["context"]})
+
+
+def test_stall_clause_parses_and_limits():
+    """The new `stall` fault kind parses like the others (seconds stays a
+    float-able string) and defaults to one injection per clause."""
+    (clause,) = faults.parse_fault_spec("stall:context=stream,seconds=0.05")
+    assert clause.kind == "stall"
+    assert clause.params["context"] == "stream"
+    assert float(clause.params["seconds"]) == 0.05
+
+    import time
+
+    os.environ[faults.FAULT_SPEC_ENV] = "stall:context=abc,seconds=0.05"
+    try:
+        t0 = time.monotonic()
+        assert faults.maybe_stall(context="xyz") == 0.0   # no context match
+        assert faults.maybe_stall(context="abc123") == 0.05
+        assert faults.maybe_stall(context="abc123") == 0.0  # limit 1
+        assert time.monotonic() - t0 < 1.0
+    finally:
+        del os.environ[faults.FAULT_SPEC_ENV]
+
+
 # ---------------------------------------------------------------------------
 # integration: kill–resume parity through the launcher
 # ---------------------------------------------------------------------------

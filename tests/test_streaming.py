@@ -119,6 +119,165 @@ def test_stream_knobs_env(monkeypatch):
     assert streaming.stream_depth(slab_bytes=1) == 7  # 2 x threads + 1
 
 
+def test_stream_knob_validation(monkeypatch):
+    """Bad knob values reject at parse time with a one-line message naming
+    the knob — not a confusing downstream error (ISSUE 6 satellite)."""
+    cases = [
+        (streaming.DEPTH_ENV, "0", streaming.stream_depth),
+        (streaming.DEPTH_ENV, "soon", streaming.stream_depth),
+        (streaming.THREADS_ENV, "-1", streaming.stream_threads),
+        (streaming.THREADS_ENV, "many", streaming.stream_threads),
+        (streaming.SHARD_RETRIES_ENV, "-2", streaming.shard_retries),
+        (streaming.STALL_ENV, "-1", streaming.stream_stall_s),
+        (streaming.STALL_ENV, "later", streaming.stream_stall_s),
+    ]
+    for env, val, fn in cases:
+        monkeypatch.setenv(env, val)
+        with pytest.raises(ValueError, match=env):
+            fn()
+        monkeypatch.delenv(env)
+    monkeypatch.setenv(streaming.BYTES_ENV, "lots")
+    with pytest.raises(ValueError, match=streaming.BYTES_ENV):
+        streaming.stream_depth(slab_bytes=100)
+    monkeypatch.delenv(streaming.BYTES_ENV)
+    # valid settings still parse (0 threads = serial is legal; depth 1 =
+    # serial is legal)
+    monkeypatch.setenv(streaming.THREADS_ENV, "0")
+    assert streaming.stream_threads() == 0
+    monkeypatch.setenv(streaming.DEPTH_ENV, "1")
+    assert streaming.stream_depth() == 1
+
+
+# ---------------------------------------------------------------------------
+# shard-granular retry + stall watchdog (ISSUE 6)
+# ---------------------------------------------------------------------------
+
+class _Events:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, t, **kw):
+        self.events.append(dict(kw, t=t))
+
+
+def test_shard_retry_recovers_transient_failures(monkeypatch):
+    monkeypatch.setenv(streaming.SHARD_BACKOFF_ENV, "0")
+    fails = {"n": 0}
+
+    def prep(i):
+        if i == 3 and fails["n"] < 2:
+            fails["n"] += 1
+            raise RuntimeError("transient wire fault")
+        return i
+
+    seen = []
+    events = _Events()
+    with pytest.warns(RuntimeWarning, match="retrying"):
+        run_pipeline(range(6), prep, lambda i, p: seen.append(p),
+                     depth=2, threads=2, fault_context="test", events=events)
+    assert seen == list(range(6))
+    retry_kinds = [e["kind"] for e in events.events if e["t"] == "fault"]
+    assert retry_kinds == ["shard_retry", "shard_retry"]
+
+
+def test_shard_retry_exhaustion_raises(monkeypatch):
+    from cnmf_torch_tpu.parallel.streaming import ShardUploadError
+
+    monkeypatch.setenv(streaming.SHARD_BACKOFF_ENV, "0")
+    monkeypatch.setenv(streaming.SHARD_RETRIES_ENV, "1")
+
+    def prep(i):
+        if i == 1:
+            raise RuntimeError("permanent")
+        return i
+
+    events = _Events()
+    with pytest.warns(RuntimeWarning):
+        with pytest.raises(ShardUploadError,
+                           match=streaming.SHARD_RETRIES_ENV):
+            run_pipeline(range(4), prep, lambda i, p: None, depth=2,
+                         threads=2, fault_context="test", events=events)
+    kinds = [e["kind"] for e in events.events if e["t"] == "fault"]
+    assert kinds == ["shard_retry", "shard_upload_failed"]
+    # serial fallback applies the same retry policy
+    monkeypatch.setenv(streaming.SHARD_RETRIES_ENV, "0")
+    with pytest.raises(ShardUploadError):
+        run_pipeline(range(4), prep, lambda i, p: None, depth=1, threads=0)
+
+
+def test_stall_watchdog_converts_hang(monkeypatch):
+    import time
+
+    from cnmf_torch_tpu.parallel.streaming import ShardStallError
+
+    monkeypatch.setenv(streaming.STALL_ENV, "0.3")
+
+    def prep(i):
+        if i == 0:
+            time.sleep(2.0)
+        return i
+
+    events = _Events()
+    t0 = time.monotonic()
+    with pytest.raises(ShardStallError, match=streaming.STALL_ENV):
+        run_pipeline(range(4), prep, lambda i, p: None, depth=2, threads=2,
+                     fault_context="test", events=events)
+    assert time.monotonic() - t0 < 1.5   # failed at the watchdog, not the hang
+    assert any(e["t"] == "fault" and e["kind"] == "shard_stall"
+               for e in events.events)
+
+
+def test_stall_watchdog_excludes_retry_backoff(monkeypatch):
+    """The two containment knobs compose: per-attempt heartbeats (with the
+    backoff window stamped forward) keep legitimate retry/backoff time out
+    of the stall budget, so a slab that recovers via retries is never
+    misreported as hung even when its total retry time exceeds
+    CNMF_TPU_STREAM_STALL_S."""
+    import time
+
+    monkeypatch.setenv(streaming.STALL_ENV, "0.6")
+    monkeypatch.setenv(streaming.SHARD_RETRIES_ENV, "2")
+    monkeypatch.setenv(streaming.SHARD_BACKOFF_ENV, "0.4")
+    fails = {"n": 0}
+
+    def prep(i):
+        if i == 1 and fails["n"] < 2:
+            fails["n"] += 1
+            time.sleep(0.3)   # slow attempt + 0.4/0.8s backoffs: ~2.2s total
+            raise RuntimeError("transient")
+        return i
+
+    seen = []
+    with pytest.warns(RuntimeWarning):
+        run_pipeline(range(4), prep, lambda i, p: seen.append(p),
+                     depth=2, threads=2, fault_context="t")
+    assert seen == [0, 1, 2, 3]
+
+
+def test_stall_fault_injection_through_staging(mesh, monkeypatch):
+    """The `stall` chaos clause (runtime/faults.py) fires inside a real
+    staging call and the watchdog converts it into ShardStallError within
+    its deadline; clearing the spec restores normal staging."""
+    import time
+
+    from cnmf_torch_tpu.parallel.rowshard import stream_rows_to_mesh
+    from cnmf_torch_tpu.parallel.streaming import ShardStallError
+
+    monkeypatch.setattr(streaming, "DENSIFY_SLAB_ROWS", 8)
+    monkeypatch.setenv("CNMF_TPU_FAULT_SPEC", "stall:context=stream,seconds=3")
+    monkeypatch.setenv(streaming.STALL_ENV, "0.3")
+    monkeypatch.setenv(streaming.THREADS_ENV, "2")
+    X = _skewed_csr(n=64, g=16, seed=2)
+    t0 = time.monotonic()
+    with pytest.raises(ShardStallError):
+        stream_rows_to_mesh(X, mesh, "cells")
+    assert time.monotonic() - t0 < 2.0
+    monkeypatch.delenv("CNMF_TPU_FAULT_SPEC")
+    monkeypatch.delenv(streaming.STALL_ENV)
+    Xd, pad = stream_rows_to_mesh(X, mesh, "cells")
+    np.testing.assert_array_equal(np.asarray(Xd)[:64], X.toarray())
+
+
 # ---------------------------------------------------------------------------
 # staged-array parity (bit-exact vs direct device_put)
 # ---------------------------------------------------------------------------

@@ -291,6 +291,62 @@ class TestEventsAndReport:
         report = tel.render_report(str(tmp_path / "off"))
         assert "timings TSV" in report and "factorize" in report
 
+    def test_faults_and_recoveries_report_section(self, tmp_path):
+        """ISSUE 6 satellite: the report renders a "Faults & recoveries"
+        table (per-class counts + retried/recovered/quarantined) and the
+        checkpoint lifecycle line from the same events file."""
+        import json
+        import time
+
+        events = [
+            {"v": 1, "t": "manifest", "ts": time.time(),
+             "package_version": "x", "jax_version": "x", "backend": "cpu",
+             "devices": [], "env": {}},
+            {"v": 1, "t": "fault", "ts": time.time(),
+             "kind": "nonfinite_replicate",
+             "context": {"k": 3, "iter": 1, "seed": 9, "attempt": 0}},
+            {"v": 1, "t": "fault", "ts": time.time(), "kind": "retry",
+             "context": {"k": 3, "iter": 1, "seed": 9, "attempt": 1,
+                         "healthy": True}},
+            {"v": 1, "t": "fault", "ts": time.time(), "kind": "shard_retry",
+             "context": {"context": "stream_dense", "task": "0",
+                         "attempt": 1, "error": "RuntimeError: x"}},
+            {"v": 1, "t": "fault", "ts": time.time(), "kind": "quarantine",
+             "context": {"k": 4, "iter": 0, "seed": 5, "attempt": 2}},
+            {"v": 1, "t": "checkpoint", "ts": time.time(),
+             "action": "write", "context": {"k": 3, "iter": 1,
+                                            "pass_idx": 4}},
+            {"v": 1, "t": "checkpoint", "ts": time.time(),
+             "action": "resume", "context": {"k": 3, "iter": 1,
+                                             "pass_idx": 4}},
+            {"v": 1, "t": "checkpoint", "ts": time.time(),
+             "action": "discard", "context": {"k": 3, "iter": 1}},
+        ]
+        run_dir = tmp_path / "faultrun"
+        (run_dir / "cnmf_tmp").mkdir(parents=True)
+        ev_path = run_dir / "cnmf_tmp" / "faultrun.events.jsonl"
+        with open(ev_path, "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+        tel.validate_events_file(str(ev_path))  # checkpoint type is schema-valid
+
+        summary = tel.summarize_events(events)
+        assert summary["faults"]["by_kind"] == {
+            "nonfinite_replicate": 1, "retry": 1, "shard_retry": 1,
+            "quarantine": 1}
+        assert summary["faults"]["retried"] == 1
+        assert summary["faults"]["recovered"] == 1
+        assert summary["faults"]["quarantined"] == 1
+        assert summary["checkpoints"]["actions"] == {
+            "write": 1, "resume": 1, "discard": 1}
+        assert summary["checkpoints"]["max_resume_pass"] == 4
+
+        report = tel.render_report(str(run_dir))
+        assert "Faults & recoveries" in report
+        assert "shard_retry" in report
+        assert "retried 1 (recovered 1), quarantined 1" in report
+        assert "deepest resume: pass 4" in report
+
     def test_cli_rejects_stray_positional_for_non_report(self, capsys):
         """The optional run_dir positional serves `report` only — a stray
         positional on any other subcommand (e.g. `consensus 9` meaning
