@@ -1,0 +1,15 @@
+"""Static analysis for the package's own invariants (``cnmf-tpu lint``).
+
+See :mod:`.engine` for the rule engine and CLI; rule families live in
+``rules_trace`` (host syncs / nondeterminism / traced branching inside
+jitted scopes), ``rules_knobs`` (env-knob registry hygiene + README
+drift), ``rules_artifacts`` (atomic-write discipline),
+``rules_telemetry`` (event-schema conformance at emit sites), and
+``rules_concurrency`` (module-state lock discipline). ``baseline.json``
+is the checked-in grandfather list — shipped empty: the package lints
+clean.
+"""
+
+from .engine import Finding, LintResult, lint_paths
+
+__all__ = ["Finding", "LintResult", "lint_paths"]

@@ -1,0 +1,140 @@
+"""Knob-hygiene rules: every env knob flows through the ONE registry.
+
+``utils/envknobs.py`` declares every ``CNMF_*``/``JAX_*`` variable the
+package consults (name/type/default/doc) and owns the strict typed
+accessors. Anything else is drift waiting to happen — PR 6's audit found
+38 raw ``os.environ`` sites against 3 modules importing the accessors,
+which is how a typo'd knob silently no-ops and how the README table went
+stale.
+
+  * ``knob-raw-env`` — ``os.environ[...]``/``.get``/``os.getenv``/
+    ``"X" in os.environ`` with a literal ``CNMF_*``/``JAX_*`` name in any
+    module but ``utils/envknobs.py``. Dynamic iteration (the telemetry
+    manifest's env snapshot) is untouched — the rule targets named reads.
+  * ``knob-unregistered`` — an accessor call naming a knob absent from
+    the registry (the accessors also refuse at runtime; the rule catches
+    it before anything runs).
+  * ``knob-doc-drift`` — registry vs README "Environment knobs" table,
+    both directions, including stale default cells. The canonical table
+    is generated (``cnmf-tpu lint --knob-table``), so the fix is a
+    regenerate, never a hand-edit.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import FileContext, Finding, dotted_name
+
+KNOB_PREFIXES = ("CNMF_", "JAX_")
+ACCESSORS = {"env_int", "env_float", "env_str", "env_flag", "env_is_set"}
+ENV_OWNER = "utils/envknobs.py"
+
+
+def _is_environ(ctx: FileContext, node: ast.AST) -> bool:
+    """The expression ``os.environ`` (or a from-imported alias of it)."""
+    name = ctx.imports.resolve(dotted_name(node))
+    return name in ("os.environ", "environ")
+
+
+def _literal_knob(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value.startswith(KNOB_PREFIXES):
+        return node.value
+    return None
+
+
+def check(ctx: FileContext):
+    findings: list[Finding] = []
+    if ctx.relpath.replace("\\", "/").endswith(ENV_OWNER):
+        return findings
+    from ..utils.envknobs import REGISTRY
+
+    hint = ("read it through utils/envknobs.py (env_int/env_float/"
+            "env_str/env_flag), registering the knob there")
+    for node in ast.walk(ctx.tree):
+        name = None
+        if isinstance(node, ast.Subscript) and _is_environ(ctx, node.value):
+            name = _literal_knob(node.slice)
+        elif isinstance(node, ast.Call):
+            resolved = ctx.resolve_call(node)
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("get", "setdefault", "pop") \
+                    and _is_environ(ctx, node.func.value) and node.args:
+                name = _literal_knob(node.args[0])
+            elif resolved == "os.getenv" and node.args:
+                name = _literal_knob(node.args[0])
+            elif (resolved or "").split(".")[-1] in ACCESSORS \
+                    and node.args:
+                knob = _literal_knob(node.args[0])
+                if knob is not None and knob not in REGISTRY:
+                    findings.append(ctx.finding(
+                        node, "knob-unregistered",
+                        f"env knob `{knob}` is not declared in the "
+                        "utils/envknobs.py registry",
+                        "add a Knob(name, kind, default, doc) entry"))
+                continue
+        elif isinstance(node, ast.Compare) \
+                and any(isinstance(op, (ast.In, ast.NotIn))
+                        for op in node.ops) \
+                and node.comparators \
+                and _is_environ(ctx, node.comparators[0]):
+            name = _literal_knob(node.left)
+        if name is not None:
+            findings.append(ctx.finding(
+                node, "knob-raw-env",
+                f"raw os.environ access to `{name}` outside "
+                f"{ENV_OWNER}", hint))
+    return findings
+
+
+def check_knob_docs(readme_path: str) -> list[Finding]:
+    """Cross-check the registry against the README knob table, both ways.
+    Runs once per lint invocation (project-level, not per-file)."""
+    from ..utils.envknobs import REGISTRY, parse_knob_table
+
+    with open(readme_path, encoding="utf-8") as f:
+        text = f.read()
+    table = parse_knob_table(text)
+    table_line = 1
+    for i, line in enumerate(text.splitlines(), start=1):
+        if line.strip().startswith("| knob |"):
+            table_line = i
+            break
+
+    from .engine import _relpath
+
+    rel = _relpath(readme_path)
+    findings: list[Finding] = []
+    documented = {n: k for n, k in REGISTRY.items() if k.documented}
+    for name, knob in documented.items():
+        if name not in table:
+            findings.append(Finding(
+                rel, table_line, "knob-doc-drift",
+                f"registered knob `{name}` is missing from the README "
+                "env-knob table",
+                "regenerate the table with `cnmf-tpu lint --knob-table`",
+                f"missing row: {name}"))
+        elif table[name][0] != knob.default:
+            findings.append(Finding(
+                rel, table_line, "knob-doc-drift",
+                f"README default for `{name}` is {table[name][0]!r}; the "
+                f"registry says {knob.default!r}",
+                "regenerate the table with `cnmf-tpu lint --knob-table`",
+                f"stale default: {name}"))
+        elif table[name][1] != knob.doc:
+            findings.append(Finding(
+                rel, table_line, "knob-doc-drift",
+                f"README description for `{name}` differs from the "
+                "registry doc (the table is generated, not hand-edited)",
+                "regenerate the table with `cnmf-tpu lint --knob-table`",
+                f"stale doc: {name}"))
+    for name in table:
+        if name not in documented:
+            findings.append(Finding(
+                rel, table_line, "knob-doc-drift",
+                f"README documents `{name}`, which is not a registered "
+                "knob",
+                "register it in utils/envknobs.py or drop the row",
+                f"unregistered row: {name}"))
+    return findings

@@ -1,0 +1,59 @@
+"""Telemetry-schema rule: emit sites are validated at lint time.
+
+``utils/telemetry.py`` holds the ONE schema (``EVENT_TYPES`` — required
+fields per event type) and ``validate_event`` enforces it in the tier-1
+smoke; but a typo'd event type or a dropped required field only surfaces
+when someone actually runs with ``CNMF_TPU_TELEMETRY=1`` and validates
+the stream. This rule closes the gap statically for the common shape —
+``events.emit("<literal type>", field=..., ...)``:
+
+  * an event type not in ``EVENT_TYPES`` is rejected (``validate_event``
+    would reject the line at runtime; the report renderer would drop it);
+  * when every field is a plain keyword (no ``**splat``), a missing
+    required field is rejected — with the caveat that ``None``-valued
+    fields are omitted at emit time, which the static check cannot see
+    (the runtime smoke still catches that case).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import FileContext, Finding
+
+COMMON_FIELDS = {"v", "t", "ts"}
+
+
+def check(ctx: FileContext):
+    from ..utils.telemetry import EVENT_TYPES
+
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "emit" and node.args):
+            continue
+        etype = node.args[0]
+        if not (isinstance(etype, ast.Constant)
+                and isinstance(etype.value, str)):
+            continue  # forwarding wrappers (telemetry.EventLog internals)
+        if etype.value not in EVENT_TYPES:
+            findings.append(ctx.finding(
+                node, "telemetry-schema",
+                f"unknown telemetry event type {etype.value!r} "
+                f"(schema knows: {', '.join(sorted(EVENT_TYPES))})",
+                "add the type to utils/telemetry.py EVENT_TYPES or fix "
+                "the call"))
+            continue
+        if any(kw.arg is None for kw in node.keywords):
+            continue  # **fields splat: field set is dynamic
+        provided = {kw.arg for kw in node.keywords} | COMMON_FIELDS
+        missing = sorted(set(EVENT_TYPES[etype.value]) - provided)
+        if missing:
+            findings.append(ctx.finding(
+                node, "telemetry-schema",
+                f"emit({etype.value!r}, ...) omits required field(s) "
+                f"{', '.join(missing)} — validate_event rejects the line "
+                "at runtime",
+                "pass every field EVENT_TYPES requires for this type"))
+    return findings

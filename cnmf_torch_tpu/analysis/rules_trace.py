@@ -1,0 +1,277 @@
+"""Trace-safety rules: nothing host-side may hide inside a traced scope.
+
+A traced scope is a function the XLA tracer will run: decorated with
+``@jit``/``@partial(jax.jit, ...)``/``@shard_map``, or passed (by name or
+as an inline lambda) to a tracer call — ``jax.jit``, ``vmap``/``pmap``,
+``lax.while_loop``/``fori_loop``/``scan``/``cond``/``switch``/``map``,
+``shard_map``, ``checkpoint``/``remat``, ``grad``. Detection is lexical
+and per-file (a helper that is only ever traced via an import in another
+module is out of reach — the rule is a tripwire for the patterns that
+actually bite, not a whole-program dataflow analysis).
+
+  * ``trace-host-sync`` — ``.item()``/``.tolist()``/
+    ``.block_until_ready()``, ``np.asarray``/``np.array``,
+    ``jax.device_get``, and ``float()``/``int()``/``bool()`` on traced
+    values. Each is a device->host sync: inside a jitted body it either
+    fails at trace time or (worse) silently forces a per-dispatch flush.
+    ``int(x.shape[0])``-style shape/size/ndim/len expressions are static
+    under tracing and exempt.
+  * ``trace-nondet`` — ``time.*`` clocks and ``random``/``np.random``
+    draws inside a traced scope: they freeze a trace-time value into the
+    compiled program, so reruns and resumed runs silently diverge
+    (reproducibility is a ledger guarantee here; RNG must flow through
+    seeded ``jax.random`` keys).
+  * ``trace-branch`` — Python ``if``/``while`` on a traced parameter:
+    concretization either raises at trace time or, via a static argnum
+    the author forgot, recompiles per value. Parameters named in
+    ``static_argnames``/``static_argnums`` are exempt (branching on
+    statics is the supported pattern — e.g. the ``telemetry`` flag on the
+    solvers).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import FileContext, Finding, dotted_name
+
+# tracer entry points: a function-valued argument of any of these is a
+# traced scope (index-precision deliberately not attempted — a lambda or
+# local function handed to any argument slot of these is traced or about
+# to be)
+TRACER_CALLS = {
+    "jax.jit", "jax.pmap", "jax.vmap",
+    "jax.lax.while_loop", "jax.lax.fori_loop", "jax.lax.scan",
+    "jax.lax.cond", "jax.lax.switch", "jax.lax.map",
+    "jax.experimental.shard_map.shard_map",
+    "jax.checkpoint", "jax.remat", "jax.grad", "jax.value_and_grad",
+}
+
+HOST_SYNC_ATTRS = {"item", "tolist", "block_until_ready",
+                   "copy_to_host_async"}
+HOST_SYNC_CALLS = {"numpy.asarray", "numpy.array", "numpy.asanyarray",
+                   "numpy.ascontiguousarray", "jax.device_get"}
+CAST_BUILTINS = {"float", "int", "bool"}
+
+NONDET_CALLS = {"time.time", "time.time_ns", "time.perf_counter",
+                "time.perf_counter_ns", "time.monotonic",
+                "time.monotonic_ns"}
+NONDET_PREFIXES = ("random.", "numpy.random.")
+
+
+def _tracer_name(ctx: FileContext, node: ast.AST) -> bool:
+    name = ctx.imports.resolve(dotted_name(node))
+    if name in TRACER_CALLS:
+        return True
+    # the package re-exports shard_map through utils.jax_compat's version
+    # shim — any import path whose leaf is shard_map is the tracer
+    return name is not None and name.split(".")[-1] == "shard_map"
+
+
+def _partial_tracer(ctx: FileContext, call: ast.Call) -> bool:
+    """``partial(jax.jit, static_argnames=...)`` used as a decorator."""
+    name = ctx.resolve_call(call)
+    if name not in ("functools.partial", "partial"):
+        return False
+    return bool(call.args) and _tracer_name(ctx, call.args[0])
+
+
+def _static_names_from_call(call: ast.Call, fn: ast.AST) -> set[str]:
+    """static_argnames / static_argnums keywords -> parameter names."""
+    out: set[str] = set()
+    params = _param_names(fn)
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    out.add(n.value)
+        elif kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    if 0 <= n.value < len(params):
+                        out.add(params[n.value])
+    return out
+
+
+def _param_names(fn: ast.AST) -> list[str]:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)):
+        return []
+    a = fn.args
+    return [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+
+
+def _collect_traced_scopes(ctx: FileContext) -> dict[ast.AST, set[str]]:
+    """Map traced function/lambda node -> set of STATIC parameter names."""
+    scopes: dict[ast.AST, set[str]] = {}
+    # local function definitions by name (last definition wins)
+    defs: dict[str, ast.AST] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _tracer_name(ctx, dec):
+                    scopes.setdefault(node, set())
+                elif isinstance(dec, ast.Call):
+                    if _tracer_name(ctx, dec.func):  # @jit(static_...)
+                        scopes.setdefault(node, set()).update(
+                            _static_names_from_call(dec, node))
+                    elif _partial_tracer(ctx, dec):
+                        scopes.setdefault(node, set()).update(
+                            _static_names_from_call(dec, node))
+        elif isinstance(node, ast.Call) and _tracer_name(ctx, node.func):
+            statics_call = node
+            # function-valued operands arrive positionally AND by keyword
+            # (lax.while_loop(cond, body_fun=body, ...) is standard style)
+            candidates = list(node.args) + [kw.value for kw in node.keywords
+                                            if kw.arg is not None]
+            for arg in candidates:
+                target = None
+                if isinstance(arg, ast.Lambda):
+                    target = arg
+                elif isinstance(arg, ast.Name) and arg.id in defs:
+                    target = defs[arg.id]
+                if target is not None:
+                    scopes.setdefault(target, set()).update(
+                        _static_names_from_call(statics_call, target))
+    return scopes
+
+
+def _traced_value_uses(ctx: FileContext, test: ast.AST):
+    """Name nodes in a branch test whose VALUE is traced — occurrences
+    that only probe trace-time-static facts (``isinstance(x, ...)``,
+    ``x.shape``/``.ndim``/``.size``/``.dtype``, ``len(x)``) don't
+    concretize and are skipped."""
+    for n in ast.walk(test):
+        if not isinstance(n, ast.Name):
+            continue
+        static = False
+        cur = n
+        for anc in ctx.ancestors(n):
+            if isinstance(anc, ast.Attribute) and anc.value is cur \
+                    and anc.attr in ("shape", "ndim", "size", "dtype"):
+                static = True
+                break
+            if isinstance(anc, ast.Call) and isinstance(anc.func, ast.Name) \
+                    and anc.func.id in ("isinstance", "len", "type"):
+                static = True
+                break
+            if anc is test:
+                break
+            cur = anc
+        if not static:
+            yield n
+
+
+def _mentions_static_shape(node: ast.AST) -> bool:
+    """``int(x.shape[0])`` / ``float(len(xs))`` / dtype probes are
+    trace-time constants, not syncs."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in ("shape", "ndim",
+                                                       "size", "dtype"):
+            return True
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id == "len":
+            return True
+    return False
+
+
+def _walk_scope(scope: ast.AST, scope_ids: set[int]):
+    """Walk ``scope``'s subtree but stop at NESTED traced scopes — each
+    traced scope gets exactly one pass, with its own (closure-aware)
+    parameter sets. Nested plain functions stay in the enclosing walk:
+    they are traced by closure when the traced scope calls them."""
+    body = scope.body if isinstance(scope.body, list) else [scope.body]
+    stack = [n for n in body if id(n) not in scope_ids]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if id(child) not in scope_ids:
+                stack.append(child)
+
+
+def check(ctx: FileContext):
+    findings: list[Finding] = []
+    scopes = _collect_traced_scopes(ctx)
+    scope_ids = set(map(id, scopes))
+
+    for scope, statics in scopes.items():
+        # traced values visible here: this scope's params plus every
+        # ENCLOSING traced scope's params (closure capture — the dominant
+        # solver shape is `def body(carry)` inside a jitted function),
+        # each minus that scope's own static names
+        traced_params = set(_param_names(scope)) - statics
+        all_statics = set(statics)
+        for anc in ctx.ancestors(scope):
+            if anc in scopes:
+                traced_params |= set(_param_names(anc)) - scopes[anc]
+                all_statics |= scopes[anc]
+        all_statics -= traced_params  # a traced binding wins over a
+        #                               same-named outer static
+        for node in _walk_scope(scope, scope_ids):
+            f = _check_node(ctx, node, traced_params, all_statics)
+            if f is not None:
+                findings.append(f)
+    return findings
+
+
+def _check_node(ctx: FileContext, node: ast.AST, traced_params: set[str],
+                statics: set[str]) -> Finding | None:
+    if isinstance(node, ast.Call):
+        resolved = ctx.resolve_call(node)
+        # host syncs
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in HOST_SYNC_ATTRS:
+            return ctx.finding(
+                node, "trace-host-sync",
+                f"`.{node.func.attr}()` forces a device->host sync inside "
+                "a traced scope",
+                "compute on-device with jnp, or hoist the fetch out of "
+                "the jitted body")
+        if resolved in HOST_SYNC_CALLS:
+            return ctx.finding(
+                node, "trace-host-sync",
+                f"`{resolved}` materializes a host array inside a traced "
+                "scope",
+                "use jnp equivalents inside jit; convert at the "
+                "dispatch boundary")
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in CAST_BUILTINS and node.args:
+            arg = node.args[0]
+            if not isinstance(arg, ast.Constant) \
+                    and not _mentions_static_shape(arg) \
+                    and not (isinstance(arg, ast.Name)
+                             and arg.id in statics):
+                return ctx.finding(
+                    node, "trace-host-sync",
+                    f"`{node.func.id}(...)` on a traced value "
+                    "concretizes (host sync) inside a traced scope",
+                    "keep it an array (jnp.asarray / astype), or mark "
+                    "the argument static")
+        # nondeterminism
+        if resolved in NONDET_CALLS or (
+                resolved and resolved.startswith(NONDET_PREFIXES)):
+            return ctx.finding(
+                node, "trace-nondet",
+                f"`{resolved}` inside a traced scope freezes a "
+                "trace-time value into the compiled program "
+                "(nondeterministic across runs/resumes)",
+                "thread seeded jax.random keys (or pass the value in as "
+                "an argument)")
+    elif isinstance(node, (ast.If, ast.While)):
+        hit = sorted({n.id for n in _traced_value_uses(ctx, node.test)
+                      if n.id in traced_params})
+        if hit:
+            kw = "if" if isinstance(node, ast.If) else "while"
+            return ctx.finding(
+                node, "trace-branch",
+                f"Python `{kw}` on traced value(s) {', '.join(hit)} — "
+                "concretization error at trace time, or a silent "
+                "per-value recompile",
+                "use lax.cond/jnp.where, or list the parameter in "
+                "static_argnames")
+    return None

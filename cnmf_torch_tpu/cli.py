@@ -36,7 +36,7 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "command", type=str,
         choices=["prepare", "factorize", "combine", "consensus",
-                 "k_selection_plot", "run_parallel", "report"])
+                 "k_selection_plot", "run_parallel", "report", "lint"])
     parser.add_argument(
         "run_dir", type=str, nargs="?", default=None,
         help="[report] Run directory ([output-dir]/[name]) whose telemetry "
@@ -175,11 +175,29 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None):
     import os
+    import sys
+
+    if argv is None:
+        argv = sys.argv[1:]
+
+    if argv and argv[0] == "lint":
+        # the static-analysis subcommand owns its argument surface
+        # (paths, --format, --baseline, ... — see analysis/engine.py) and,
+        # like `report`, never touches jax — dispatch before the
+        # reference-compatible parser can mangle its positionals
+        from .analysis.engine import main as lint_main
+
+        raise SystemExit(lint_main(argv[1:]))
 
     # parse BEFORE any jax import: --help / usage errors must not pay the
     # backend-initialization cost or touch the cache directory
     parser = build_parser()
     args = parser.parse_args(argv)
+
+    if args.command == "lint":  # e.g. `cnmf-tpu --name x lint`
+        parser.error("lint takes its own options; use: cnmf-tpu lint "
+                     "[paths ...] [--format text|json] [--baseline FILE] "
+                     "[--write-baseline] [--knob-table]")
 
     if args.command != "report" and args.run_dir is not None:
         # the optional positional exists for `report` only; for every
@@ -213,11 +231,13 @@ def main(argv=None):
     # virtual CPU devices BEFORE the backend initializes. Env vars are too
     # late here — this environment pre-imports jax at interpreter startup —
     # so go through jax.config like tests/conftest.py does.
-    sim = os.environ.get("CNMF_SIM_CPU_DEVICES")
+    from .utils.envknobs import env_int
+
+    sim = env_int("CNMF_SIM_CPU_DEVICES", 0, lo=0)
     if sim:
         from .utils.jax_compat import force_cpu_devices
 
-        force_cpu_devices(int(sim))
+        force_cpu_devices(sim)
 
     # persistent XLA compile cache (no-op if the user configured their own):
     # repeat runs and the per-K k-selection loop skip recompilation
@@ -257,8 +277,10 @@ def main(argv=None):
             clean=args.clean, factorize_flags=factorize_flags)
         return
 
+    from .utils.envknobs import env_str
+
     if args.command == "factorize" and (
-            args.distributed or os.environ.get("CNMF_COORDINATOR_ADDRESS")):
+            args.distributed or env_str("CNMF_COORDINATOR_ADDRESS")):
         from .parallel import initialize_distributed
 
         pid, nproc = initialize_distributed(auto=args.distributed)

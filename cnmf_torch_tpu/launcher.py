@@ -89,11 +89,11 @@ def _run_subprocess_workers(
 
     from .runtime.resilience import UNHEALTHY_EXIT_CODE
 
-    respawn_limit = max(0, int(
-        os.environ.get("CNMF_TPU_WORKER_RESPAWNS", "1") or 0))
-    timeout_s = float(os.environ.get("CNMF_TPU_WORKER_TIMEOUT", "0") or 0)
-    backoff_s = float(os.environ.get("CNMF_TPU_WORKER_BACKOFF_S", "0.5")
-                      or 0)
+    from .utils.envknobs import env_float, env_int
+
+    respawn_limit = env_int("CNMF_TPU_WORKER_RESPAWNS", 1, lo=0)
+    timeout_s = env_float("CNMF_TPU_WORKER_TIMEOUT", 0.0, lo=0.0)
+    backoff_s = env_float("CNMF_TPU_WORKER_BACKOFF_S", 0.5, lo=0.0)
 
     def spawn(i: int, resume: bool):
         flags = ["--worker-index", str(i),

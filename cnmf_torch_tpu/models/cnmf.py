@@ -42,7 +42,9 @@ from ..ops import (
 from ..ops.nmf import (beta_loss_to_float, fit_h, resolve_online_schedule,
                        run_nmf)
 from ..parallel import replicate_sweep, worker_filter
-from ..utils.anndata_lite import AnnDataLite, read_h5ad, write_h5ad
+from ..utils.anndata_lite import (AnnDataLite, atomic_artifact, read_h5ad,
+                                  write_h5ad)
+from ..utils.envknobs import env_flag, env_int
 from ..utils.io import (
     load_counts,
     load_df_from_npz,
@@ -309,8 +311,9 @@ class cNMF:
             if np.isnan(norm_counts.X).sum().sum() > 0:
                 print("Warning NaNs in normalized counts matrix")
 
-        with open(self.paths["nmf_genes_list"], "w") as f:
-            f.write("\n".join(high_variance_genes_filter))
+        with atomic_artifact(self.paths["nmf_genes_list"]) as tmp:
+            with open(tmp, "w") as f:
+                f.write("\n".join(high_variance_genes_filter))
 
         zerocells = np.asarray(norm_counts.X.sum(axis=1) == 0).reshape(-1)
         if zerocells.sum() > 0:
@@ -416,8 +419,9 @@ class cNMF:
         self._set_ledger_manifest(replicate_params, run_params)
         save_df_to_npz(replicate_params,
                        self.paths["nmf_replicate_parameters"])
-        with open(self.paths["nmf_run_parameters"], "w") as f:
-            yaml.dump(run_params, f)
+        with atomic_artifact(self.paths["nmf_run_parameters"]) as tmp:
+            with open(tmp, "w") as f:
+                yaml.dump(run_params, f)
 
     def _set_ledger_manifest(self, replicate_params, nmf_kwargs,
                              n_worker_tasks=None):
@@ -1734,8 +1738,8 @@ class cNMF:
 
         jobs = [warm_kmeans, warm_sil]
         if (n_hv < self.rowshard_threshold
-                and int(n_hv) * int(g_hv) * 4 <= int(os.environ.get(
-                    "CNMF_TPU_WARM_DUMMY_BUDGET_BYTES", 2 << 30))):
+                and int(n_hv) * int(g_hv) * 4 <= env_int(
+                    "CNMF_TPU_WARM_DUMMY_BUDGET_BYTES", 2 << 30, lo=0)):
             # above the threshold refit_usage takes fit_h_rowsharded, which
             # compiles per-K (k_pad unsupported there) — warming this
             # executable would only pin a useless (n, g) dummy in HBM; the
@@ -1791,8 +1795,7 @@ class cNMF:
         n_neighbors = int(local_neighborhood_size
                           * merged_spectra.shape[0] / k)
 
-        if (os.environ.get("CNMF_WARM_CONSENSUS", "1") != "0"
-                and _packed_dims is None):
+        if env_flag("CNMF_WARM_CONSENSUS", True) and _packed_dims is None:
             # packed stats runs warm their (shared) program set in
             # k_selection_plot instead of a per-K set here
             with self._timer.stage("consensus.warm"):
@@ -2097,7 +2100,7 @@ class cNMF:
         if tok not in self._x_sq_cache:
             self._x_sq_cache[tok] = _x_squared_sum(norm_counts.X)
 
-        if os.environ.get("CNMF_WARM_CONSENSUS", "1") != "0":
+        if env_flag("CNMF_WARM_CONSENSUS", True):
             # warm the packed program set concurrently up front: each
             # executable's first dispatch pays a ~2 s program-upload round
             # trip on a tunneled chip regardless of compile caching

@@ -11,7 +11,21 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..utils.anndata_lite import atomic_artifact
+
 __all__ = ["clustergram", "k_selection_figure", "cluster_ordering"]
+
+
+def _save_fig_atomic(fig, out_png: str, dpi: int):
+    """Figures are pipeline artifacts too (`--skip-completed-runs` probes
+    the run directory): land them with the same temp+rename dance as the
+    npz/h5ad writers. The temp name has no extension, so the format comes
+    from the target's suffix explicitly."""
+    import os
+
+    ext = os.path.splitext(os.path.basename(out_png))[1]
+    with atomic_artifact(out_png) as tmp:
+        fig.savefig(tmp, dpi=dpi, format=ext[1:] if ext else "png")
 
 
 def cluster_ordering(topics_dist: np.ndarray, cluster_labels) -> list[int]:
@@ -100,7 +114,7 @@ def clustergram(topics_dist, cluster_labels, local_density, density_filter,
                  ticks=np.linspace(D.min(), D.max(), 3),
                  orientation="horizontal")
 
-    fig.savefig(out_png, dpi=250)
+    _save_fig_atomic(fig, out_png, dpi=250)
     if close_fig:
         plt.close(fig)
     return fig
@@ -127,7 +141,7 @@ def k_selection_figure(stats, out_png: str, close_fig: bool = False):
     ax1.set_xlabel("Number of Components", fontsize=15)
     ax1.grid("on")
     plt.tight_layout()
-    fig.savefig(out_png, dpi=250)
+    _save_fig_atomic(fig, out_png, dpi=250)
     if close_fig:
         plt.close(fig)
     return fig

@@ -195,8 +195,12 @@ class Preprocess:
             write_h5ad(save_output_base + ".Corrected.HVG.Varnorm.h5ad",
                        adata_RNA)
             write_h5ad(save_output_base + ".TP10K.h5ad", tp10k)
-            with open(save_output_base + ".Corrected.HVGs.txt", "w") as f:
-                f.write("\n".join(hvgs))
+            from ..utils.anndata_lite import atomic_artifact
+
+            with atomic_artifact(
+                    save_output_base + ".Corrected.HVGs.txt") as tmp:
+                with open(tmp, "w") as f:
+                    f.write("\n".join(hvgs))
 
         return adata_RNA, tp10k, hvgs
 
@@ -225,7 +229,6 @@ class Preprocess:
         self._warmed.add(sig)
 
         import concurrent.futures
-        import os
 
         import jax.numpy as jnp
 
@@ -281,8 +284,10 @@ class Preprocess:
         # warm allocations; they run UNJOINED alongside production's
         # host-side stages, so cap them to keep warm+production peak HBM
         # bounded at atlas scale (the small warms above are K/d-sized)
-        if 3 * n * n_hvg * 4 <= int(os.environ.get(
-                "CNMF_TPU_WARM_DUMMY_BUDGET_BYTES", 2 << 30)):
+        from ..utils.envknobs import env_int
+
+        if 3 * n * n_hvg * 4 <= env_int(
+                "CNMF_TPU_WARM_DUMMY_BUDGET_BYTES", 2 << 30, lo=0):
             jobs += [warm_pca, lambda: warm_moe(n_hvg)]
 
         def run_one(job):
@@ -323,9 +328,9 @@ class Preprocess:
         scaled TP10K view handed to Harmony, whose MOE ridge then corrects
         the gene matrix itself with negatives clipped to zero
         (``preprocess.py:250-338``)."""
-        import os
+        from ..utils.envknobs import env_flag
 
-        if os.environ.get("CNMF_TPU_COMPILE_CACHE", "1") != "0":
+        if env_flag("CNMF_TPU_COMPILE_CACHE", True):
             # the pipeline entry points (CLI, bench, and this method — the
             # Preprocess compute entry) enable the persistent compile
             # cache; constructing the object stays side-effect-free, and
@@ -336,8 +341,8 @@ class Preprocess:
 
             enable_persistent_compilation_cache()
 
-        if (harmony_vars is not None
-                and os.environ.get("CNMF_WARM_PREPROCESS", "1") != "0"):
+        if harmony_vars is not None and env_flag("CNMF_WARM_PREPROCESS",
+                                                 True):
             # launch the device-program warms NOW so their compiles and
             # uploads overlap the host-side HVG scoring and scaling below
             if n_top_genes is not None:
@@ -466,8 +471,11 @@ class Preprocess:
         if self.plot_dir is not None:
             import os
 
+            from .plots import _save_fig_atomic
+
             os.makedirs(self.plot_dir, exist_ok=True)
-            fig.savefig(os.path.join(self.plot_dir, slug + ".png"), dpi=150)
+            _save_fig_atomic(fig, os.path.join(self.plot_dir, slug + ".png"),
+                             dpi=150)
             import matplotlib.pyplot as plt
 
             plt.close(fig)

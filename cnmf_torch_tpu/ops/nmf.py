@@ -36,6 +36,7 @@ TPU-first design notes:
 from __future__ import annotations
 
 import functools
+import threading
 import typing
 from typing import Any
 
@@ -282,6 +283,7 @@ def resolve_online_schedule(beta: float, h_tol=None, n_passes=None):
 
 
 _bf16_ratio_announced = False
+_bf16_announce_lock = threading.Lock()
 
 
 def resolve_bf16_ratio(beta: float, mode: str, override=None) -> bool:
@@ -299,14 +301,16 @@ def resolve_bf16_ratio(beta: float, mode: str, override=None) -> bool:
     users should find the opt-out without reading this docstring."""
     if override is not None:
         return bool(override)
-    import os
+    from ..utils.envknobs import env_flag
 
     active = (beta in (1.0, 0.0) and mode == "online"
-              and os.environ.get("CNMF_TPU_BF16_RATIO", "1") != "0")
+              and env_flag("CNMF_TPU_BF16_RATIO", True))
     if active:
         global _bf16_ratio_announced
-        if not _bf16_ratio_announced:
+        with _bf16_announce_lock:
+            first = not _bf16_ratio_announced
             _bf16_ratio_announced = True
+        if first:
             print("cnmf-tpu: bf16 ratio chain active for online "
                   "KL/IS updates (1.78-2.09x on v5e; per-seed objectives "
                   "within ~2-5% of strict f32 — set CNMF_TPU_BF16_RATIO=0 "

@@ -44,8 +44,6 @@ composes these statistics.
 
 from __future__ import annotations
 
-import os
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -330,7 +328,9 @@ def resolve_sparse_beta(beta: float, density: float | None = None,
     if override is not None:
         return bool(override)
     threshold = SPARSE_DENSITY_THRESHOLD
-    env = os.environ.get("CNMF_TPU_SPARSE_BETA", "")
+    from ..utils.envknobs import env_str
+
+    env = env_str("CNMF_TPU_SPARSE_BETA", "")
     if env:
         try:
             t = float(env)

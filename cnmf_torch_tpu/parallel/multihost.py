@@ -36,7 +36,6 @@ from __future__ import annotations
 
 import functools
 import math
-import os
 
 import jax
 import jax.numpy as jnp
@@ -152,11 +151,13 @@ def initialize_distributed(coordinator_address: str | None = None,
     if _initialized or getattr(jax.distributed, "is_initialized", lambda: False)():
         return jax.process_index(), jax.process_count()
 
-    coordinator_address = coordinator_address or os.environ.get(_ENV_COORD)
-    if num_processes is None and os.environ.get(_ENV_NPROC):
-        num_processes = int(os.environ[_ENV_NPROC])
-    if process_id is None and os.environ.get(_ENV_PID):
-        process_id = int(os.environ[_ENV_PID])
+    from ..utils.envknobs import env_int, env_str
+
+    coordinator_address = coordinator_address or env_str(_ENV_COORD) or None
+    if num_processes is None:
+        num_processes = env_int(_ENV_NPROC, None, lo=1)
+    if process_id is None:
+        process_id = env_int(_ENV_PID, None, lo=0)
 
     given = {"coordinator_address": coordinator_address,
              "num_processes": num_processes, "process_id": process_id}
@@ -176,7 +177,9 @@ def initialize_distributed(coordinator_address: str | None = None,
                     "cluster and no CNMF_COORDINATOR_ADDRESS / "
                     "CNMF_NUM_PROCESSES / CNMF_PROCESS_ID are set"
                 ) from exc
-            _initialized = True
+            # single-threaded by construction: runs once from CLI/worker
+            # startup before any thread pool exists
+            _initialized = True  # cnmf-lint: disable=lock-discipline
             return jax.process_index(), jax.process_count()
         # plain single-process call. Don't force initialize — and don't
         # latch: a later call WITH coordinates must still be able to
@@ -197,7 +200,7 @@ def initialize_distributed(coordinator_address: str | None = None,
     # the CPU backend"); the gloo implementation ships in jaxlib — enable
     # it when simulating pods on CPU so the same code path works across
     # versions (modern jax ignores/auto-handles this)
-    if not os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION"):
+    if not env_str("JAX_CPU_COLLECTIVES_IMPLEMENTATION"):
         try:
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
         except Exception:
@@ -205,7 +208,8 @@ def initialize_distributed(coordinator_address: str | None = None,
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)
-    _initialized = True
+    # single-threaded by construction (same once-at-startup path as above)
+    _initialized = True  # cnmf-lint: disable=lock-discipline
     return jax.process_index(), jax.process_count()
 
 

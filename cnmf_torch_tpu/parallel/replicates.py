@@ -114,11 +114,11 @@ def _device_budget_elems() -> int:
     — so on a >=16 GB part with real stats the budget scales up instead of
     undersubscribing at the v5e-tuned constant.
     ``CNMF_TPU_BUDGET_ELEMS`` overrides both."""
-    import os
+    from ..utils.envknobs import env_int
 
-    env = os.environ.get("CNMF_TPU_BUDGET_ELEMS")
+    env = env_int("CNMF_TPU_BUDGET_ELEMS", 0, lo=0)
     if env:
-        return max(int(env), 1)
+        return env
     try:
         stats = jax.devices()[0].memory_stats() or {}
     except Exception:

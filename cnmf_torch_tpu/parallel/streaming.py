@@ -132,7 +132,8 @@ class ShardStallError(RuntimeError):
 
 # strict parsers (utils/envknobs.py — the ONE definition): bad values
 # reject at parse time with a one-line message naming the knob
-from ..utils.envknobs import env_float as _env_float, env_int as _env_int
+from ..utils.envknobs import (env_float as _env_float, env_int as _env_int,
+                              env_str as _env_str)
 
 
 def shard_retries() -> int:
@@ -562,7 +563,7 @@ def _csr_transport(devices) -> str:
     backend), where XLA's element-wise scatter costs ~4x the memcpy it
     replaces (measured 8.8 s scatter vs 2.2 s host toarray at 300k x 2k,
     5% density). ``CNMF_TPU_STREAM_TRANSPORT`` forces either."""
-    forced = os.environ.get(TRANSPORT_ENV, "").strip().lower()
+    forced = _env_str(TRANSPORT_ENV, "").strip().lower()
     if forced in ("csr", "dense"):
         return forced
     return "dense" if all(d.platform == "cpu" for d in devices) else "csr"

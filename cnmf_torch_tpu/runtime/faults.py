@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import os
 import signal
+import threading
 
 __all__ = [
     "FAULT_SPEC_ENV",
@@ -111,18 +112,28 @@ def parse_fault_spec(raw: str) -> list[FaultClause]:
 # parsed-spec cache keyed on the raw env value: hook sites call
 # active_spec() on every hit, so toggling the env mid-process (tests,
 # chaos drivers) re-parses exactly once per distinct value while the
-# steady state costs one getenv + one string compare
+# steady state costs one getenv + one lock-free string compare. The lock
+# covers the whole check-then-parse-then-swap, so concurrent first hits
+# (staging worker threads all consult the upload hook) parse once.
 _cache: tuple[str, list[FaultClause]] | None = None
+_cache_lock = threading.Lock()
 
 
 def active_spec() -> list[FaultClause] | None:
     global _cache
-    raw = os.environ.get(FAULT_SPEC_ENV, "")
+    from ..utils.envknobs import env_str
+
+    raw = env_str(FAULT_SPEC_ENV, "")
     if not raw.strip():
         return None
-    if _cache is None or _cache[0] != raw:
-        _cache = (raw, parse_fault_spec(raw))
-    return _cache[1]
+    cache = _cache
+    if cache is None or cache[0] != raw:
+        with _cache_lock:
+            cache = _cache
+            if cache is None or cache[0] != raw:
+                cache = (raw, parse_fault_spec(raw))
+                _cache = cache
+    return cache[1]
 
 
 def _take_once(params: dict) -> bool:
@@ -270,7 +281,8 @@ def maybe_tear(path) -> bool:
             continue
         try:
             size = os.path.getsize(path)
-            with open(path, "r+b") as f:
+            # deliberately tearing the artifact IS this injector's job
+            with open(path, "r+b") as f:  # cnmf-lint: disable=artifact-nonatomic
                 f.truncate(max(1, size // 3))
             clause.injected += 1
             return True

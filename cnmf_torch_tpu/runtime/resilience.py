@@ -82,13 +82,9 @@ def max_retries() -> int:
     """Retry budget per unhealthy replicate (``CNMF_TPU_MAX_RETRIES``,
     default 2; 0 disables retries — unhealthy lanes quarantine
     immediately)."""
-    try:
-        return max(0, int(os.environ.get(MAX_RETRIES_ENV,
-                                         _DEFAULT_MAX_RETRIES)))
-    except ValueError:
-        raise ValueError(
-            f"{MAX_RETRIES_ENV}={os.environ[MAX_RETRIES_ENV]!r}: "
-            "expected a non-negative integer")
+    from ..utils.envknobs import env_int
+
+    return env_int(MAX_RETRIES_ENV, _DEFAULT_MAX_RETRIES, lo=0)
 
 
 def min_healthy_frac() -> float:
@@ -102,18 +98,10 @@ def min_healthy_frac() -> float:
     and global coincide; with many thin shards, size the floor against
     the per-shard replicate count (e.g. a 3-replicate shard quantizes to
     thirds)."""
-    raw = os.environ.get(MIN_HEALTHY_FRAC_ENV)
-    if raw is None:
-        return _DEFAULT_MIN_HEALTHY_FRAC
-    try:
-        val = float(raw)
-    except ValueError:
-        raise ValueError(
-            f"{MIN_HEALTHY_FRAC_ENV}={raw!r}: expected a float in [0, 1]")
-    if not 0.0 <= val <= 1.0:
-        raise ValueError(
-            f"{MIN_HEALTHY_FRAC_ENV}={raw!r}: expected a float in [0, 1]")
-    return val
+    from ..utils.envknobs import env_float
+
+    return env_float(MIN_HEALTHY_FRAC_ENV, _DEFAULT_MIN_HEALTHY_FRAC,
+                     lo=0.0, hi=1.0)
 
 
 def derive_retry_seed(seed: int, attempt: int) -> int:

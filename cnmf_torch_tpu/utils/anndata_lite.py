@@ -195,7 +195,9 @@ def _x_compression() -> dict:
     gzip was the largest single cost of the prepare stage (~5 s of a 22 s
     run at gzip-1). Match the reference default; opt back in with
     CNMF_H5_COMPRESSION=gzip (level 1) or =lzf (fast, h5py-only filter)."""
-    mode = os.environ.get("CNMF_H5_COMPRESSION", "none").lower()
+    from .envknobs import env_str
+
+    mode = env_str("CNMF_H5_COMPRESSION", "none").lower()
     if mode in ("", "none", "0", "off", "false"):
         return {}
     if mode == "lzf":

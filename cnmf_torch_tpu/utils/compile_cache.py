@@ -28,8 +28,11 @@ def enable_persistent_compilation_cache(path: str | None = None) -> str | None:
     when unavailable."""
     import jax
 
-    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
-        return os.environ["JAX_COMPILATION_CACHE_DIR"]
+    from .envknobs import env_str
+
+    user_dir = env_str("JAX_COMPILATION_CACHE_DIR")
+    if user_dir:
+        return user_dir
     try:
         current = jax.config.jax_compilation_cache_dir
     except AttributeError:  # config name changed; don't fight it
@@ -41,7 +44,7 @@ def enable_persistent_compilation_cache(path: str | None = None) -> str | None:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
         # pin the threshold ONLY when the user hasn't set their own
-        if not os.environ.get("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"):
+        if not env_str("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"):
             jax.config.update("jax_persistent_cache_min_compile_time_secs",
                               1.0)
     except Exception:

@@ -1,24 +1,229 @@
-"""Strict env-knob parsing — the ONE definition (ISSUE 6 satellite).
+"""Strict env-knob parsing and the ONE knob registry (ISSUE 6 + ISSUE 7).
 
-Every numeric ``CNMF_TPU_*`` knob used to fall through to a confusing
-downstream error on a typo; these helpers reject at parse time with a
-one-line message naming the knob. Stdlib-only so the light runtime
-modules (``runtime/checkpoint.py``) can share them with the jax-heavy
-staging layer (``parallel/streaming.py``, ``parallel/multihost.py``)
-without import-order consequences.
+Every ``CNMF_*``/``JAX_*`` environment variable the package consults is
+declared here — name, type, display default, and one-line doc — and read
+exclusively through the typed accessors below. Two gates hang off the
+registry:
+
+  * ``cnmf-tpu lint`` (``analysis/rules_knobs.py``) flags any raw
+    ``os.environ`` access to a ``CNMF_*``/``JAX_*`` name outside this
+    module, and any accessor call naming a knob that is not registered;
+  * the registry is cross-checked both ways against the README's
+    "Environment knobs" table (:func:`knob_table` prints the canonical
+    table; ``cnmf-tpu lint --knob-table`` regenerates it), so doc drift
+    fails tier-1 instead of accumulating.
+
+Accessors reject bad values at parse time with a one-line message naming
+the knob (a typo'd ``CNMF_TPU_STREAM_DEPTH=tow`` used to surface as a
+confusing downstream error). Stdlib-only so the light runtime modules
+(``runtime/checkpoint.py``) can share them with the jax-heavy staging
+layer (``parallel/streaming.py``, ``parallel/multihost.py``) without
+import-order consequences.
 """
 
 from __future__ import annotations
 
 import os
+from dataclasses import dataclass
 
-__all__ = ["env_int", "env_float"]
+__all__ = [
+    "Knob",
+    "REGISTRY",
+    "env_int",
+    "env_float",
+    "env_str",
+    "env_flag",
+    "env_is_set",
+    "knob_table",
+    "parse_knob_table",
+]
+
+_FALSE_WORDS = ("0", "false", "off", "no")
 
 
-def env_int(name: str, default: int, lo: int | None = None) -> int:
+@dataclass(frozen=True)
+class Knob:
+    """One registered environment knob.
+
+    ``default`` is the *display* default — the exact cell text for the
+    README table (some defaults are computed at runtime: "device-derived",
+    "`2×threads+1`"). ``documented=False`` marks upstream variables we
+    merely respect (``JAX_*``): registered so the accessors and the lint
+    hygiene rule cover them, excluded from the README cross-check.
+    """
+
+    name: str
+    kind: str  # "int" | "float" | "str" | "flag"
+    default: str
+    doc: str
+    documented: bool = True
+
+
+def _knobs(*entries) -> dict:
+    return {k.name: k for k in entries}
+
+
+REGISTRY: dict[str, Knob] = _knobs(
+    # -- solver / dispatch ------------------------------------------------
+    Knob("CNMF_TPU_SPARSE_BETA", "str", "auto",
+         "β∈{1,0} ELL sparse path: `0` force dense, `1` force ELL, a value "
+         "in (0,1) replaces the auto density threshold (default 0.10, plus "
+         "a width≤genes/8 ragged-row guard)"),
+    Knob("CNMF_TPU_BF16_RATIO", "flag", "`1`",
+         "bf16 X/WH/ratio intermediates for online KL/IS (1.78–2.09× on "
+         "v5e); `0` restores strict f32 (announced once per process when "
+         "active)"),
+    Knob("CNMF_TPU_BUDGET_ELEMS", "int", "device-derived",
+         "fp32 element budget for replicate-sweep slicing"),
+    Knob("CNMF_TPU_WARM_DUMMY_BUDGET_BYTES", "int", "`2<<30`",
+         "cap on dataset-sized warm-up dummy allocations "
+         "(consensus/K-selection/Harmony warms)"),
+    # -- staging ----------------------------------------------------------
+    Knob("CNMF_TPU_STREAM_DEPTH", "int", "`2×threads+1`",
+         "max in-flight (prepared-but-uncommitted) staging slabs; `1` = "
+         "exact serial fallback; clamped by the bytes budget"),
+    Knob("CNMF_TPU_STREAM_THREADS", "int", "`min(4, cpus−1)`",
+         "host-prep worker threads for pipelined staging; `0` = serial"),
+    Knob("CNMF_TPU_STREAM_BYTES", "int", "`4<<30`",
+         "host-RAM budget for in-flight staging slab buffers (caps depth × "
+         "slab bytes)"),
+    Knob("CNMF_TPU_STREAM_TRANSPORT", "str", "auto",
+         "sparse staging transport: `csr` ships CSR buffers + on-device "
+         "scatter densify (accelerators — wire bytes scale with nnz), "
+         "`dense` densifies slab-by-slab on host (auto on CPU backends, "
+         "where XLA's scatter costs ~4× the memcpy it replaces)"),
+    Knob("CNMF_TPU_SHARD_RETRIES", "int", "`2`",
+         "per-slab upload retry budget: a transient prep/transfer failure "
+         "retries with bounded backoff instead of failing the whole "
+         "staging call; exhausted slabs raise `ShardUploadError`. `0` "
+         "disables retries"),
+    Knob("CNMF_TPU_SHARD_BACKOFF_S", "float", "`0.1`",
+         "shard-retry backoff base: attempt N waits `base * 2^(N-1)` "
+         "seconds"),
+    Knob("CNMF_TPU_STREAM_STALL_S", "float", "`0` (off)",
+         "per-slab progress watchdog on the pipelined staging path: a "
+         "transfer hung longer than this raises `ShardStallError` "
+         "(diagnosable, checkpoint-resumable) instead of hanging the mesh"),
+    # -- checkpointing / multihost ----------------------------------------
+    Knob("CNMF_TPU_CKPT_EVERY_PASSES", "int", "`1`",
+         "mid-run checkpoint cadence for the rowsharded solver, in solver "
+         "passes: each replicate's `(A,B)`/W/cursor state persists "
+         "atomically and `--skip-completed-runs` resumes mid-run. `0` "
+         "disables the subsystem (exact pre-checkpoint fused programs)"),
+    Knob("CNMF_TPU_CKPT_H_BYTES", "int", "`256<<20`",
+         "byte budget under which the usage matrix H also rides the "
+         "checkpoint (resume then bit-identical); above it resume "
+         "re-derives H from W within solver tolerance"),
+    Knob("CNMF_TPU_CKPT_MIN_INTERVAL_S", "float",
+         "`0` (every eligible pass)",
+         "wall-clock floor between checkpoint writes: caps the "
+         "gather+write amplification on runs whose passes take seconds "
+         "(resume restarts from a slightly older pass)"),
+    Knob("CNMF_TPU_BARRIER_TIMEOUT_S", "float", "`0` (off)",
+         "cross-host barrier watchdog: a barrier a dead host can never "
+         "join raises `HostBarrierTimeout` (clean abort; relaunch resumes "
+         "from checkpoints) instead of a distributed hang"),
+    # -- warm-up / caching / io -------------------------------------------
+    Knob("CNMF_WARM_CONSENSUS", "flag", "`1`",
+         "`0` disables the concurrent consensus program warm-up"),
+    Knob("CNMF_WARM_PREPROCESS", "flag", "`1`",
+         "`0` disables the concurrent Harmony/PCA preprocess program "
+         "warm-up"),
+    Knob("CNMF_TPU_COMPILE_CACHE", "flag", "`1`",
+         "`0` stops the pipeline entry points from enabling the persistent "
+         "XLA compile cache (a user's explicit JAX cache config is never "
+         "overridden either way)"),
+    Knob("CNMF_H5_COMPRESSION", "str", "`none`",
+         "h5ad artifact compression: `none` (reference-matching default; "
+         "gzip-1 was ~5 s of a 22 s prepare), `gzip` (level 1), or `lzf`"),
+    # -- observability ----------------------------------------------------
+    Knob("CNMF_TPU_TELEMETRY", "flag", "`0`",
+         "`1` enables the structured run-telemetry event log "
+         "(`<run>/cnmf_tmp/<name>.events.jsonl`): manifest, dispatch "
+         "decisions, stage walls, per-replicate solver convergence "
+         "records, stream stats, device-memory watermarks — rendered by "
+         "`cnmf-tpu report`. Off = zero ops added to the jitted solvers "
+         "and no file I/O"),
+    Knob("CNMF_TPU_PROFILE_DIR", "str", "unset",
+         "per-stage `jax.profiler` traces into this directory"),
+    # -- fault tolerance ---------------------------------------------------
+    Knob("CNMF_TPU_MAX_RETRIES", "int", "`2`",
+         "retry budget per unhealthy (nonfinite) replicate: each attempt "
+         "re-runs the lane with the derived seed `seed XOR attempt`; `0` "
+         "quarantines immediately"),
+    Knob("CNMF_TPU_MIN_HEALTHY_FRAC", "float", "`0.8`",
+         "per-K survival floor: factorize degrades gracefully (quarantined "
+         "replicates excluded from combine) while at least this fraction "
+         "of a K's replicates end healthy, and hard-fails with a clear "
+         "error below it. Evaluated over each worker's own ledger shard "
+         "(workers can't see each other's outcomes); with many thin "
+         "shards size it against the per-shard replicate count"),
+    Knob("CNMF_TPU_FAULT_SPEC", "str", "unset",
+         "deterministic fault injection (`runtime/faults.py`), e.g. "
+         "`nonfinite:k=5,iter=2;kill:stage=factorize,worker=1;"
+         "torn:artifact=iter_` — NaN lanes, worker SIGKILL, torn "
+         "artifacts, failed uploads, stalls; every hook is a no-op when "
+         "unset"),
+    Knob("CNMF_TPU_WORKER_TIMEOUT", "float", "`0` (off)",
+         "per-worker wall timeout in seconds for the subprocess launcher "
+         "engine; an over-budget worker is killed (and respawned, below)"),
+    Knob("CNMF_TPU_WORKER_RESPAWNS", "int", "`1`",
+         "how many times the launcher respawns a dead/timed-out worker "
+         "onto its unfinished ledger shard (`--skip-completed-runs`) "
+         "before falling back to skip-missing combine"),
+    Knob("CNMF_TPU_WORKER_BACKOFF_S", "float", "`0.5`",
+         "respawn backoff base: attempt N waits `base * 2^(N-1)` seconds"),
+    # -- testing / sanitizers ---------------------------------------------
+    Knob("CNMF_TPU_SANITIZE", "flag", "`0`",
+         "`1` wraps the designated tier-1 solver subset in "
+         "`jax.transfer_guard(\"disallow\")` + NaN debugging "
+         "(`tests/conftest.py`): an implicit host transfer or a NaN "
+         "escaping a jitted hot path fails the test instead of silently "
+         "costing a sync"),
+    # -- multi-host coordinates -------------------------------------------
+    Knob("CNMF_COORDINATOR_ADDRESS", "str", "unset",
+         "multi-host pod coordinate: coordinator `host:port` (set all "
+         "three together)"),
+    Knob("CNMF_NUM_PROCESSES", "int", "unset",
+         "multi-host pod coordinate: total process count (set all three "
+         "together)"),
+    Knob("CNMF_PROCESS_ID", "int", "unset",
+         "multi-host pod coordinate: this process's id (set all three "
+         "together)"),
+    Knob("CNMF_SIM_CPU_DEVICES", "int", "unset",
+         "simulate an N-device CPU pod host (launcher/tests)"),
+    # -- upstream JAX variables we respect (not ours to document) ---------
+    Knob("JAX_COMPILATION_CACHE_DIR", "str", "unset",
+         "user-configured persistent compile cache wins over ours",
+         documented=False),
+    Knob("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "str", "unset",
+         "user-configured cache threshold wins over ours",
+         documented=False),
+    Knob("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "str", "unset",
+         "user-selected CPU collectives implementation wins over gloo",
+         documented=False),
+    Knob("JAX_THREEFRY_PARTITIONABLE", "str", "on (package default)",
+         "the packed K-selection's bit-parity needs the partitionable "
+         "threefry; pinning `0` makes the packed entry points fail fast "
+         "instead of silently diverging"),
+)
+
+
+def _raw(name: str) -> str | None:
+    if name not in REGISTRY:
+        raise ValueError(
+            f"env knob {name!r} is not registered; declare it in "
+            "cnmf_torch_tpu/utils/envknobs.py (name/type/default/doc) so "
+            "the lint gate and the README knob table stay complete")
+    return os.environ.get(name)
+
+
+def env_int(name: str, default: int | None,
+            lo: int | None = None, hi: int | None = None) -> int | None:
     """Parse an integer knob: empty/unset -> ``default``; non-numeric or
-    below the knob's floor raises ``ValueError`` naming the knob."""
-    raw = os.environ.get(name, "").strip()
+    outside ``[lo, hi]`` raises ``ValueError`` naming the knob."""
+    raw = _raw(name)
+    raw = (raw or "").strip()
     if not raw:
         return default
     try:
@@ -27,12 +232,17 @@ def env_int(name: str, default: int, lo: int | None = None) -> int:
         raise ValueError(f"{name}={raw!r}: expected an integer")
     if lo is not None and val < lo:
         raise ValueError(f"{name}={raw!r}: must be >= {lo}")
+    if hi is not None and val > hi:
+        raise ValueError(f"{name}={raw!r}: must be <= {hi}")
     return val
 
 
-def env_float(name: str, default: float, lo: float | None = None) -> float:
+def env_float(name: str, default: float | None,
+              lo: float | None = None,
+              hi: float | None = None) -> float | None:
     """Parse a float knob with the same strictness as :func:`env_int`."""
-    raw = os.environ.get(name, "").strip()
+    raw = _raw(name)
+    raw = (raw or "").strip()
     if not raw:
         return default
     try:
@@ -41,4 +251,73 @@ def env_float(name: str, default: float, lo: float | None = None) -> float:
         raise ValueError(f"{name}={raw!r}: expected a number")
     if lo is not None and val < lo:
         raise ValueError(f"{name}={raw!r}: must be >= {lo}")
+    if hi is not None and val > hi:
+        raise ValueError(f"{name}={raw!r}: must be <= {hi}")
     return val
+
+
+def env_str(name: str, default: str = "") -> str:
+    """Read a string knob verbatim; unset -> ``default``."""
+    raw = _raw(name)
+    return default if raw is None else raw
+
+
+def env_flag(name: str, default: bool) -> bool:
+    """Boolean knob: unset/empty -> ``default``; ``0/false/off/no`` (any
+    case) -> False; anything else -> True."""
+    raw = _raw(name)
+    if raw is None or not raw.strip():
+        return default
+    return raw.strip().lower() not in _FALSE_WORDS
+
+
+def env_is_set(name: str) -> bool:
+    """True when the knob is present in the environment (even empty) —
+    the "an explicit user pin wins" predicate."""
+    if name not in REGISTRY:
+        raise ValueError(
+            f"env knob {name!r} is not registered; declare it in "
+            "cnmf_torch_tpu/utils/envknobs.py")
+    return name in os.environ
+
+
+# ---------------------------------------------------------------------------
+# canonical README table
+# ---------------------------------------------------------------------------
+
+TABLE_HEADER = ("| knob | default | what it does |", "|---|---|---|")
+
+
+def knob_table() -> str:
+    """The canonical markdown "Environment knobs" table, generated from
+    the registry (``cnmf-tpu lint --knob-table``). The README's table must
+    match it byte-for-byte — the lint gate's doc-drift rule compares both
+    directions, so regenerate with this instead of hand-editing."""
+    lines = list(TABLE_HEADER)
+    for k in REGISTRY.values():
+        if k.documented:
+            lines.append(f"| `{k.name}` | {k.default} | {k.doc} |")
+    return "\n".join(lines)
+
+
+def parse_knob_table(text: str) -> dict[str, tuple[str, str]]:
+    """Parse a markdown knob table (README or :func:`knob_table` output)
+    into ``{name: (default_cell, doc_cell)}``. Rows are ``| `NAME` |
+    default | doc |``; non-table lines and the header are ignored."""
+    import re
+
+    # non-greedy name/default cells, greedy doc cell: a doc that contains
+    # a literal `|` still parses (only name/default cells must be `|`-free,
+    # which the knob kinds guarantee)
+    row_re = re.compile(r"^\| (.+?) \| (.+?) \| (.+) \|$")
+    out: dict[str, tuple[str, str]] = {}
+    for line in text.splitlines():
+        m = row_re.match(line.strip())
+        if not m:
+            continue
+        name_cell, default_cell, doc_cell = (c.strip() for c in m.groups())
+        if name_cell in ("knob", "Variable"):
+            continue
+        for name in re.findall(r"`((?:CNMF|JAX)[A-Z0-9_]*)`", name_cell):
+            out[name] = (default_cell, doc_cell)
+    return out

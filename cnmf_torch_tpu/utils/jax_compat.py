@@ -78,7 +78,9 @@ def default_threefry_partitionable() -> None:
     """Flip ``jax_threefry_partitionable`` ON where an older JAX defaults
     it OFF. An explicit user env pin wins (the packed entry points will
     then refuse loudly instead of silently diverging)."""
-    if "JAX_THREEFRY_PARTITIONABLE" not in os.environ:
+    from .envknobs import env_is_set
+
+    if not env_is_set("JAX_THREEFRY_PARTITIONABLE"):
         try:
             jax.config.update("jax_threefry_partitionable", True)
         except AttributeError:  # future jax that removed the legacy impl

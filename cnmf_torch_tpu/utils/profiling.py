@@ -101,7 +101,10 @@ class StageTimer:
         try:
             with self._lock:
                 header_needed = not os.path.exists(self.timings_path)
-                with open(self.timings_path, "a") as f:
+                # append-only ledger, not a probed artifact: an atomic
+                # rewrite would drop rows raced in by sibling processes,
+                # and a torn tail row is tolerated by every reader
+                with open(self.timings_path, "a") as f:  # cnmf-lint: disable=artifact-nonatomic
                     if header_needed:
                         # bytes/gb_per_s sit AFTER wall_seconds: the one
                         # external parser (bench.iter_stage_rows) reads
@@ -145,7 +148,9 @@ def trace(stage_name: str):
     whichever stage acquires the (non-blocking) session lock first traces;
     stages nested inside it or racing it from sibling threads no-op.
     """
-    profile_dir = os.environ.get(PROFILE_ENV)
+    from .envknobs import env_str
+
+    profile_dir = env_str(PROFILE_ENV, "")
     if not profile_dir or not _trace_lock.acquire(blocking=False):
         yield
         return

@@ -92,8 +92,9 @@ def telemetry_enabled() -> bool:
     """True when ``CNMF_TPU_TELEMETRY`` is set to anything but 0/off.
     Checked at every emission site, so tests (and long-lived processes)
     can toggle it without rebuilding pipeline objects."""
-    return os.environ.get(TELEMETRY_ENV, "0").strip().lower() not in (
-        "", "0", "false", "off", "no")
+    from .envknobs import env_flag
+
+    return env_flag(TELEMETRY_ENV, False)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,38 @@
+#!/usr/bin/env python
+"""Tier-1 lint gate: the package must produce ZERO unbaselined findings.
+
+Runs ``cnmf-tpu lint`` over ``cnmf_torch_tpu/`` (all rule families plus
+the README knob-table drift check) against the checked-in baseline
+(``cnmf_torch_tpu/analysis/baseline.json`` — shipped empty) and echoes a
+one-line per-family count next to the telemetry/chaos smoke lines in
+``scripts/verify_tier1.sh``. Never imports jax — this step costs well
+under a second.
+
+Exit 0: clean. Exit 1: findings (printed). Anything else: engine error.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    os.chdir(REPO)
+    from cnmf_torch_tpu.analysis.engine import (DEFAULT_BASELINE,
+                                                format_text, lint_paths)
+
+    result = lint_paths(["cnmf_torch_tpu"], baseline_path=DEFAULT_BASELINE)
+    fams = " ".join(f"{fam}={n}" for fam, n in
+                    sorted(result.family_counts().items()))
+    print(f"LINT_GATE: {fams} baselined={len(result.baselined)} "
+          f"suppressed={result.suppressed} files={result.files}")
+    if result.findings:
+        print(format_text(result))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

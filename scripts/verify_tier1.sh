@@ -19,6 +19,18 @@ if [ -n "${1:-}" ]; then
   export XLA_FLAGS="${XLA_FLAGS:+$XLA_FLAGS }--xla_force_host_platform_device_count=$1"
 fi
 
+# static-analysis gate first (scripts/lint_gate.py): sub-second, no jax —
+# zero unbaselined `cnmf-tpu lint` findings across the package (trace
+# safety, knob hygiene + README knob-table drift, artifact atomicity,
+# telemetry schema, lock discipline)
+echo "[tier1] lint gate (cnmf-tpu lint cnmf_torch_tpu/) ..."
+if python scripts/lint_gate.py; then
+  echo LINT_GATE=ok
+else
+  echo LINT_GATE=fail
+  exit 1
+fi
+
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
 rm -f "$LOG"
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

@@ -1,0 +1,495 @@
+"""`cnmf-tpu lint` engine tests (ISSUE 7): paired positive/negative
+fixtures per rule family, suppression + baseline semantics, JSON output
+shape, knob-registry round-trips, and the package-wide clean gate."""
+
+import json
+import os
+
+import pytest
+
+from cnmf_torch_tpu.analysis.engine import (DEFAULT_BASELINE, format_json,
+                                            lint_paths, main as lint_main,
+                                            write_baseline)
+from cnmf_torch_tpu.utils import envknobs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint_src(tmp_path, src, name="fixture.py", baseline=None):
+    p = tmp_path / name
+    p.write_text(src)
+    return lint_paths([str(p)], baseline_path=baseline, doc_check=False)
+
+
+def _rules(result):
+    return sorted(f.rule for f in result.findings)
+
+
+# ---------------------------------------------------------------------------
+# trace-safety family
+# ---------------------------------------------------------------------------
+
+def test_host_sync_in_jitted_body_detected(tmp_path):
+    res = _lint_src(tmp_path, """
+import jax
+
+@jax.jit
+def f(x):
+    return x.item()
+""")
+    assert _rules(res) == ["trace-host-sync"]
+    assert res.findings[0].line == 6
+
+
+def test_host_sync_outside_traced_scope_clean(tmp_path):
+    res = _lint_src(tmp_path, """
+import numpy as np
+
+def fetch(x):
+    return float(np.asarray(x).item())
+""")
+    assert res.findings == []
+
+
+def test_host_sync_in_while_loop_body_and_partial_jit(tmp_path):
+    res = _lint_src(tmp_path, """
+import functools
+import jax
+import numpy as np
+from jax import lax
+
+def body(carry):
+    return np.asarray(carry) + 1
+
+out = lax.while_loop(lambda c: c < 3, body, 0)
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def g(x, mode):
+    return x.block_until_ready()
+""")
+    assert _rules(res) == ["trace-host-sync", "trace-host-sync"]
+
+
+def test_nested_traced_scope_gets_its_own_params(tmp_path):
+    """A while_loop body nested inside a jitted function is analyzed with
+    its OWN params traced plus the enclosing scope's by closure (review
+    finding, this PR)."""
+    res = _lint_src(tmp_path, """
+import functools
+import jax
+from jax import lax
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def f(x, mode):
+    def body(carry):
+        if carry > 0:      # inner param: traced
+            carry = carry - x
+        if x > 0:          # closure over outer traced param
+            carry = carry + 1
+        if mode:           # closure over outer STATIC: exempt
+            carry = carry * 2
+        return carry
+    return lax.while_loop(lambda c: c < 3, body, x)
+""")
+    assert _rules(res) == ["trace-branch", "trace-branch"]
+    assert [f.line for f in res.findings] == [9, 11]
+
+
+def test_tracer_function_passed_by_keyword_detected(tmp_path):
+    res = _lint_src(tmp_path, """
+from jax import lax
+
+def body(c):
+    return c.item() + 1
+
+out = lax.while_loop(lambda c: c < 3, body_fun=body, init_val=0)
+""")
+    assert _rules(res) == ["trace-host-sync"]
+
+
+def test_shape_probes_and_static_casts_clean(tmp_path):
+    res = _lint_src(tmp_path, """
+import jax
+
+@jax.jit
+def f(x):
+    n = int(x.shape[0])
+    m = float(len(x.shape))
+    if x.ndim > 1:
+        x = x.sum(axis=0)
+    return x * n * m
+""")
+    assert res.findings == []
+
+
+def test_nondeterminism_in_traced_scope(tmp_path):
+    res = _lint_src(tmp_path, """
+import random
+import time
+import jax
+
+@jax.jit
+def f(x):
+    return x + time.time() + random.random()
+
+def host_side():
+    return time.time()
+""")
+    assert _rules(res) == ["trace-nondet", "trace-nondet"]
+
+
+def test_branch_on_traced_param_detected_static_exempt(tmp_path):
+    res = _lint_src(tmp_path, """
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("telemetry",))
+def f(x, telemetry):
+    if telemetry:          # static: supported pattern
+        x = x + 0
+    if x > 0:              # traced: concretization error
+        x = x - 1
+    return x
+""")
+    assert _rules(res) == ["trace-branch"]
+    assert "x" in res.findings[0].message
+
+
+def test_branch_on_isinstance_and_shape_clean(tmp_path):
+    res = _lint_src(tmp_path, """
+import jax
+
+@jax.jit
+def f(X):
+    if isinstance(X, tuple):
+        X = X[0]
+    if X.shape[0] > 4:
+        X = X[:4]
+    return X
+""")
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# knob hygiene family
+# ---------------------------------------------------------------------------
+
+def test_raw_env_read_detected(tmp_path):
+    res = _lint_src(tmp_path, """
+import os
+
+depth = os.environ.get("CNMF_TPU_STREAM_DEPTH", "3")
+spec = os.environ["JAX_COMPILATION_CACHE_DIR"]
+present = "CNMF_TPU_TELEMETRY" in os.environ
+via_getenv = os.getenv("CNMF_TPU_MAX_RETRIES")
+other = os.environ.get("HOME")
+""")
+    assert _rules(res) == ["knob-raw-env"] * 4
+
+
+def test_accessor_usage_clean_and_unregistered_detected(tmp_path):
+    res = _lint_src(tmp_path, """
+from cnmf_torch_tpu.utils.envknobs import env_flag, env_int
+
+ok = env_int("CNMF_TPU_STREAM_DEPTH", 3, lo=1)
+bad = env_flag("CNMF_TPU_NOT_A_KNOB", True)
+""")
+    assert _rules(res) == ["knob-unregistered"]
+
+
+def test_envknobs_module_itself_exempt(tmp_path):
+    utils = tmp_path / "utils"
+    utils.mkdir()
+    p = utils / "envknobs.py"
+    p.write_text('import os\nv = os.environ.get("CNMF_TPU_TELEMETRY")\n')
+    res = lint_paths([str(p)], doc_check=False)
+    assert res.findings == []
+
+
+def test_accessors_reject_unregistered_at_runtime():
+    with pytest.raises(ValueError, match="not registered"):
+        envknobs.env_int("CNMF_TPU_NOT_A_KNOB", 1)
+    with pytest.raises(ValueError, match="not registered"):
+        envknobs.env_is_set("CNMF_TPU_NOT_A_KNOB")
+
+
+# ---------------------------------------------------------------------------
+# artifact atomicity family
+# ---------------------------------------------------------------------------
+
+def test_nonatomic_writes_detected(tmp_path):
+    res = _lint_src(tmp_path, """
+import numpy as np
+
+def save(df, path, arr):
+    with open(path, "w") as f:
+        f.write("x")
+    np.savez(path + ".npz", arr=arr)
+    df.to_csv(path + ".tsv", sep="\\t")
+""")
+    assert _rules(res) == ["artifact-nonatomic"] * 3
+
+
+def test_atomic_artifact_block_clean(tmp_path):
+    res = _lint_src(tmp_path, """
+import numpy as np
+from cnmf_torch_tpu.utils.anndata_lite import atomic_artifact
+
+def save(df, path, arr, fig):
+    with atomic_artifact(path) as tmp:
+        with open(tmp, "w") as f:
+            f.write("x")
+    with atomic_artifact(path + ".npz") as tmp:
+        np.savez(tmp, arr=arr)
+    with atomic_artifact(path + ".png") as tmp:
+        fig.savefig(tmp, format="png")
+
+def read(path):
+    with open(path) as f:          # read mode: never flagged
+        return f.read()
+""")
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# telemetry schema family
+# ---------------------------------------------------------------------------
+
+def test_unknown_event_type_and_missing_field_detected(tmp_path):
+    res = _lint_src(tmp_path, """
+def report(events, wall):
+    events.emit("frobnicate", foo=1)
+    events.emit("stage", stage="combine")
+    events.emit("stage", stage="combine", wall_s=wall)
+""")
+    assert _rules(res) == ["telemetry-schema", "telemetry-schema"]
+    msgs = " ".join(f.message for f in res.findings)
+    assert "frobnicate" in msgs and "wall_s" in msgs
+
+
+def test_emit_splat_and_dynamic_type_skipped(tmp_path):
+    res = _lint_src(tmp_path, """
+def forward(events, etype, fields):
+    events.emit(etype, **fields)      # dynamic: runtime smoke covers it
+    events.emit("fault", **fields)    # splat: field set unknowable
+""")
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# concurrency family
+# ---------------------------------------------------------------------------
+
+def test_unlocked_module_state_mutation_detected(tmp_path):
+    res = _lint_src(tmp_path, """
+import threading
+
+_cache = {}
+_flag = False
+_lock = threading.Lock()
+
+def poke(k, v):
+    _cache[k] = v
+
+def latch():
+    global _flag
+    _flag = True
+""")
+    assert _rules(res) == ["lock-discipline", "lock-discipline"]
+
+
+def test_nested_scope_binding_does_not_shadow_outer(tmp_path):
+    """A nested function binding the same name must not mask the outer
+    function's unlocked mutation (review finding, this PR)."""
+    res = _lint_src(tmp_path, """
+_state = {}
+
+def outer(v):
+    _state["k"] = v          # unlocked mutation: must fire
+    def inner():
+        _state = {}          # nested local: shadows only inner
+        _state["k"] = 0      # clean (local)
+        return _state
+    return inner
+""")
+    assert _rules(res) == ["lock-discipline"]
+    assert res.findings[0].line == 5
+
+
+def test_locked_mutation_and_local_shadow_clean(tmp_path):
+    res = _lint_src(tmp_path, """
+import threading
+
+_cache = {}
+_other = {}
+_lock = threading.Lock()
+
+def poke(k, v):
+    with _lock:
+        _cache[k] = v
+
+def shadowed(k, v):
+    _cache = {}        # local: shadows the module binding
+    _cache[k] = v
+    return _cache
+
+def annotated(k, v):
+    _cache: dict = {}  # annotated local: still a shadow
+    _cache[k] = v
+    if (_other := dict()):   # walrus local: still a shadow
+        _other[k] = v
+    return _cache, _other
+""")
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions, baseline, output
+# ---------------------------------------------------------------------------
+
+def test_inline_suppression_same_line_and_line_above(tmp_path):
+    res = _lint_src(tmp_path, """
+import os
+
+a = os.environ.get("CNMF_TPU_TELEMETRY")  # cnmf-lint: disable=knob-raw-env
+# cnmf-lint: disable=knob-raw-env
+b = os.environ.get("CNMF_TPU_PROFILE_DIR")
+c = os.environ.get("CNMF_TPU_MAX_RETRIES")  # cnmf-lint: disable=lock-discipline
+""")
+    assert _rules(res) == ["knob-raw-env"]  # wrong rule id doesn't suppress
+    assert res.suppressed == 2
+    assert res.findings[0].line == 7
+
+
+def test_baseline_absorbs_then_new_finding_fails(tmp_path):
+    src = 'import os\nv = os.environ.get("CNMF_TPU_TELEMETRY")\n'
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    pre = lint_paths([str(p)], doc_check=False)
+    assert len(pre.findings) == 1
+
+    baseline = tmp_path / "baseline.json"
+    write_baseline(str(baseline), pre.findings)
+    clean = lint_paths([str(p)], baseline_path=str(baseline),
+                       doc_check=False)
+    assert clean.findings == [] and len(clean.baselined) == 1
+
+    # a NEW violation is not hidden by the old baseline (and line drift
+    # of the baselined one stays absorbed: fingerprint is rule+text)
+    p.write_text("# moved down a line\n" + src
+                 + 'w = os.environ.get("CNMF_TPU_PROFILE_DIR")\n')
+    res = lint_paths([str(p)], baseline_path=str(baseline),
+                     doc_check=False)
+    assert len(res.findings) == 1 and len(res.baselined) == 1
+    assert "CNMF_TPU_PROFILE_DIR" in res.findings[0].message
+
+
+def test_json_output_shape(tmp_path):
+    res = _lint_src(tmp_path, """
+import os
+v = os.environ.get("CNMF_TPU_TELEMETRY")
+""")
+    data = json.loads(format_json(res))
+    assert data["version"] == 1 and data["files"] == 1
+    (f,) = data["findings"]
+    assert set(f) == {"path", "line", "rule", "message", "hint", "text"}
+    assert f["rule"] == "knob-raw-env"
+    assert data["counts"] == {"knob-raw-env": 1}
+    assert data["families"]["knobs"] == 1
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    res = _lint_src(tmp_path, "def broken(:\n")
+    assert _rules(res) == ["lint-parse-error"]
+
+
+# ---------------------------------------------------------------------------
+# knob registry round-trip + doc drift
+# ---------------------------------------------------------------------------
+
+def test_knob_table_round_trip():
+    table = envknobs.knob_table()
+    parsed = envknobs.parse_knob_table(table)
+    documented = {n: k for n, k in envknobs.REGISTRY.items()
+                  if k.documented}
+    assert set(parsed) == set(documented)
+    for name, (default, doc) in parsed.items():
+        assert default == documented[name].default
+        assert doc == documented[name].doc
+
+
+def test_readme_drift_detected(tmp_path):
+    from cnmf_torch_tpu.analysis.rules_knobs import check_knob_docs
+
+    readme = tmp_path / "README.md"
+    table = envknobs.knob_table().splitlines()
+    # drop one knob row, corrupt another default, rewrite a third's doc
+    table.pop(2)
+    name3, default3, _ = (c.strip() for c in
+                          table[3].strip("|").split(" | ", 2))
+    table[3] = f"| {name3} | STALE_DEFAULT | doesn't matter |"
+    name4, _, doc4 = (c.strip() for c in
+                      table[4].strip("|").split(" | ", 2))
+    table[4] = table[4].replace(doc4, "hand-edited description")
+    readme.write_text("## Environment knobs\n\n" + "\n".join(table)
+                      + "\n| `CNMF_TPU_BOGUS_KNOB` | `1` | nothing |\n")
+    findings = check_knob_docs(str(readme))
+    kinds = sorted(f.text.split(":")[0] for f in findings)
+    assert kinds == ["missing row", "stale default", "stale doc",
+                     "unregistered row"]
+
+
+def test_parse_knob_table_tolerates_pipe_in_doc():
+    row = ("| `CNMF_TPU_TELEMETRY` | `0` | choose `a` | `b` | `c` here |")
+    parsed = envknobs.parse_knob_table(row)
+    assert parsed == {"CNMF_TPU_TELEMETRY":
+                      ("`0`", "choose `a` | `b` | `c` here")}
+
+
+def test_real_readme_matches_registry():
+    from cnmf_torch_tpu.analysis.rules_knobs import check_knob_docs
+
+    assert check_knob_docs(os.path.join(REPO, "README.md")) == []
+
+
+# ---------------------------------------------------------------------------
+# the gate: the shipped package lints clean against an EMPTY baseline
+# ---------------------------------------------------------------------------
+
+def test_package_lints_clean_with_empty_baseline():
+    with open(DEFAULT_BASELINE) as f:
+        assert json.load(f)["findings"] == []
+    res = lint_paths([os.path.join(REPO, "cnmf_torch_tpu")],
+                     baseline_path=DEFAULT_BASELINE)
+    assert res.findings == []
+
+
+def test_cli_exit_codes_and_knob_table(tmp_path, capsys):
+    assert lint_main(["--knob-table"]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith("| knob | default | what it does |")
+    assert "CNMF_TPU_TELEMETRY" in out
+
+    bad = tmp_path / "bad.py"
+    bad.write_text('import os\nv = os.environ.get("CNMF_TPU_TELEMETRY")\n')
+    assert lint_main([str(bad), "--baseline", "", "--no-doc-check"]) == 1
+    assert "knob-raw-env" in capsys.readouterr().out
+    ok = tmp_path / "ok.py"
+    ok.write_text("x = 1\n")
+    assert lint_main([str(ok), "--baseline", "", "--no-doc-check"]) == 0
+    capsys.readouterr()
+
+    # --write-baseline grandfathers, then the same paths gate clean
+    bl = tmp_path / "bl.json"
+    assert lint_main([str(bad), "--baseline", str(bl), "--write-baseline",
+                      "--no-doc-check"]) == 0
+    assert lint_main([str(bad), "--baseline", str(bl),
+                      "--no-doc-check"]) == 0
+    capsys.readouterr()
+
+    # "--baseline ''" means no baseline; combining it with
+    # --write-baseline must NOT fall back to the checked-in default
+    with pytest.raises(SystemExit) as exc:
+        lint_main([str(bad), "--baseline", "", "--write-baseline",
+                   "--no-doc-check"])
+    assert exc.value.code == 2
