@@ -16,9 +16,15 @@ Two engines replace GNU parallel:
     into a multi-hour replicate restarts mid-run, not from scratch — after
     an exponential backoff with deterministic per-worker jitter
     (:func:`respawn_delay`), up to ``CNMF_TPU_WORKER_RESPAWNS`` times
-    (default 1). Only when the respawn budget is exhausted does the run
-    fall back to the reference's dead-worker tolerance: combine with
-    ``skip_missing_files=True``.
+    (default 1). Elastic (ISSUE 8, ``CNMF_TPU_ELASTIC``): once any worker
+    has finished cleanly, dead shards are ADOPTED by the idle fleet
+    immediately (work-stealing, no backoff) and get one extra adoption
+    wave past the respawn budget; a worker whose run exceeds the longest
+    clean finisher's wall time by ``CNMF_TPU_STRAGGLER_S`` seconds with
+    a stale heartbeat is killed and contained the same way. Only when
+    every recovery lever is
+    exhausted does the run fall back to the reference's dead-worker
+    tolerance: combine with ``skip_missing_files=True``.
   * ``multihost`` — ONE single-controller JAX program spanning N processes
     stitched by ``jax.distributed`` (``parallel/multihost.py``); factorize
     runs over the 2-D (replicates x cells) mesh, with the cells-psum on ICI
@@ -71,7 +77,7 @@ def _worker_cmd(output_dir: str, name: str, extra: list[str]) -> list[str]:
 def _run_subprocess_workers(
         output_dir: str, name: str, total_workers: int,
         factorize_flags: list[str], base_env: dict,
-        poll_s: float = 0.05) -> tuple[set[int], set[int]]:
+        poll_s: float = 0.05, events=None) -> tuple[set[int], set[int]]:
     """Run the subprocess-engine worker fleet with self-healing: per-worker
     wall timeouts (``CNMF_TPU_WORKER_TIMEOUT`` seconds; 0/unset = none)
     and bounded exponential-backoff respawn of dead workers
@@ -79,14 +85,55 @@ def _run_subprocess_workers(
     ``CNMF_TPU_WORKER_BACKOFF_S * 2^(attempt-1)``). A respawned worker
     resumes its OWN round-robin ledger shard via ``--skip-completed-runs``
     — factorize probes AND validates the eager per-replicate artifacts, so
-    a SIGKILL'd predecessor's torn files are rerun, not trusted. Returns
-    ``(failed, unhealthy)``: worker indices that stayed dead after the
-    respawn budget, and workers that exited with
+    a SIGKILL'd predecessor's torn files are rerun, not trusted.
+
+    Elastic work-stealing (ISSUE 8, on unless ``CNMF_TPU_ELASTIC=0``):
+    once any worker has finished cleanly — proof the environment solves
+    and there is idle capacity — a dead worker's unfinished ``(k, iter)``
+    cells are ADOPTED by the fleet instead of waiting out the fixed-shard
+    backoff ladder: the adoption spawns immediately onto the orphan shard
+    with ``--skip-completed-runs`` (the probe skips the dead worker's
+    completed cells), and a shard whose respawn budget is exhausted gets
+    one further adoption wave before combine degrades around it — a
+    budget exhausted BEFORE any worker finished defers that wave until
+    the first clean finisher proves the environment (an early-crashing
+    shard does not forfeit its adoption just by crashing first). The
+    adopter runs under the orphan's ``--worker-index``, so its resilience
+    ledger (``*.resilience.w<N>.json``), provenance record, and
+    min-healthy-frac floor accounting stay exactly where the dead
+    worker's would have been — quarantine records carry over instead of
+    double-counting or vanishing.
+
+    Straggler containment (``CNMF_TPU_STRAGGLER_S``, part of the elastic
+    layer — inert under ``CNMF_TPU_ELASTIC=0``, and REQUIRES liveness,
+    ``CNMF_TPU_HEARTBEAT_S``): the longest clean finisher's wall time is
+    the fleet's observed shard runtime; a worker whose OWN elapsed (from
+    its own spawn, so adoptions doing a full shard's work get a full
+    allowance) exceeds that baseline by ``CNMF_TPU_STRAGGLER_S`` seconds
+    AND whose heartbeat is stale (older than ``max(grace, 3 x heartbeat
+    interval)`` — the barrier diagnosis's presumed-dead multiple) is
+    killed and contained through the same adoption path, before one slow
+    shard wedges the sweep. A worker stamping liveness on schedule is
+    never convicted: conviction needs both "past the fleet's wall" and
+    "no evidence of progress" — resumed runs have wildly unequal shards,
+    and a near-instant already-complete shard must not convict the one
+    doing real work. At most ONE straggler conviction per shard: a
+    second conviction at the same point would mean the deadline is wrong
+    (e.g. the shard's remaining work is one long jitted dispatch that
+    cannot stamp liveness mid-flight), so the containment respawn runs
+    to completion untouched — the straggler path alone can never
+    permanently fail a shard. Both containment kinds land in telemetry
+    as ``fault`` events (``worker_steal`` / ``straggler``) when
+    ``events`` is given.
+
+    Returns ``(failed, unhealthy)``: worker indices that stayed dead
+    after the recovery budget, and workers that exited with
     ``resilience.UNHEALTHY_EXIT_CODE`` (below the min-healthy-frac floor
     — a deterministic policy failure that is neither respawned nor
     degraded around; the caller aborts the pipeline)."""
     import time
 
+    from .runtime import elastic
     from .runtime.resilience import UNHEALTHY_EXIT_CODE
 
     from .utils.envknobs import env_float, env_int
@@ -94,6 +141,26 @@ def _run_subprocess_workers(
     respawn_limit = env_int("CNMF_TPU_WORKER_RESPAWNS", 1, lo=0)
     timeout_s = env_float("CNMF_TPU_WORKER_TIMEOUT", 0.0, lo=0.0)
     backoff_s = env_float("CNMF_TPU_WORKER_BACKOFF_S", 0.5, lo=0.0)
+    steal_on = elastic.elastic_enabled()
+    straggler_s = elastic.straggler_deadline_s()
+    hb_interval = elastic.heartbeat_s()
+    # straggler conviction is EVIDENCE-based: it needs liveness
+    # (CNMF_TPU_HEARTBEAT_S) so "slow but progressing" is distinguishable
+    # from "wedged" — a wall clock alone would convict healthy workers on
+    # resumed runs, whose shards are wildly unequal (a near-instant
+    # already-complete shard must not set the bar for one doing real
+    # work). The stale window is the larger of the grace and 3x the
+    # heartbeat interval (the same presumed-dead multiple the barrier
+    # diagnosis uses), so a worker beating on schedule is never convicted.
+    straggler_on = steal_on and straggler_s > 0 and hb_interval > 0
+    stale_window = max(straggler_s, 3.0 * hb_interval)
+    if steal_on and straggler_s > 0 and hb_interval <= 0:
+        warnings.warn(
+            "CNMF_TPU_STRAGGLER_S is set but CNMF_TPU_HEARTBEAT_S is off: "
+            "straggler containment needs liveness evidence to avoid "
+            "killing slow-but-healthy workers (resumed runs have wildly "
+            "unequal shards) — the deadline is disabled. Set "
+            "CNMF_TPU_HEARTBEAT_S to arm it.", RuntimeWarning)
 
     def spawn(i: int, resume: bool):
         flags = ["--worker-index", str(i),
@@ -104,19 +171,137 @@ def _run_subprocess_workers(
             _worker_cmd(output_dir, name, flags + factorize_flags),
             env=base_env)
 
+    def _emit(kind: str, **context):
+        if events is not None:
+            events.emit("fault", kind=kind, context=context)
+
+    def _read_heartbeat(i: int):
+        return elastic.Heartbeat.read(os.path.join(
+            output_dir, name, "cnmf_tmp", f"{name}.heartbeat.{i}.json"))
+
+    def _last_heartbeat(i: int) -> str:
+        """The worker's last liveness stamp, for diagnosis messages —
+        empty when heartbeats are off or never landed. Rendered by the
+        shared :meth:`Heartbeat.describe` formatter so launcher and
+        barrier diagnoses read the same way."""
+        rec = _read_heartbeat(i)
+        if not rec:
+            return ""
+        import time as _time
+
+        age = None
+        try:
+            age = round(max(0.0, _time.time() - float(rec["ts"])), 1)
+        except (KeyError, TypeError, ValueError):
+            pass
+        return "; " + elastic.Heartbeat.describe(
+            [{"index": i, "age_s": age, "phase": rec.get("phase"),
+              "cursor": rec.get("cursor")}])
+
+    def _heartbeat_fresh(i: int, within_s: float) -> bool:
+        """True when the worker stamped liveness within ``within_s`` —
+        evidence of real progress that vetoes a wall-clock straggler
+        conviction."""
+        rec = _read_heartbeat(i)
+        if not rec:
+            return False
+        import time as _time
+
+        try:
+            return _time.time() - float(rec["ts"]) <= within_s
+        except (KeyError, TypeError, ValueError):
+            return False
+
     now = time.monotonic
     procs = {i: spawn(i, False) for i in range(total_workers)}
+    started = {i: now() for i in procs}
     deadline = {i: (now() + timeout_s if timeout_s > 0 else None)
                 for i in procs}
     attempts = {i: 0 for i in procs}
+    adoptions = {i: 0 for i in procs}
     respawn_at: dict[int, float] = {}
     failed: set[int] = set()
     unhealthy: set[int] = set()
+    finished: set[int] = set()
+    # shards whose respawn budget died BEFORE any worker finished: their
+    # adoption wave is deferred until a clean finisher proves the
+    # environment (an early-crashing shard must not forfeit the wave
+    # just because it crashed first)
+    deferred: set[int] = set()
+    # at most ONE straggler conviction per shard: a second conviction at
+    # the same point means the deadline is wrong (e.g. the shard's work
+    # is one long jitted dispatch that cannot stamp liveness mid-flight),
+    # not the shard — the adoption is then left to run to completion, so
+    # the straggler path alone can never permanently fail a shard
+    straggled: set[int] = set()
+    # the longest clean finisher's wall time: the fleet's observed shard
+    # runtime, baseline for the straggler deadline
+    baseline_s: float | None = None
+
+    def _recover(i: int, rc) -> None:
+        """Schedule recovery for dead shard ``i``: fixed-shard respawn
+        with backoff while the budget lasts (immediate, labeled adoption
+        when the idle fleet can steal), one bonus adoption wave after
+        the budget, then the reference's dead-worker tolerance."""
+        can_steal = steal_on and bool(finished)
+        if attempts[i] < respawn_limit:
+            attempts[i] += 1
+            if can_steal:
+                warnings.warn(
+                    "factorize worker %d died (rc=%s); its unfinished "
+                    "cells are adopted by the idle fleet now (work-"
+                    "stealing via --skip-completed-runs, attempt %d/%d)"
+                    % (i, rc, attempts[i], respawn_limit),
+                    RuntimeWarning)
+                _emit("worker_steal", shard=i, attempt=attempts[i],
+                      reason="dead_worker")
+                respawn_at[i] = now()
+            else:
+                delay = respawn_delay(backoff_s, attempts[i], i)
+                warnings.warn(
+                    "factorize worker %d died (rc=%s); respawning onto its "
+                    "unfinished ledger shard in %.1fs (attempt %d/%d)"
+                    % (i, rc, delay, attempts[i], respawn_limit),
+                    RuntimeWarning)
+                respawn_at[i] = now() + delay
+        elif can_steal and adoptions[i] < 1:
+            # respawn budget burned — one adoption wave by the proven-
+            # healthy fleet before giving the shard up: the budget guards
+            # against a sick environment, and a clean finisher is the
+            # evidence the environment is fine
+            adoptions[i] += 1
+            warnings.warn(
+                "factorize worker %d exhausted its respawn budget; one "
+                "adoption wave steals its unfinished cells before combine "
+                "degrades around them" % i, RuntimeWarning)
+            _emit("worker_steal", shard=i,
+                  attempt=respawn_limit + adoptions[i],
+                  reason="respawn_budget_exhausted")
+            respawn_at[i] = now()
+        elif steal_on and not finished and adoptions[i] < 1:
+            # budget exhausted before ANY worker finished: park the
+            # shard — its adoption wave fires when the first clean
+            # finisher proves the environment (below). If nothing ever
+            # finishes, the run-exit sweep converts deferred to failed.
+            deferred.add(i)
+            warnings.warn(
+                "factorize worker %d exhausted its respawn budget before "
+                "any worker finished; its adoption wave is deferred "
+                "until the fleet proves the environment" % i,
+                RuntimeWarning)
+        else:
+            failed.add(i)
+            warnings.warn(
+                "factorize worker %d exited with rc=%s; its replicates "
+                "will be skipped at combine (the reference's dead-worker "
+                "tolerance, cnmf.py:904-909)" % (i, rc),
+                RuntimeWarning)
 
     while procs or respawn_at:
         for i in [j for j, t in respawn_at.items() if now() >= t]:
             del respawn_at[i]
             procs[i] = spawn(i, True)
+            started[i] = now()
             deadline[i] = now() + timeout_s if timeout_s > 0 else None
         for i in list(procs):
             p = procs[i]
@@ -130,10 +315,61 @@ def _run_subprocess_workers(
                     p.kill()
                     p.wait()
                     rc = p.returncode
+                elif (straggler_on and baseline_s is not None
+                        and i not in straggled
+                        # never convict without a recovery lever left:
+                        # killing a still-working process that nothing
+                        # can adopt would be strictly worse than letting
+                        # it finish
+                        and (attempts[i] < respawn_limit
+                             or adoptions[i] < 1)
+                        and now() - started[i] > baseline_s + straggler_s
+                        and not _heartbeat_fresh(i, stale_window)):
+                    # straggler deadline: this run has exceeded the
+                    # fleet's observed shard runtime (the longest clean
+                    # finisher's wall) by the grace, with no fresh
+                    # heartbeat vetoing the conviction — contain it
+                    # (kill + adoption resumes its completed cells)
+                    # before it wedges the sweep. Measured from the
+                    # process's OWN spawn, so an adoption redoing a full
+                    # shard gets a full allowance, not an instant kill.
+                    warnings.warn(
+                        "factorize worker %d is a straggler (%.0fs "
+                        "elapsed vs the fleet's %.0fs shard wall + "
+                        "CNMF_TPU_STRAGGLER_S=%gs grace)%s; killing + "
+                        "adopting its shard"
+                        % (i, now() - started[i], baseline_s, straggler_s,
+                           _last_heartbeat(i)),
+                        RuntimeWarning)
+                    _emit("straggler", worker=i, deadline_s=straggler_s,
+                          elapsed_s=round(now() - started[i], 1),
+                          baseline_s=round(baseline_s, 1))
+                    straggled.add(i)
+                    p.kill()
+                    p.wait()
+                    rc = p.returncode
                 else:
                     continue
             del procs[i]
             if rc == 0:
+                finished.add(i)
+                # the LONGEST clean wall so far: heterogeneous shards
+                # (and resumed runs' near-instant complete shards) must
+                # not convict a peer doing a full shard's work
+                baseline_s = max(baseline_s or 0.0, now() - started[i])
+                # the environment just proved itself: fire the deferred
+                # adoption waves of shards that crashed out early
+                for j in sorted(deferred):
+                    adoptions[j] += 1
+                    warnings.warn(
+                        "factorize worker %d's deferred adoption wave "
+                        "fires now (worker %d finished cleanly)"
+                        % (j, i), RuntimeWarning)
+                    _emit("worker_steal", shard=j,
+                          attempt=attempts[j] + adoptions[j],
+                          reason="deferred_until_fleet_proved")
+                    respawn_at[j] = now()
+                deferred.clear()
                 continue
             if rc == UNHEALTHY_EXIT_CODE:
                 # below the min-healthy-frac floor: deterministic — a
@@ -141,24 +377,19 @@ def _run_subprocess_workers(
                 # same way, so don't burn the budget
                 unhealthy.add(i)
                 continue
-            if attempts[i] < respawn_limit:
-                attempts[i] += 1
-                delay = respawn_delay(backoff_s, attempts[i], i)
-                warnings.warn(
-                    "factorize worker %d died (rc=%s); respawning onto its "
-                    "unfinished ledger shard in %.1fs (attempt %d/%d)"
-                    % (i, rc, delay, attempts[i], respawn_limit),
-                    RuntimeWarning)
-                respawn_at[i] = now() + delay
-            else:
-                failed.add(i)
-                warnings.warn(
-                    "factorize worker %d exited with rc=%d; its replicates "
-                    "will be skipped at combine (the reference's dead-worker "
-                    "tolerance, cnmf.py:904-909)" % (i, rc),
-                    RuntimeWarning)
+            _recover(i, rc)
         if procs or respawn_at:
             time.sleep(poll_s)
+    if deferred:
+        # nothing ever finished cleanly — the deferred shards' adoption
+        # never had a healthy fleet to run on; they are failed like the
+        # pre-elastic budget-exhausted case
+        for i in sorted(deferred):
+            failed.add(i)
+            warnings.warn(
+                "factorize worker %d's deferred adoption never ran (no "
+                "worker finished cleanly); its replicates will be "
+                "skipped at combine" % i, RuntimeWarning)
     return failed, unhealthy
 
 
@@ -231,8 +462,17 @@ def run_pipeline(counts: str, output_dir: str, name: str,
 
     any_failed = False
     if engine == "subprocess":
+        # launcher-side telemetry: work-stealing adoptions and straggler
+        # containment append to the SAME per-run events file the workers
+        # write (no-op unless CNMF_TPU_TELEMETRY) — `cnmf-tpu report`
+        # then renders one mesh-elasticity audit trail for the run
+        from .utils.telemetry import EventLog
+
+        events = EventLog(os.path.join(
+            output_dir, name, "cnmf_tmp", f"{name}.events.jsonl"))
         failed, unhealthy = _run_subprocess_workers(
-            output_dir, name, total_workers, factorize_flags, base_env)
+            output_dir, name, total_workers, factorize_flags, base_env,
+            events=events)
         if unhealthy:
             # the min-healthy-frac floor is a hard guarantee end-to-end:
             # degrading around it with skip-missing combine would produce
@@ -290,6 +530,9 @@ def run_pipeline(counts: str, output_dir: str, name: str,
                         # their replicate's artifact lands; a worker that
                         # exhausted its respawn budget can leave one behind
                         os.path.join("cnmf_tmp", "*.ckpt.k_*.npz"),
+                        # liveness stamps (CNMF_TPU_HEARTBEAT_S) are
+                        # meaningful only while their writer is alive
+                        os.path.join("cnmf_tmp", "*.heartbeat.*.json"),
                         # atomic-write temp orphans land wherever their
                         # artifact lives: intermediates in cnmf_tmp/, the
                         # txt/stats finals in the run dir itself

@@ -65,6 +65,21 @@ __all__ = ["cNMF"]
 _DEFAULT_CHUNK_MAX_ITER = 1000
 
 
+def _delete_staged(x):
+    """Free a staged device array (dense ``jax.Array`` or an EllMatrix's
+    four leaves) ahead of a degraded re-mesh: the survivors must not hold
+    the doomed topology's shards while the replacement uploads — at atlas
+    scale that transient doubling is an OOM. Best-effort: a backend that
+    cannot delete just garbage-collects later."""
+    leaves = ((x.vals, x.cols, x.rows_t, x.perm_t) if hasattr(x, "vals")
+              else (x,))
+    for leaf in leaves:
+        try:
+            leaf.delete()
+        except Exception:
+            pass
+
+
 def compute_tpm(input_counts: AnnDataLite, totals=None) -> AnnDataLite:
     """Per-cell scaling to 1e6 total counts (``cnmf.py:241-247``);
     ``totals`` threads precomputed row sums through (one matrix pass)."""
@@ -606,6 +621,18 @@ class cNMF:
             events=self._events,
             ledger_path=self.paths["resilience_ledger"] % int(worker_i))
 
+        # liveness (ISSUE 8): every factorize path stamps progress under
+        # CNMF_TPU_HEARTBEAT_S so the launcher's straggler containment
+        # (and a pod's barrier diagnosis) can tell "slow but working"
+        # from "wedged" — the rowshard path additionally beats per pass
+        from ..runtime import elastic as _elastic
+
+        heartbeat = None
+        if _elastic.heartbeat_s() > 0:
+            heartbeat = _elastic.Heartbeat(
+                os.path.dirname(self.paths["resilience_ledger"]),
+                self.name, int(worker_i), events=self._events)
+
         def _credit_completed(final_jobs):
             # resume accounting: replicates already valid on disk count as
             # healthy toward the per-K min-healthy-frac floor — without
@@ -663,7 +690,8 @@ class cNMF:
             self._factorize_rowsharded(jobs, run_params, norm_counts,
                                        _nmf_kwargs, mesh, worker_i,
                                        guard=guard,
-                                       resume=skip_completed_runs)
+                                       resume=skip_completed_runs,
+                                       heartbeat=heartbeat)
             return
 
         if not batched:
@@ -682,6 +710,9 @@ class cNMF:
             for idx in jobs:
                 p = run_params.iloc[idx, :]
                 print("[Worker %d]. Starting task %d." % (worker_i, idx))
+                if heartbeat is not None:
+                    heartbeat.beat(phase="task", cursor=idx)
+                faults.maybe_straggle(context="factorize", worker=worker_i)
                 k_t, it_t = int(p["n_components"]), int(p["iter"])
                 spectra, err = _solve_seq(k_t, p["nmf_seed"])
                 sp3, errs = faults.maybe_poison_lanes(
@@ -927,6 +958,8 @@ class cNMF:
                     # transient files combine deletes under --clean
                     self._write_iter_spectra(_k, it, spectra[j][:_k],
                                              norm_counts.var.index)
+                if heartbeat is not None:
+                    heartbeat.beat(phase="slice", cursor=task_idx[0])
                 faults.maybe_kill("factorize", worker_i)
 
             replicate_sweep_packed(
@@ -1018,6 +1051,9 @@ class cNMF:
             seeds = [t[1] for t in tasks]
             print("[Worker %d]. Running %d replicates for k=%d as one "
                   "batched program." % (worker_i, len(tasks), k))
+            if heartbeat is not None:
+                heartbeat.beat(phase="sweep", cursor=k)
+            faults.maybe_straggle(context="factorize", worker=worker_i)
             spectra_d, _, errs_d = replicate_sweep(
                 X, seeds, k,
                 beta_loss=_nmf_kwargs["beta_loss"],
@@ -1136,7 +1172,7 @@ class cNMF:
 
     def _factorize_rowsharded(self, jobs, run_params, norm_counts,
                               nmf_kwargs, mesh, worker_i, guard=None,
-                              resume=False):
+                              resume=False, heartbeat=None):
         """Atlas-scale factorize: cells sharded over the mesh, replicates
         sequential. X streams host→HBM once (shard-sized CSR blocks, no host
         dense copy) and is reused by every replicate; padded rows contribute
@@ -1165,31 +1201,63 @@ class cNMF:
         from ..parallel.streaming import (ShardStallError, ShardUploadError,
                                           StreamStats)
         from ..runtime import checkpoint as ckpt_mod
-        from ..runtime import faults, resilience
+        from ..runtime import elastic, faults, resilience
 
         if guard is None:
             guard = resilience.ReplicateGuard(
                 events=self._events,
                 ledger_path=self.paths["resilience_ledger"] % int(worker_i))
 
-        stage_stats = StreamStats() if self._events.enabled else None
-        try:
-            Xd, n_orig = prepare_rowsharded(norm_counts.X, mesh,
-                                            stats=stage_stats,
-                                            events=self._events)
-        except (ShardUploadError, ShardStallError) as exc:
-            # exhausted/stalled shards land in the PR-4 ledger before the
-            # abort: the staged array cannot be completed, so there is no
-            # degraded mode here — but the audit trail (and the launcher's
-            # respawn, which re-stages) must see WHY the worker died
-            guard.record_shard_fault(
-                "shard_stall" if isinstance(exc, ShardStallError)
-                else "shard_upload_failed",
-                {"stage": "rowshard_stage_x", "error": str(exc)})
-            guard.finalize()
-            raise
-        if stage_stats is not None:
-            self._events.emit_stream("rowshard_stage_x", stage_stats)
+        # liveness (ISSUE 8): under CNMF_TPU_HEARTBEAT_S this worker
+        # stamps an atomic heartbeat (pass cursor included) at staging
+        # and pass boundaries; the launcher's straggler containment and
+        # barrier diagnoses read it back to name the culprit. Reuses the
+        # caller's heartbeat when factorize() built one already.
+        if heartbeat is None and elastic.heartbeat_s() > 0:
+            heartbeat = elastic.Heartbeat(
+                os.path.dirname(self.paths["resilience_ledger"]),
+                self.name, int(worker_i), events=self._events)
+        if heartbeat is not None:
+            heartbeat.beat(phase="stage_x", force=True)
+        import jax
+
+        # in-process re-mesh is a single-controller recovery: on a
+        # multi-host pod the surviving processes' collectives still span
+        # the dead host (same constraint as the 2-D path), so the loss
+        # propagates as the pre-elastic clean abort and the relaunch
+        # minus the dead host resumes from checkpoints
+        elastic_on = (elastic.elastic_enabled()
+                      and jax.process_count() == 1)
+
+        def _stage(mesh_):
+            """Stage (or re-stage, after a degraded re-mesh) X onto
+            ``mesh_`` through the streaming engine."""
+            stage_stats = StreamStats() if self._events.enabled else None
+            try:
+                Xd_, n_orig_ = prepare_rowsharded(norm_counts.X, mesh_,
+                                                  stats=stage_stats,
+                                                  events=self._events,
+                                                  liveness=heartbeat)
+            except (ShardUploadError, ShardStallError) as exc:
+                # exhausted/stalled shards land in the PR-4 ledger before
+                # the abort: the staged array cannot be completed, so
+                # there is no degraded mode here — but the audit trail
+                # (and the launcher's respawn, which re-stages) must see
+                # WHY the worker died
+                guard.record_shard_fault(
+                    "shard_stall" if isinstance(exc, ShardStallError)
+                    else "shard_upload_failed",
+                    {"stage": "rowshard_stage_x", "error": str(exc)})
+                guard.finalize()
+                raise
+            if stage_stats is not None:
+                self._events.emit_stream("rowshard_stage_x", stage_stats)
+            return Xd_, n_orig_
+
+        Xd, n_orig = _stage(mesh)
+        # mesh/Xd live in a mutable cell: a degraded re-mesh mid-sweep
+        # swaps both, and every later solve reads the current topology
+        topo = {"mesh": mesh, "Xd": Xd}
         _, n_passes_eff, _ = resolve_online_schedule(
             beta_loss_to_float(nmf_kwargs["beta_loss"]), 0.05,
             nmf_kwargs.get("n_passes"))
@@ -1233,21 +1301,25 @@ class cNMF:
             "l1_ratio_H": float(nmf_kwargs.get("l1_ratio_H", 0.0)),
         }.items()))
 
-        def _make_ckpt(k_c, it_c, seed_c, attempt=0):
+        def _make_ckpt(k_c, it_c, seed_c, attempt=0, force_resume=False):
             """Checkpoint policy for one (k, iter) solve. Retry attempts
             (``attempt >= 1``) checkpoint too — exactly the lanes that
             just burned a multi-hour solve — under an attempt-suffixed
             path with the DERIVED seed in the identity, and always load
             with ``resume=True``: the retry ladder is deterministic
             (identical derived seeds on relaunch), so a matching
-            checkpoint can only be this retry's own interrupted state."""
+            checkpoint can only be this retry's own interrupted state.
+            ``force_resume`` (elastic continuation after a host loss):
+            load even on a fresh run — the checkpoint just written by
+            THIS session's interrupted solve is the state to continue
+            from, not stale history."""
             if ckpt_every <= 0:
                 return None
             path = self.paths["pass_checkpoint"] % (int(k_c), int(it_c))
             if int(attempt) > 0:
                 assert path.endswith(".npz")
                 path = path[:-4] + ".a%d.npz" % int(attempt)
-            elif not resume:
+            elif not resume and not force_resume:
                 # fresh runs void prior retry cursors along with the
                 # base one (PassCheckpointer only discards its own path)
                 import glob as _glob
@@ -1263,11 +1335,12 @@ class cNMF:
                       "attempt": int(attempt), "digest": digest,
                       "beta": float(beta_val), "params": params_sig},
                 events=self._events, worker=worker_i,
-                resume=(resume if int(attempt) == 0 else True))
+                resume=(bool(resume or force_resume) if int(attempt) == 0
+                        else True))
 
         def _solve_rowshard(k_r, seed_r, ckpt=None):
             _H, spectra, err = nmf_fit_rowsharded(
-                Xd, int(k_r), mesh,
+                topo["Xd"], int(k_r), topo["mesh"],
                 beta_loss=nmf_kwargs["beta_loss"],
                 init=nmf_kwargs.get("init", "random"),
                 seed=int(seed_r),
@@ -1280,14 +1353,68 @@ class cNMF:
                 l1_ratio_H=nmf_kwargs.get("l1_ratio_H", 0.0),
                 n_orig=n_orig,
                 telemetry_sink=self._emit_replicates_event,
-                checkpoint=ckpt)
+                checkpoint=ckpt, heartbeat=heartbeat)
             return np.asarray(spectra), err
+
+        def _remesh_after_loss(exc):
+            """Degraded re-mesh (ISSUE 8): re-plan the cells mesh over
+            the surviving devices, free the doomed staged array, and
+            re-stage X from the original input through the streaming
+            engine. Raises ``DegradedMeshError`` (chained to the loss)
+            when fewer than CNMF_TPU_MIN_DEVICES devices survive."""
+            lost = elastic.resolve_lost_devices(exc, topo["mesh"])
+            old_n = int(np.prod(topo["mesh"].devices.shape))
+            guard.record_shard_fault(
+                "host_loss",
+                {"context": "rowshard",
+                 "lost_devices": [int(d.id) for d in lost],
+                 "error": str(exc)})
+            new_mesh = elastic.plan_degraded_mesh(topo["mesh"], lost)
+            warnings.warn(
+                "host/device loss mid-factorize (%s); continuing "
+                "degraded on %d of %d devices — in-flight replicates "
+                "resume from their pass checkpoints"
+                % (exc, int(np.prod(new_mesh.devices.shape)), old_n),
+                RuntimeWarning, stacklevel=2)
+            _delete_staged(topo["Xd"])
+            topo["mesh"] = new_mesh
+            topo["Xd"], _ = _stage(new_mesh)
+            self._events.emit(
+                "fault", kind="remesh",
+                context={"context": "rowshard", "from_devices": old_n,
+                         "to_devices": int(np.prod(new_mesh.devices.shape))})
+
+        def _solve_elastic(k_r, it_r, seed_r, attempt=0):
+            """One replicate solve that survives topology loss: on a
+            detected host/device loss the mesh shrinks to the survivors,
+            X re-stages, and the solve re-enters with ``resume=True`` so
+            the just-written pass checkpoint continues mid-run (bit-exact
+            state; a loss at the post-checkpoint replicate boundary
+            completes bit-identically, a mid-pass loss finishes its
+            remaining passes on the shrunk mesh within solver
+            tolerance)."""
+            force_resume = False
+            while True:
+                ckpt = _make_ckpt(k_r, it_r, seed_r, attempt=attempt,
+                                  force_resume=force_resume)
+                try:
+                    spectra, err = _solve_rowshard(k_r, seed_r, ckpt=ckpt)
+                    # injectable loss at the replicate boundary — after
+                    # the final checkpoint, before the artifact write
+                    faults.maybe_hostloss(context="replicate",
+                                          worker=worker_i)
+                    return spectra, err, ckpt
+                except BaseException as exc:
+                    if not (elastic_on and elastic.is_device_loss(exc)):
+                        raise
+                    _remesh_after_loss(exc)  # DegradedMeshError aborts
+                    force_resume = True
 
         for idx in jobs:
             p = run_params.iloc[idx, :]
             k, it = int(p["n_components"]), int(p["iter"])
-            ckpt = _make_ckpt(k, it, p["nmf_seed"])
-            spectra, err = _solve_rowshard(k, p["nmf_seed"], ckpt=ckpt)
+            faults.maybe_straggle(context="factorize", worker=worker_i)
+            spectra, err, ckpt = _solve_elastic(k, it, p["nmf_seed"])
             sp3, errs = faults.maybe_poison_lanes(
                 k, [it], spectra[None], np.asarray([err]),
                 seeds=[int(p["nmf_seed"])])
@@ -1306,15 +1433,16 @@ class cNMF:
                 ckpt.discard()
             faults.maybe_kill("factorize", worker_i)
 
-        def rerun_rowshard(k_r, seeds_r, iters=None, attempt=0):
+        def rerun_rowshard(k_r, seeds_r, iters, attempt=0):
             # retries checkpoint too (review finding): these are exactly
             # the multi-hour replicates that just failed once — a
-            # preemption mid-retry must not also lose the retry's passes
+            # preemption mid-retry must not also lose the retry's passes,
+            # and a host loss mid-retry re-meshes like the main loop
             outs = []
             for j, s in enumerate(seeds_r):
-                ckpt = (None if iters is None else
-                        _make_ckpt(k_r, iters[j], s, attempt=attempt))
-                outs.append(_solve_rowshard(k_r, s, ckpt=ckpt))
+                spectra, err, ckpt = _solve_elastic(k_r, iters[j], s,
+                                                    attempt=attempt)
+                outs.append((spectra, err))
                 if ckpt is not None:
                     ckpt.discard()
             return (np.stack([o[0] for o in outs]),
@@ -1337,8 +1465,21 @@ class cNMF:
 
         from ..parallel import is_coordinator, sync_hosts
         from ..parallel.multihost import replicate_sweep_2d, stage_x_2d
+        from ..runtime import elastic
 
-        Xd = stage_x_2d(norm_counts.X, mesh, events=self._events)
+        # liveness (ISSUE 8): every mesh participant stamps a heartbeat
+        # at stage/sweep boundaries; a barrier a dead host can never join
+        # then raises a HostBarrierTimeout that NAMES the silent process
+        heartbeat = None
+        if elastic.heartbeat_s() > 0:
+            heartbeat = elastic.Heartbeat(
+                os.path.dirname(self.paths["resilience_ledger"]),
+                self.name, int(jax.process_index()), events=self._events)
+            heartbeat.beat(phase="stage_x_2d", force=True)
+        elastic_on = elastic.elastic_enabled()
+
+        Xd = stage_x_2d(norm_counts.X, mesh, events=self._events,
+                        liveness=heartbeat)
         _, n_passes_eff, _ = resolve_online_schedule(
             beta_loss_to_float(nmf_kwargs["beta_loss"]), 0.05,
             nmf_kwargs.get("n_passes"))
@@ -1374,18 +1515,64 @@ class cNMF:
         for k, tasks in sorted(by_k.items()):
             iters = [t[0] for t in tasks]
             seeds = [t[1] for t in tasks]
-            spectra, _errs = replicate_sweep_2d(
-                Xd, seeds, k, mesh,
-                beta_loss=nmf_kwargs["beta_loss"],
-                init=nmf_kwargs.get("init", "random"),
-                tol=nmf_kwargs.get("tol", 1e-4),
-                n_passes=n_passes_eff,
-                chunk_max_iter=nmf_kwargs.get("online_chunk_max_iter", _DEFAULT_CHUNK_MAX_ITER),
-                alpha_W=nmf_kwargs.get("alpha_W", 0.0),
-                l1_ratio_W=nmf_kwargs.get("l1_ratio_W", 0.0),
-                alpha_H=nmf_kwargs.get("alpha_H", 0.0),
-                l1_ratio_H=nmf_kwargs.get("l1_ratio_H", 0.0),
-                replicates_per_batch=replicates_per_batch)
+            if heartbeat is not None:
+                heartbeat.beat(phase="sweep2d", cursor=k, force=True)
+            while True:
+                try:
+                    spectra, _errs = replicate_sweep_2d(
+                        Xd, seeds, k, mesh,
+                        beta_loss=nmf_kwargs["beta_loss"],
+                        init=nmf_kwargs.get("init", "random"),
+                        tol=nmf_kwargs.get("tol", 1e-4),
+                        n_passes=n_passes_eff,
+                        chunk_max_iter=nmf_kwargs.get("online_chunk_max_iter", _DEFAULT_CHUNK_MAX_ITER),
+                        alpha_W=nmf_kwargs.get("alpha_W", 0.0),
+                        l1_ratio_W=nmf_kwargs.get("l1_ratio_W", 0.0),
+                        alpha_H=nmf_kwargs.get("alpha_H", 0.0),
+                        l1_ratio_H=nmf_kwargs.get("l1_ratio_H", 0.0),
+                        replicates_per_batch=replicates_per_batch)
+                    break
+                except BaseException as exc:
+                    # degraded re-mesh (ISSUE 8), single-controller form:
+                    # a lost device shrinks the (replicates x cells) mesh
+                    # over the survivors (_balanced_rc re-plans the same
+                    # way the original mesh was planned), X re-stages,
+                    # and the K's sweep reruns whole — the 2-D path has
+                    # no per-pass checkpoints, so its recovery unit is
+                    # the sweep, its parity solver-tolerance. Multi-host
+                    # pods cannot shrink in-process (the surviving
+                    # processes' collectives still span the dead host):
+                    # there the loss propagates as a clean abort and the
+                    # operator relaunches minus the dead host.
+                    if not (elastic_on and jax.process_count() == 1
+                            and elastic.is_device_loss(exc)):
+                        raise
+                    lost = elastic.resolve_lost_devices(exc, mesh)
+                    old_n = int(np.prod(mesh.devices.shape))
+                    self._events.emit(
+                        "fault", kind="host_loss",
+                        context={"context": "sweep2d",
+                                 "lost_devices": [int(d.id) for d in lost],
+                                 "error": str(exc)})
+                    mesh = elastic.plan_degraded_mesh(mesh, lost)
+                    r_dim, c_dim = mesh.devices.shape
+                    warnings.warn(
+                        "host/device loss mid-sweep (%s); re-planned a "
+                        "%d x %d mesh over %d of %d devices and rerunning "
+                        "k=%d" % (exc, r_dim, c_dim,
+                                  int(np.prod(mesh.devices.shape)), old_n,
+                                  k),
+                        RuntimeWarning, stacklevel=2)
+                    _delete_staged(Xd)
+                    Xd = stage_x_2d(norm_counts.X, mesh,
+                                    events=self._events,
+                                    liveness=heartbeat)
+                    self._events.emit(
+                        "fault", kind="remesh",
+                        context={"context": "sweep2d",
+                                 "from_devices": old_n,
+                                 "to_devices":
+                                     int(np.prod(mesh.devices.shape))})
             if is_coordinator():
                 for r, it in enumerate(iters):
                     df = pd.DataFrame(spectra[r],
@@ -1393,7 +1580,7 @@ class cNMF:
                                       columns=norm_counts.var.index)
                     save_df_to_npz(df, self.paths["iter_spectra"] % (k, it),
                                    compress=False)
-        sync_hosts("factorize_2d")
+        sync_hosts("factorize_2d", heartbeat=heartbeat)
 
     # ------------------------------------------------------------------
     # combine

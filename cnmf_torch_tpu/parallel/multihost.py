@@ -77,7 +77,17 @@ class HostBarrierTimeout(RuntimeError):
     single-controller program cannot make progress without it, so this
     converts the distributed hang into a clean abort: relaunch the SAME
     command on every host and factorize resumes from its per-replicate
-    artifacts and the newest valid pass checkpoint."""
+    artifacts and the newest valid pass checkpoint.
+
+    Under ``CNMF_TPU_HEARTBEAT_S`` liveness (``runtime/elastic.py``) the
+    timeout is additionally DIAGNOSED: ``culprits`` names the peers whose
+    heartbeats went stale or were never stamped (index, last-beat age,
+    pass cursor), so the operator relaunches minus the right host instead
+    of bisecting a generic timeout."""
+
+    def __init__(self, message: str, culprits: list | None = None):
+        super().__init__(message)
+        self.culprits = list(culprits or [])
 
 
 def barrier_timeout_s() -> float:
@@ -90,18 +100,31 @@ def barrier_timeout_s() -> float:
     return env_float(BARRIER_TIMEOUT_ENV, 0.0, lo=0.0)
 
 
+import threading
+
+# one abandonment log line per barrier name per process: the watchdog may
+# fire on the same wedged barrier repeatedly across retries, and a log
+# storm would bury the diagnosis it exists to provide
+_abandoned_lock = threading.Lock()
+_abandoned_names: set[str] = set()
+
+
 def _wait_with_timeout(fn, timeout_s: float, name: str):
     """Run a (blocking, uninterruptible) collective with a wall-clock
     watchdog: the collective runs on a daemon thread and the caller waits
-    ``timeout_s`` for it. On expiry the thread is abandoned (a wedged
-    collective cannot be cancelled, only diagnosed) and
-    :class:`HostBarrierTimeout` raises so the process exits cleanly
-    instead of hanging the whole mesh forever. ``timeout_s <= 0`` runs
-    inline, unchanged."""
+    ``timeout_s`` for it.
+
+    No-zombie-thread invariant (aligned with the streaming watchdog,
+    ``parallel/streaming.py:run_pipeline``): only a GENUINE wedge — the
+    collective still running at expiry — abandons the thread (a wedged
+    collective cannot be cancelled, only diagnosed), and that abandonment
+    is logged once per barrier name. Every other path — completion,
+    collective raised its own error — joins the thread before returning,
+    so no barrier thread outlives a successful or failed barrier call.
+    ``timeout_s <= 0`` runs inline, unchanged."""
     if not timeout_s or timeout_s <= 0:
         fn()
         return
-    import threading
 
     done = threading.Event()
     errs: list[BaseException] = []
@@ -118,11 +141,25 @@ def _wait_with_timeout(fn, timeout_s: float, name: str):
                          daemon=True)
     t.start()
     if not done.wait(timeout_s):
+        with _abandoned_lock:
+            first = name not in _abandoned_names
+            _abandoned_names.add(name)
+        if first:
+            import warnings
+
+            warnings.warn(
+                "abandoning wedged barrier thread %r after %gs — a hung "
+                "collective cannot be cancelled, only diagnosed; it exits "
+                "with the process" % (f"cnmf-barrier-{name}", timeout_s),
+                RuntimeWarning, stacklevel=2)
         raise HostBarrierTimeout(
             "barrier %r did not complete within %gs (%s) — a peer host is "
             "likely dead. Aborting with state checkpointed; relaunch the "
             "same command on every host to resume from the newest valid "
             "checkpoint." % (name, timeout_s, BARRIER_TIMEOUT_ENV))
+    # the collective finished (ok or raising): the thread is past fn() and
+    # about to exit — join it so no barrier thread outlives its call
+    t.join()
     if errs:
         raise errs[0]
 
@@ -218,7 +255,8 @@ def is_coordinator() -> bool:
     return jax.process_index() == 0
 
 
-def sync_hosts(name: str = "cnmf", timeout_s: float | None = None) -> None:
+def sync_hosts(name: str = "cnmf", timeout_s: float | None = None,
+               heartbeat=None) -> None:
     """Barrier across hosts (no-op single-process). Used around artifact
     writes so non-coordinator hosts don't race ahead and read files the
     coordinator hasn't written yet — the same write-then-read discipline the
@@ -227,13 +265,38 @@ def sync_hosts(name: str = "cnmf", timeout_s: float | None = None) -> None:
     Bounded (ISSUE 6): under ``CNMF_TPU_BARRIER_TIMEOUT_S`` (or an
     explicit ``timeout_s``) a barrier a dead host can never join raises
     :class:`HostBarrierTimeout` — a clean, checkpoint-resumable abort —
-    instead of wedging every surviving host forever."""
+    instead of wedging every surviving host forever.
+
+    Named culprits (ISSUE 8): pass a
+    :class:`~cnmf_torch_tpu.runtime.elastic.Heartbeat` and this process
+    stamps its own liveness before waiting; on timeout the peers' stale
+    or missing heartbeats are read back and the raised
+    :class:`HostBarrierTimeout` NAMES the dead/wedged participant(s)
+    (``.culprits``) — plus a telemetry ``fault`` event (kind
+    ``host_loss``) when the heartbeat carries an event log — instead of
+    a generic barrier timeout."""
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
+        if heartbeat is not None:
+            heartbeat.beat(phase=f"barrier:{name}", force=True)
         timeout = barrier_timeout_s() if timeout_s is None else timeout_s
-        _wait_with_timeout(
-            lambda: multihost_utils.sync_global_devices(name), timeout, name)
+        try:
+            _wait_with_timeout(
+                lambda: multihost_utils.sync_global_devices(name), timeout,
+                name)
+        except HostBarrierTimeout as exc:
+            if heartbeat is None or not heartbeat.enabled:
+                raise
+            culprits = heartbeat.culprits(jax.process_count())
+            detail = heartbeat.describe(culprits)
+            if heartbeat.events is not None:
+                heartbeat.events.emit(
+                    "fault", kind="host_loss",
+                    context={"barrier": name, "culprits": culprits})
+            raise HostBarrierTimeout(
+                f"{exc} Liveness diagnosis: {detail}.",
+                culprits=culprits) from None
 
 
 def _balanced_rc(n_dev: int, n_proc: int) -> tuple[int, int]:
@@ -397,6 +460,8 @@ def replicate_sweep_2d(X, seeds, k: int, mesh: Mesh, beta_loss="frobenius",
     _, slices = _slice_specs(n_local, g, int(k), R, beta, "batch", n_local,
                              replicates_per_batch, r_dim)
 
+    from ..runtime.faults import maybe_hostloss
+
     # every slice stays PADDED on device: trimming (w[:r]) or concatenating
     # sharded arrays eagerly would cut across shard boundaries of
     # non-fully-addressable arrays on a real multi-host pod — gather first,
@@ -404,6 +469,10 @@ def replicate_sweep_2d(X, seeds, k: int, mesh: Mesh, beta_loss="frobenius",
     # same order is merely free there)
     parts = []
     for start, r, r_pad in slices:
+        # injectable topology loss at the slice boundary (hostloss:
+        # context=sweep2d) — where a real dead device would surface as
+        # the next dispatch failing; the elastic controller re-meshes
+        maybe_hostloss(context="sweep2d")
         sl = seeds[start:start + r]
         if r_pad > r:
             sl = sl + [sl[i % r] for i in range(r_pad - r)]
@@ -439,10 +508,13 @@ def replicate_sweep_2d(X, seeds, k: int, mesh: Mesh, beta_loss="frobenius",
     return spectra, errs
 
 
-def stage_x_2d(X, mesh: Mesh, dtype=jnp.float32, events=None):
+def stage_x_2d(X, mesh: Mesh, dtype=jnp.float32, events=None,
+               liveness=None):
     """Stage a host matrix for repeated 2-D sweeps: rows sharded over the
     cells axis, replicated over the replicate axis; one shard-sized CSR
-    block densifies at a time (no whole-matrix host densify)."""
+    block densifies at a time (no whole-matrix host densify).
+    ``liveness`` is stamped per committed slab (heartbeat — a long stage
+    must not read as a wedge at the next barrier)."""
     Xd, _pad = stream_rows_to_mesh(X, mesh, mesh.axis_names[1], dtype=dtype,
-                                   events=events)
+                                   events=events, liveness=liveness)
     return Xd

@@ -81,7 +81,8 @@ def pad_rows_to_mesh(X, multiple: int):
 
 def stream_rows_to_mesh(X, mesh: Mesh, axis: str, dtype=jnp.float32,
                         pad_multiple: int | None = None,
-                        stats: StreamStats | None = None, events=None):
+                        stats: StreamStats | None = None, events=None,
+                        liveness=None):
     """Out-of-core host→HBM transfer: build the row-sharded device array
     straight from a host CSR (or dense) matrix. Sparse inputs densify
     slab-by-slab (on device via ``streaming._csr_densify``, or on host per
@@ -115,14 +116,17 @@ def stream_rows_to_mesh(X, mesh: Mesh, axis: str, dtype=jnp.float32,
     sharding = NamedSharding(mesh, P(axis, None))
     if sp.issparse(X):
         return _stream_csr_sharded(X.tocsr(), sharding, dtype,
-                                   stats=stats, events=events), pad
+                                   stats=stats, events=events,
+                                   liveness=liveness), pad
     return _stream_dense_sharded(np.asarray(X), sharding, dtype,
-                                 stats=stats, events=events), pad
+                                 stats=stats, events=events,
+                                 liveness=liveness), pad
 
 
 def stream_ell_to_mesh(X, mesh: Mesh, axis: str, width: int | None = None,
                        pad_multiple: int | None = None,
-                       stats: StreamStats | None = None, events=None):
+                       stats: StreamStats | None = None, events=None,
+                       liveness=None):
     """Row-shard a host CSR matrix as fixed-width ELL — the beta != 2
     sparse staging path. The CSR buffers are already what crosses the wire
     on this path (``_stream_csr_sharded``); instead of densifying into an
@@ -207,7 +211,8 @@ def stream_ell_to_mesh(X, mesh: Mesh, axis: str, width: int | None = None,
 
     t_wall = time.perf_counter()
     run_pipeline(devs, prep, commit, depth=ell_depth, threads=ell_threads,
-                 fault_context="stream_ell", events=events)
+                 fault_context="stream_ell", events=events,
+                 liveness=liveness)
 
     def assemble(shape, leaf_i, leaf_shard):
         arrs = [leaf_arrs[dev][leaf_i] for dev in devs]
@@ -229,13 +234,15 @@ def stream_ell_to_mesh(X, mesh: Mesh, axis: str, width: int | None = None,
 
 
 def prepare_rowsharded(X, mesh: Mesh, stats: StreamStats | None = None,
-                       events=None):
+                       events=None, liveness=None):
     """Stage a counts matrix for repeated row-sharded solves (one transfer,
     many replicates). Returns ``(X_device, n_orig)`` to pass to
-    :func:`nmf_fit_rowsharded` / :func:`fit_h_rowsharded`."""
+    :func:`nmf_fit_rowsharded` / :func:`fit_h_rowsharded`. ``liveness``
+    (a ``runtime.elastic.Heartbeat``) is stamped per committed slab so a
+    multi-minute atlas stage stays diagnosably alive."""
     n_orig = int(X.shape[0])
     Xd, _ = stream_rows_to_mesh(X, mesh, mesh.axis_names[0], stats=stats,
-                                events=events)
+                                events=events, liveness=liveness)
     return Xd, n_orig
 
 
@@ -386,7 +393,8 @@ def _rowshard_pass_jit(X, H, W, mesh, axis, beta, h_tol, chunk_max_iter,
 
 def _fit_rowsharded_checkpointed(Xd, H0, W0, mesh, axis, beta, tol, h_tol,
                                  n_passes, chunk_max_iter,
-                                 l1_H, l2_H, l1_W, l2_W, ckpt):
+                                 l1_H, l2_H, l1_W, l2_W, ckpt,
+                                 heartbeat=None, n_orig=None):
     """Host-driven pass loop with mid-run checkpoints — the checkpointed
     twin of :func:`_fit_rowsharded_jit`'s fused while_loop (same per-pass
     program, same f32 convergence test, same stopping rule; the loop
@@ -400,8 +408,20 @@ def _fit_rowsharded_checkpointed(Xd, H0, W0, mesh, axis, beta, tol, h_tol,
     re-derives from the restored W (one tightly solved block-coordinate
     pass — the sufficient-statistics trade, runtime/checkpoint.py).
 
+    Liveness + elasticity (ISSUE 8): ``heartbeat`` (a
+    ``runtime.elastic.Heartbeat``) is stamped at every pass boundary
+    with the pass cursor, so a wedged or dead participant is diagnosable
+    by name; the per-pass ``hostloss`` chaos hook fires here too — the
+    boundary where a real dead device surfaces as the next dispatch
+    failing — and the raised loss propagates to the elastic controller
+    in ``models/cnmf.py``, which re-meshes over the survivors and
+    re-enters this loop with ``resume=True`` (checkpointed state
+    restores bit-exactly; remaining passes run on the shrunk mesh).
+
     Returns ``(H, W, err, trace (TRACE_LEN,) np, passes, nonfinite)``.
     """
+    from ..runtime.faults import maybe_hostloss
+
     row_sh = NamedSharding(mesh, P(axis, None))
     rep_sh = NamedSharding(mesh, P())
     k, g = int(W0.shape[0]), int(W0.shape[1])
@@ -418,11 +438,26 @@ def _fit_rowsharded_checkpointed(Xd, H0, W0, mesh, axis, beta, tol, h_tol,
     A = B = None
     ran_pass = False
 
-    state = ckpt.load(n_rows=n_pad, n_genes=g)
+    # H row validation: exact pad match on a stable mesh; with n_orig
+    # known, a floor instead — an elastic continuation resumes a
+    # checkpoint whose H was zero-padded for the ORIGINAL (larger) mesh,
+    # and the zero tail re-fits the shrunk mesh's padding below
+    state = (ckpt.load(n_rows_min=int(n_orig), n_genes=g)
+             if n_orig is not None else ckpt.load(n_rows=n_pad, n_genes=g))
     if state is not None:
         W = jax.device_put(jnp.asarray(state["W"]), rep_sh)
-        H = (jax.device_put(jnp.asarray(state["H"]), row_sh)
-             if state["H"] is not None else H0)
+        if state["H"] is not None:
+            h_np = np.asarray(state["H"], np.float32)
+            if h_np.shape[0] > n_pad:
+                # rows past this mesh's padding are the writing mesh's
+                # padding rows — exactly zero (a zero X row collapses
+                # its usage row in one multiplicative step)
+                h_np = h_np[:n_pad]
+            elif h_np.shape[0] < n_pad:
+                h_np = np.pad(h_np, ((0, n_pad - h_np.shape[0]), (0, 0)))
+            H = jax.device_put(jnp.asarray(h_np), row_sh)
+        else:
+            H = H0
         resumed_without_h = state["H"] is None
         it = int(state["pass_idx"])
         err_prev, err = f32(state["err_prev"]), f32(state["err"])
@@ -453,8 +488,18 @@ def _fit_rowsharded_checkpointed(Xd, H0, W0, mesh, axis, beta, tol, h_tol,
                      else np.zeros((k, k), np.float32)),
                   H=h_np)
 
+    def _pass_boundary():
+        # liveness stamp + injectable topology loss, AFTER any checkpoint
+        # write for this pass landed: an injected (or real) loss here
+        # leaves exactly the on-disk state a preempted host leaves, and
+        # the resumed continuation picks up from this pass's cursor
+        if heartbeat is not None:
+            heartbeat.beat(phase="pass", cursor=it)
+        maybe_hostloss(context="pass")
+
     if ran_pass and ckpt.every and it % ckpt.every == 0 and ckpt.due():
         _save()
+    _pass_boundary()
 
     def active() -> bool:
         # the fused loop's cond, in the same f32 arithmetic
@@ -473,6 +518,7 @@ def _fit_rowsharded_checkpointed(Xd, H0, W0, mesh, axis, beta, tol, h_tol,
         trace[min(it - 1, TRACE_LEN - 1)] = err
         if ckpt.every and it % ckpt.every == 0 and ckpt.due():
             _save()
+        _pass_boundary()
 
     if resumed_without_h and not ran_pass:
         # already-converged checkpoint without H: the spectra (W) are
@@ -526,7 +572,8 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
                        alpha_W: float = 0.0, l1_ratio_W: float = 0.0,
                        alpha_H: float = 0.0, l1_ratio_H: float = 0.0,
                        n_orig: int | None = None, init: str = "random",
-                       telemetry_sink=None, checkpoint=None):
+                       telemetry_sink=None, checkpoint=None,
+                       heartbeat=None):
     """Factorize a cells-sharded X over ``mesh`` (1-D). Returns
     ``(H (n,k), W (k,g), err)`` as numpy arrays.
 
@@ -542,6 +589,11 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
     ``checkpoint.every`` passes and a valid checkpoint resumes mid-run.
     ``None`` (or ``every <= 0``) keeps the fused single-dispatch
     while_loop program, byte-identical to the pre-checkpoint build.
+
+    ``heartbeat``: optional ``runtime.elastic.Heartbeat`` stamped with
+    the pass cursor at every pass boundary of the checkpointed loop —
+    pass-granular liveness for the elastic layer (the fused program is
+    a single dispatch, so it cannot beat mid-run).
 
     ``X`` may be a host matrix (dense or CSR — streamed shard-by-shard to
     HBM without a host dense copy) or a device array already staged by
@@ -622,7 +674,7 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
         H, W, err, trace_np, passes, nonfin = _fit_rowsharded_checkpointed(
             Xd, H0, W0, mesh, axis, beta, float(tol), float(h_tol),
             int(n_passes), int(chunk_max_iter), l1_H, l2_H, l1_W, l2_W,
-            checkpoint)
+            checkpoint, heartbeat=heartbeat, n_orig=n_orig)
         if want_telem:
             telemetry_sink({
                 "k": int(k), "beta": float(beta), "mode": "rowshard",

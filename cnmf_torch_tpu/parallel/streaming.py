@@ -372,7 +372,7 @@ def _retrying(prep, context: str | None, events, heartbeat: dict | None = None):
 
 def run_pipeline(tasks, prep, commit, *, depth: int | None = None,
                  threads: int | None = None, fault_context: str | None = None,
-                 events=None):
+                 events=None, liveness=None):
     """Sliding-window pipeline: ``prep(task)`` on worker threads, with at
     most ``depth`` tasks prepared-but-uncommitted; ``commit(task,
     payload)`` on the caller thread in exact submission order (donated
@@ -389,7 +389,12 @@ def run_pipeline(tasks, prep, commit, *, depth: int | None = None,
     stalled worker thread is abandoned, not joined: a hung transfer
     cannot be interrupted, only diagnosed and relaunched around).
     ``fault_context`` names the staging site in fault events/errors;
-    ``events`` is an optional telemetry EventLog.
+    ``events`` is an optional telemetry EventLog; ``liveness`` is an
+    optional ``runtime.elastic.Heartbeat`` stamped (throttled) after
+    every committed slab, so a participant mid-staging stays diagnosably
+    alive to the liveness layer — a multi-minute atlas stage must not
+    read as a wedge at the next barrier/straggler check. (Distinct from
+    the internal per-slab ``heartbeat`` stamps the stall watchdog keeps.)
     """
     tasks = list(tasks)
     if threads is None:
@@ -397,10 +402,17 @@ def run_pipeline(tasks, prep, commit, *, depth: int | None = None,
     if depth is None:
         depth = stream_depth(threads=threads)
     stall_s = stream_stall_s()
+
+    def _committed(i: int):
+        if liveness is not None:
+            liveness.beat(phase=f"stage:{fault_context or 'stream'}",
+                          cursor=i)
+
     if depth <= 1 or threads <= 0 or len(tasks) <= 1:
         serial_prep = _retrying(prep, fault_context, events)
-        for t in tasks:
+        for i, t in enumerate(tasks):
             commit(t, serial_prep(t))
+            _committed(i)
         return
     import concurrent.futures
 
@@ -435,6 +447,7 @@ def run_pipeline(tasks, prep, commit, *, depth: int | None = None,
                     from None
 
     pending = collections.deque()
+    n_done = 0
     ex = concurrent.futures.ThreadPoolExecutor(
         max_workers=min(threads, len(tasks)),
         thread_name_prefix="cnmf-stream")
@@ -443,10 +456,14 @@ def run_pipeline(tasks, prep, commit, *, depth: int | None = None,
             if len(pending) >= depth:
                 tt, fut = pending.popleft()
                 commit(tt, await_result(tt, fut))
+                _committed(n_done)
+                n_done += 1
             pending.append((t, ex.submit(prep, t)))
         while pending:
             tt, fut = pending.popleft()
             commit(tt, await_result(tt, fut))
+            _committed(n_done)
+            n_done += 1
     except ShardStallError:
         # a genuinely stalled worker cannot be joined without re-inheriting
         # the hang it was just converted from: abandon it (it finishes or
@@ -570,7 +587,7 @@ def _csr_transport(devices) -> str:
 
 
 def _stream_csr_sharded(X, sharding, dtype, stats: StreamStats | None = None,
-                        events=None):
+                        events=None, liveness=None):
     """Stage a host CSR matrix as a dense sharded device array through the
     pipeline: slab prep (CSR slicing + pad buffers, or host slab densify —
     :func:`_csr_transport`) on the stream thread pool, transfers issued
@@ -694,7 +711,8 @@ def _stream_csr_sharded(X, sharding, dtype, stats: StreamStats | None = None,
 
     run_pipeline(tasks, prep_dense if transport == "dense" else prep_csr,
                  commit, depth=depth, threads=threads,
-                 fault_context=f"stream_csr:{transport}", events=events)
+                 fault_context=f"stream_csr:{transport}", events=events,
+                 liveness=liveness)
 
     t0 = time.perf_counter()
     while inflight:
@@ -709,7 +727,8 @@ def _stream_csr_sharded(X, sharding, dtype, stats: StreamStats | None = None,
 
 
 def _stream_dense_sharded(X, sharding, dtype,
-                          stats: StreamStats | None = None, events=None):
+                          stats: StreamStats | None = None, events=None,
+                          liveness=None):
     """Dense host matrix -> sharded device array, slab-pipelined: workers
     make each slab contiguous at the target dtype (a no-op view when the
     input already is) and upload it; the caller chains donated slab
@@ -755,7 +774,8 @@ def _stream_dense_sharded(X, sharding, dtype,
             stats.add(device_s=time.perf_counter() - t0)
 
     run_pipeline(tasks, prep, commit, depth=depth, threads=threads,
-                 fault_context="stream_dense", events=events)
+                 fault_context="stream_dense", events=events,
+                 liveness=liveness)
 
     t0 = time.perf_counter()
     blocks = asm.blocks([dev for dev, _, _ in shards])

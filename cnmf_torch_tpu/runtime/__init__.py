@@ -1,13 +1,14 @@
-"""Execution-resilience runtime: fault injection, quarantine/retry, and
-mid-run checkpoints.
+"""Execution-resilience runtime: fault injection, quarantine/retry,
+mid-run checkpoints, and elastic degraded-mesh recovery.
 
-Three modules, imported explicitly by their consumers (this package pulls
+Four modules, imported explicitly by their consumers (this package pulls
 in no heavy dependencies at import time):
 
   * :mod:`.faults` — the deterministic fault-injection harness behind
     ``CNMF_TPU_FAULT_SPEC`` (NaN replicate lanes, worker SIGKILL, torn
-    artifact files, failed device uploads, stalled transfers). Stdlib-only;
-    every hook is a no-op when the spec is unset.
+    artifact files, failed device uploads, stalled transfers, simulated
+    host loss, injected stragglers). Stdlib-only; every hook is a no-op
+    when the spec is unset.
   * :mod:`.resilience` — the recovery layer: per-replicate health
     evaluation, quarantine + reseeded retry bookkeeping
     (``ReplicateGuard``), torn-artifact validation for resume/combine,
@@ -17,4 +18,11 @@ in no heavy dependencies at import time):
     streaming/rowsharded solvers (``CNMF_TPU_CKPT_EVERY_PASSES``): tiny
     ``(A, B)``/W/cursor state persisted atomically per replicate so an
     interrupted multi-hour pass resumes mid-run instead of from scratch.
+  * :mod:`.elastic` — elastic degraded-mesh execution (ISSUE 8):
+    heartbeat liveness for mesh participants (named culprits at barrier
+    timeouts and straggler deadlines), host/device-loss detection, and
+    degraded-mesh re-planning over surviving devices so a topology
+    failure becomes a recoverable, chaos-testable degraded mode instead
+    of an abort (``CNMF_TPU_ELASTIC`` / ``CNMF_TPU_HEARTBEAT_S`` /
+    ``CNMF_TPU_STRAGGLER_S`` / ``CNMF_TPU_MIN_DEVICES``).
 """

@@ -190,11 +190,22 @@ def save_pass_checkpoint(path, *, k, it, seed, attempt, digest, beta,
 
 def load_pass_checkpoint(path, *, expect: dict | None = None,
                          n_genes: int | None = None,
-                         n_rows: int | None = None) -> dict:
+                         n_rows: int | None = None,
+                         n_rows_min: int | None = None) -> dict:
     """Load + validate a pass checkpoint; :class:`TornCheckpointError` on
     ANY defect. ``expect`` pins the replicate identity (the
     ``_IDENTITY_KEYS`` subset it carries); ``n_genes``/``n_rows`` pin the
-    factor shapes of the solve about to resume."""
+    factor shapes of the solve about to resume.
+
+    ``n_rows_min`` (elastic degraded re-mesh, ISSUE 8): accept an ``H``
+    whose row count is at least this many rows instead of exactly
+    ``n_rows`` — the checkpoint's H carries the WRITING mesh's
+    zero-padding (rows past the true cell count are exactly zero: a
+    zero X row collapses its usage row in one multiplicative step), and
+    a continuation on a shrunk mesh pads to a different multiple. The
+    resuming loop trims/re-pads the zero tail to its own padding; the
+    true rows are pinned by ``n_rows_min`` (the original cell count) and
+    the identity digest as before."""
     try:
         with np.load(path, allow_pickle=False) as f:
             data = {key: np.asarray(f[key]) for key in f.files}
@@ -243,12 +254,16 @@ def load_pass_checkpoint(path, *, expect: dict | None = None,
             f"{path}: W has {state['W'].shape[1]} gene columns, expected "
             f"{int(n_genes)}")
     if state["H"] is not None:
-        if (state["H"].ndim != 2 or state["H"].shape[1] != k
-                or (n_rows is not None
-                    and state["H"].shape[0] != int(n_rows))):
+        rows = state["H"].shape[0] if state["H"].ndim == 2 else -1
+        bad = state["H"].ndim != 2 or state["H"].shape[1] != k
+        if n_rows_min is not None:
+            bad = bad or rows < int(n_rows_min)
+        elif n_rows is not None:
+            bad = bad or rows != int(n_rows)
+        if bad:
             raise TornCheckpointError(
                 f"{path}: H shape {state['H'].shape} does not match the "
-                f"resumed solve ({n_rows} x {k})")
+                f"resumed solve ({n_rows_min if n_rows_min is not None else n_rows} x {k})")
     if state["pass_idx"] < 1:
         raise TornCheckpointError(
             f"{path}: pass cursor {state['pass_idx']} < 1")
@@ -326,14 +341,19 @@ class PassCheckpointer:
                     >= self.min_interval_s)
         return True
 
-    def load(self, n_rows: int | None = None, n_genes: int | None = None):
+    def load(self, n_rows: int | None = None, n_genes: int | None = None,
+             n_rows_min: int | None = None):
         """Validated state for a resume, or ``None`` (absent / fresh run /
         torn — a torn checkpoint is discarded, surfaced as a telemetry
-        ``fault``, and the replicate restarts from scratch)."""
+        ``fault``, and the replicate restarts from scratch).
+        ``n_rows_min`` relaxes the exact H row check to a floor for
+        degraded re-mesh continuations (see
+        :func:`load_pass_checkpoint`)."""
         if not self.resume or self.every <= 0:
             return None
         state, reason = probe_pass_checkpoint(
-            self.path, expect=self.meta, n_genes=n_genes, n_rows=n_rows)
+            self.path, expect=self.meta, n_genes=n_genes, n_rows=n_rows,
+            n_rows_min=n_rows_min)
         if state is None:
             if reason != "missing":
                 warnings.warn(

@@ -16,7 +16,15 @@ spec into injected faults at fixed hook points in the pipeline:
   * ``upload`` — raise from a host→device staging entry point;
   * ``stall`` — sleep inside a per-slab staging hook (``seconds=N``,
     default 30), simulating a hung transfer so the
-    ``CNMF_TPU_STREAM_STALL_S`` watchdog path is testable on demand.
+    ``CNMF_TPU_STREAM_STALL_S`` watchdog path is testable on demand;
+  * ``hostloss`` — raise :class:`HostLossError` at a pass/replicate
+    boundary, simulating a mesh participant (host or device) dying
+    mid-run; carries the simulated lost-device set so the elastic
+    controller (``runtime/elastic.py``) can re-plan a degraded mesh
+    over the survivors. Default ``limit`` 1 (one loss per process);
+  * ``straggler`` — sleep ``seconds=N`` (default 1) at per-task hooks
+    of a matching worker, turning it into a deterministic straggler so
+    the launcher's ``CNMF_TPU_STRAGGLER_S`` containment is testable.
 
 Spec grammar (semicolon-separated clauses)::
 
@@ -45,6 +53,7 @@ import threading
 __all__ = [
     "FAULT_SPEC_ENV",
     "FaultClause",
+    "HostLossError",
     "parse_fault_spec",
     "active_spec",
     "maybe_poison_lanes",
@@ -52,12 +61,30 @@ __all__ = [
     "maybe_tear",
     "maybe_fail",
     "maybe_stall",
+    "maybe_hostloss",
+    "maybe_straggle",
 ]
 
 FAULT_SPEC_ENV = "CNMF_TPU_FAULT_SPEC"
 
-_KINDS = ("nonfinite", "kill", "torn", "upload", "stall")
+_KINDS = ("nonfinite", "kill", "torn", "upload", "stall", "hostloss",
+          "straggler")
 _CONTROL_KEYS = ("after", "limit", "once")
+
+
+class HostLossError(RuntimeError):
+    """Injected topology failure: a mesh participant (host/device) died.
+
+    ``lost`` names the simulated lost device ids (empty = "lose the last
+    ``count`` devices of whatever mesh the catcher holds"). The elastic
+    controller treats this exactly like a real XLA device-loss error —
+    the only difference is that a real loss identifies its dead devices
+    by probing, an injected one by decree."""
+
+    def __init__(self, message: str, lost=(), count: int = 1):
+        super().__init__(message)
+        self.lost = tuple(int(d) for d in lost)
+        self.count = int(count)
 
 
 class FaultClause:
@@ -306,19 +333,90 @@ def maybe_stall(context=None) -> float:
     for clause in spec:
         if clause.kind != "stall":
             continue
+        if not _clause_fires(clause, context, None, default_limit=1):
+            continue
+        secs = float(clause.params.get("seconds", 30.0))
+        time.sleep(secs)
+        return secs
+    return 0.0
+
+
+def _clause_fires(clause: FaultClause, context, worker,
+                  default_limit: int | None) -> bool:
+    """Shared selector + control evaluation for the topology hooks
+    (``hostloss``/``straggler``): ``context`` substring match, ``worker``
+    int match, then the ``after``/``limit``/``once`` controls.
+    ``default_limit=None`` means unbounded unless the clause caps it.
+    Mutates the clause's hit/injected counters; True = inject now."""
+    params = clause.params
+    sub = params.get("context")
+    if sub is not None and str(sub) not in str(context or ""):
+        return False
+    if "worker" in params:
+        try:
+            if worker is None or int(worker) != int(params["worker"]):
+                return False
+        except (TypeError, ValueError):
+            return False
+    clause.hits += 1
+    if clause.hits <= int(params.get("after", 0)):
+        return False
+    limit = params.get("limit", default_limit)
+    if limit is not None and clause.injected >= int(limit):
+        return False
+    if not _take_once(params):
+        return False
+    clause.injected += 1
+    return True
+
+
+def maybe_hostloss(context=None, worker=None) -> None:
+    """Raise :class:`HostLossError` when a ``hostloss`` clause matches —
+    the injectable form of a host/device dying mid-run. Selectors:
+    ``context`` (substring match against the hook site — ``pass`` for the
+    rowsharded per-pass boundary, ``replicate`` for the post-solve
+    boundary, ``sweep2d`` for the 2-D sweep's slice loop), ``worker``.
+    Clause params ``devices`` (``+``-separated ids, e.g. ``devices=2+3``)
+    or ``count=N`` (default 1: lose the last N devices of the mesh the
+    catcher holds) describe WHAT died. ``limit`` defaults to 1 — one
+    topology loss per process, so the degraded continuation itself runs
+    clean and the recovery is observable."""
+    spec = active_spec()
+    if spec is None:
+        return
+    for clause in spec:
+        if clause.kind != "hostloss":
+            continue
+        if not _clause_fires(clause, context, worker, default_limit=1):
+            continue
         params = clause.params
-        sub = params.get("context")
-        if sub is not None and str(sub) not in str(context or ""):
+        lost = [int(d) for d in
+                str(params.get("devices", "")).split("+") if d != ""]
+        raise HostLossError(
+            "cnmf-tpu injected fault: hostloss (context=%s, lost=%s, "
+            "count=%s) — a mesh participant died"
+            % (context, lost or "last-%d" % int(params.get("count", 1)),
+               params.get("count", 1)),
+            lost=lost, count=int(params.get("count", 1)))
+
+
+def maybe_straggle(context=None, worker=None) -> float:
+    """Sleep when a ``straggler`` clause matches — the injectable form of
+    a slow shard/worker. Unlike ``stall`` (one hung transfer), a
+    straggler is CONSISTENTLY slow: ``limit`` defaults to unbounded, so
+    every matching per-task hook hit sleeps ``seconds`` (default 1) and
+    the worker falls steadily behind its peers. Returns seconds slept."""
+    spec = active_spec()
+    if spec is None:
+        return 0.0
+    import time
+
+    for clause in spec:
+        if clause.kind != "straggler":
             continue
-        clause.hits += 1
-        if clause.hits <= int(params.get("after", 0)):
+        if not _clause_fires(clause, context, worker, default_limit=None):
             continue
-        if clause.injected >= int(params.get("limit", 1)):
-            continue
-        if not _take_once(params):
-            continue
-        clause.injected += 1
-        secs = float(params.get("seconds", 30.0))
+        secs = float(clause.params.get("seconds", 1.0))
         time.sleep(secs)
         return secs
     return 0.0

@@ -173,6 +173,35 @@ REGISTRY: dict[str, Knob] = _knobs(
          "before falling back to skip-missing combine"),
     Knob("CNMF_TPU_WORKER_BACKOFF_S", "float", "`0.5`",
          "respawn backoff base: attempt N waits `base * 2^(N-1)` seconds"),
+    # -- elastic degraded-mesh execution ----------------------------------
+    Knob("CNMF_TPU_ELASTIC", "flag", "`1`",
+         "elastic degraded-mode execution: after a host/device loss the "
+         "rowshard and 2-D factorize paths re-plan a smaller mesh over "
+         "the surviving devices, re-stage X, and resume in-flight "
+         "replicates from their pass checkpoints; the launcher "
+         "additionally lets the idle fleet adopt a dead or straggling "
+         "worker's shard (work-stealing). `0` restores abort-and-relaunch"),
+    Knob("CNMF_TPU_HEARTBEAT_S", "float", "`0` (off)",
+         "mesh-participant liveness interval: each process/worker stamps "
+         "an atomic heartbeat file (pass cursor included) at pass/stage "
+         "boundaries; barrier timeouts and straggler containment then "
+         "name the silent culprit (index, last-beat age, pass) instead "
+         "of a generic timeout. A peer is presumed dead after 3x this "
+         "interval"),
+    Knob("CNMF_TPU_STRAGGLER_S", "float", "`0` (off)",
+         "launcher straggler grace (elastic layer; needs "
+         "`CNMF_TPU_HEARTBEAT_S` — conviction is evidence-based): a "
+         "worker whose run exceeds the longest clean finisher's wall "
+         "time by this many seconds AND whose heartbeat is stale (older "
+         "than max(grace, 3× heartbeat interval)) is killed and its "
+         "shard adopted by the fleet — containment before a slow shard "
+         "wedges the sweep. Clocks start at each process's own spawn, "
+         "so adoptions redoing a full shard get a full allowance; a "
+         "worker stamping liveness on schedule is never convicted"),
+    Knob("CNMF_TPU_MIN_DEVICES", "int", "`1`",
+         "degraded-mesh floor: elastic continuation refuses to shrink "
+         "below this many surviving devices and re-raises the loss "
+         "(clean, checkpoint-resumable abort) instead"),
     # -- testing / sanitizers ---------------------------------------------
     Knob("CNMF_TPU_SANITIZE", "flag", "`0`",
          "`1` wraps the designated tier-1 solver subset in "

@@ -71,11 +71,16 @@ EVENT_TYPES = {
     "replicates": {"k", "beta", "records"},
     "stream": {"context", "wall_s", "nbytes", "overlap_fraction"},
     "memory": {"stage", "devices"},
-    # resilience events (runtime/resilience.py + parallel/streaming.py):
-    # nonfinite_replicate / retry / quarantine / torn_artifact /
-    # shard_retry / shard_upload_failed / shard_stall detections, with
-    # the (k, iter, seed, attempt) or (path, reason) or (context, task)
-    # context needed to audit a degraded run
+    # resilience + elasticity events (runtime/{resilience,elastic}.py,
+    # parallel/streaming.py, launcher.py): nonfinite_replicate / retry /
+    # quarantine / torn_artifact / shard_retry / shard_upload_failed /
+    # shard_stall detections, plus the ISSUE-8 topology kinds —
+    # host_loss (a mesh participant died; culprits/lost devices in
+    # context), remesh (degraded continuation re-planned the mesh, with
+    # from/to device counts), worker_steal (the fleet adopted a dead
+    # worker's shard), straggler (deadline containment) — with the
+    # (k, iter, seed, attempt) / (path, reason) / (context, task) /
+    # topology context needed to audit a degraded run
     "fault": {"kind", "context"},
     # mid-run checkpoint lifecycle (runtime/checkpoint.py): action in
     # {write, resume, discard} with the replicate identity + pass cursor
@@ -558,6 +563,37 @@ def summarize_events(events: list[dict]) -> dict:
             ckpt_sum["max_resume_pass"] = max_resume_pass
         summary["checkpoints"] = ckpt_sum
 
+    # mesh elasticity (ISSUE 8): topology losses, degraded re-meshes
+    # (with the before/after device counts), launcher shard adoptions,
+    # and straggler containments — the audit trail that distinguishes
+    # "the run survived a dying pod" from "the run was never stressed"
+    losses = remeshes = stolen = stragglers = 0
+    remesh_paths: list[str] = []
+    for e in events:
+        if e["t"] != "fault":
+            continue
+        kind = str(e.get("kind"))
+        ctx = e.get("context") if isinstance(e.get("context"), dict) else {}
+        if kind == "host_loss":
+            losses += 1
+        elif kind == "remesh":
+            remeshes += 1
+            fd, td = ctx.get("from_devices"), ctx.get("to_devices")
+            if isinstance(fd, int) and isinstance(td, int):
+                remesh_paths.append(f"{fd}->{td}")
+        elif kind == "worker_steal":
+            stolen += 1
+        elif kind == "straggler":
+            stragglers += 1
+    if losses or remeshes or stolen or stragglers:
+        elasticity = {"host_losses": losses, "remeshes": remeshes,
+                      "stolen_shards": stolen, "stragglers": stragglers}
+        if remesh_paths:
+            elasticity["remesh_devices"] = remesh_paths
+        if max_resume_pass is not None:
+            elasticity["max_resume_pass"] = max_resume_pass
+        summary["elasticity"] = elasticity
+
     mem_peak = 0
     mem_stage = None
     for e in events:
@@ -695,6 +731,24 @@ def render_report(run_dir: str) -> str:
                 line += (" (deepest resume: pass %d)"
                          % ckpts["max_resume_pass"])
             lines.append(line)
+
+    el = summary.get("elasticity")
+    if el:
+        lines.append("")
+        lines.append("Mesh elasticity")
+        lines.append("-" * 15)
+        lines.append(f"  {'host/device losses':<28s} {el['host_losses']:>7d}")
+        remesh_detail = ("  (" + ", ".join(el["remesh_devices"]) + " devices)"
+                         if el.get("remesh_devices") else "")
+        lines.append(f"  {'degraded re-meshes':<28s} {el['remeshes']:>7d}"
+                     + remesh_detail)
+        lines.append(f"  {'stolen worker shards':<28s}"
+                     f" {el['stolen_shards']:>7d}")
+        lines.append(f"  {'stragglers contained':<28s}"
+                     f" {el['stragglers']:>7d}")
+        if el.get("max_resume_pass") is not None:
+            lines.append(f"  {'deepest resumed pass':<28s}"
+                         f" {el['max_resume_pass']:>7d}")
 
     lines.append("")
     lines.append("Device memory")

@@ -24,6 +24,19 @@ and asserts the pipeline completes with correct degraded-mode accounting:
   6. ``torn:artifact=ckpt`` — a truncated pass checkpoint is detected on
      resume, discarded, and the replicate restarts from scratch,
      reproducing the clean result.
+  7. ``hostloss`` — a simulated host (2 of a worker's 4 devices) dies
+     mid-sweep at a replicate's post-checkpoint boundary; the elastic
+     controller re-plans the mesh over the survivors, re-stages X, and
+     the run COMPLETES degraded with merged spectra and consensus
+     bit-identical to an uninterrupted run (the interrupted replicate
+     finishes from its checkpointed state, H under the byte budget) —
+     proven via ``host_loss``/``remesh``/``checkpoint resume`` telemetry
+     events, with zero leaked threads or checkpoint files.
+  8. ``straggler`` — one of two launcher workers is made pathologically
+     slow; the ``CNMF_TPU_STRAGGLER_S`` deadline fires after the first
+     clean finisher, the straggler is killed (telemetry ``straggler``)
+     and its shard adopted by the fleet (``worker_steal``), and every
+     replicate still lands — containment instead of a wedged sweep.
 
 Exits nonzero on any violated invariant, failing the gate.
 """
@@ -350,6 +363,151 @@ def scenario_torn_ckpt(workdir: str, counts_fn: str) -> None:
           "bit-identically")
 
 
+def scenario_hostloss(workdir: str, counts_fn: str) -> None:
+    """Elastic degraded-mesh execution (ISSUE 8): a simulated host (2 of
+    a 4-device worker mesh) dies mid-sweep at the second replicate's
+    post-checkpoint boundary. The worker re-plans the mesh over the 2
+    survivors, re-stages X, resumes the in-flight replicate from its
+    pass checkpoint (zero further passes needed — H rode the checkpoint
+    under its byte budget), and the run completes with merged spectra
+    AND consensus bit-identical to an uninterrupted run."""
+    import glob
+    import threading
+
+    import numpy as np
+
+    from cnmf_torch_tpu import cNMF
+    from cnmf_torch_tpu.launcher import run_pipeline
+    from cnmf_torch_tpu.utils.io import load_df_from_npz
+    from cnmf_torch_tpu.utils.telemetry import (read_events,
+                                                validate_events_file)
+
+    common = dict(components=[3], n_iter=2, total_workers=1, seed=4,
+                  numgenes=50, k_selection=False,
+                  factorize_flags=["--rowshard"])
+    run_pipeline(counts_fn, workdir, "elclean",
+                 env_extra={"CNMF_SIM_CPU_DEVICES": "4"}, **common)
+    run_pipeline(counts_fn, workdir, "elloss",
+                 env_extra={"CNMF_SIM_CPU_DEVICES": "4",
+                            "CNMF_TPU_TELEMETRY": "1",
+                            FAULT_ENV:
+                                "hostloss:context=replicate,after=1,count=2"},
+                 **common)
+
+    ev_path = os.path.join(workdir, "elloss", "cnmf_tmp",
+                           "elloss.events.jsonl")
+    validate_events_file(ev_path)               # raises on malformed lines
+    ev = read_events(ev_path)
+    kinds = [e["kind"] for e in ev if e["t"] == "fault"]
+    assert "host_loss" in kinds and "remesh" in kinds, kinds
+    remesh = next(e for e in ev if e["t"] == "fault"
+                  and e["kind"] == "remesh")
+    assert (remesh["context"]["from_devices"],
+            remesh["context"]["to_devices"]) == (4, 2), remesh
+    resumes = [e for e in ev
+               if e["t"] == "checkpoint" and e["action"] == "resume"]
+    assert resumes and int(resumes[0]["context"]["pass_idx"]) >= 1, \
+        "degraded continuation did not resume from the pass checkpoint"
+
+    a = load_df_from_npz(os.path.join(
+        workdir, "elclean", "cnmf_tmp",
+        "elclean.spectra.k_3.merged.df.npz")).values
+    b = load_df_from_npz(os.path.join(
+        workdir, "elloss", "cnmf_tmp",
+        "elloss.spectra.k_3.merged.df.npz")).values
+    assert np.array_equal(a, b), \
+        "degraded run's merged spectra diverge from the clean run"
+    outs = []
+    for name in ("elclean", "elloss"):
+        obj = cNMF(output_dir=workdir, name=name)
+        obj.consensus(3, density_threshold=2.0,
+                      local_neighborhood_size=0.7, show_clustering=False,
+                      build_ref=False)
+        outs.append(load_df_from_npz(
+            obj.paths["consensus_spectra"] % (3, "2_0")).values)
+    assert np.array_equal(outs[0], outs[1]), "consensus diverges"
+    # zero leaks: checkpoints discarded, no cnmf worker threads survive
+    # (worker processes were waited by run_pipeline itself)
+    assert not glob.glob(os.path.join(workdir, "elloss", "cnmf_tmp",
+                                      "*.ckpt.*"))
+    leaked = [t.name for t in threading.enumerate()
+              if t.name.startswith("cnmf-")]
+    assert not leaked, leaked
+    print("chaos smoke [hostloss]: host died mid-sweep, mesh re-planned "
+          "4->2 devices, replicate resumed from checkpoint pass %d; merged "
+          "spectra + consensus bit-identical to the uninterrupted run"
+          % int(resumes[0]["context"]["pass_idx"]))
+
+
+def scenario_straggler(workdir: str, counts_fn: str) -> None:
+    """Launcher straggler containment (ISSUE 8): one of two workers is
+    made pathologically slow (injected ``straggler`` clause); after the
+    fast worker finishes, the ``CNMF_TPU_STRAGGLER_S`` deadline kills
+    the straggler and its shard is adopted by the fleet — every
+    replicate lands, asserted via telemetry, instead of the sweep
+    waiting out the slow shard."""
+    import threading
+    import time
+
+    import numpy as np
+
+    from cnmf_torch_tpu.launcher import run_pipeline
+    from cnmf_torch_tpu.utils.io import load_df_from_npz
+    from cnmf_torch_tpu.utils.telemetry import (read_events,
+                                                validate_events_file)
+
+    sentinel = os.path.join(workdir, "straggle.once")
+    # launcher-side knobs live in THIS process; the fault spec rides the
+    # worker env. The injected sleep (120 s) dwarfs the whole gate: only
+    # containment can finish this scenario. Straggler conviction is
+    # evidence-based, so liveness must be on: the straggler stamps once
+    # at its sweep boundary, then goes silent inside the injected sleep
+    # — exactly the stale-heartbeat + past-deadline combination the
+    # containment requires. Prior env values are restored afterwards.
+    knobs = {"CNMF_TPU_STRAGGLER_S": "2", "CNMF_TPU_HEARTBEAT_S": "0.5",
+             "CNMF_TPU_WORKER_RESPAWNS": "1",
+             "CNMF_TPU_WORKER_BACKOFF_S": "0.1", "CNMF_TPU_TELEMETRY": "1"}
+    saved = {key: os.environ.get(key) for key in knobs}
+    os.environ.update(knobs)
+    t0 = time.monotonic()
+    try:
+        run_pipeline(counts_fn, workdir, "strag", components=[3, 4],
+                     n_iter=3, total_workers=2, seed=4, numgenes=50,
+                     k_selection=False,
+                     env_extra={FAULT_ENV:
+                                "straggler:worker=1,context=factorize,"
+                                f"seconds=120,once={sentinel}"})
+    finally:
+        for key, old in saved.items():
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+    wall = time.monotonic() - t0
+    assert wall < 110, f"straggler was not contained ({wall:.0f}s)"
+    assert os.path.exists(sentinel), "straggler fault never fired"
+
+    ev_path = os.path.join(workdir, "strag", "cnmf_tmp",
+                           "strag.events.jsonl")
+    validate_events_file(ev_path)
+    kinds = [e["kind"] for e in read_events(ev_path) if e["t"] == "fault"]
+    assert "straggler" in kinds, kinds
+    assert "worker_steal" in kinds, kinds
+    # the adopted shard finished: every replicate of both Ks landed
+    for k in (3, 4):
+        merged = load_df_from_npz(os.path.join(
+            workdir, "strag", "cnmf_tmp",
+            f"strag.spectra.k_{k}.merged.df.npz")).values
+        assert merged.shape[0] == 3 * k, (k, merged.shape)
+        assert np.isfinite(merged).all()
+    leaked = [t.name for t in threading.enumerate()
+              if t.name.startswith("cnmf-")]
+    assert not leaked, leaked
+    print("chaos smoke [straggler]: slow worker killed %.0fs in by the "
+          "2s deadline, shard adopted by the fleet; all replicates "
+          "landed" % wall)
+
+
 def main() -> int:
     workdir = tempfile.mkdtemp(prefix="chaos_smoke_")
     try:
@@ -360,6 +518,8 @@ def main() -> int:
         scenario_stall(workdir, counts_fn)
         scenario_ckpt_kill(workdir, counts_fn)
         scenario_torn_ckpt(workdir, counts_fn)
+        scenario_hostloss(workdir, counts_fn)
+        scenario_straggler(workdir, counts_fn)
         print("chaos smoke: all fault classes recovered")
         return 0
     finally:

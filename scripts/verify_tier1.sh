@@ -55,15 +55,17 @@ fi
 
 # chaos smoke: run the mini pipeline once per injected fault class
 # (nonfinite lane, killed worker, torn artifact, stalled shard upload,
-# mid-pass kill + checkpoint resume, torn checkpoint —
-# scripts/chaos_smoke.py) and assert degraded-mode accounting:
-# quarantine + derived-seed retry, respawn + bit-identical resumed
-# consensus, torn-artifact detection, the stream stall watchdog, and
-# mid-run checkpoint resume (relaunch continues from the pass cursor,
-# not from scratch)
+# mid-pass kill + checkpoint resume, torn checkpoint, simulated host
+# loss mid-sweep, straggler worker — scripts/chaos_smoke.py) and assert
+# degraded-mode accounting: quarantine + derived-seed retry, respawn +
+# bit-identical resumed consensus, torn-artifact detection, the stream
+# stall watchdog, mid-run checkpoint resume (relaunch continues from the
+# pass cursor, not from scratch), elastic degraded re-mesh with
+# bit-identical consensus parity, and straggler-deadline containment +
+# work-stealing adoption
 if [ "$rc" -eq 0 ]; then
-  echo "[tier1] chaos smoke (fault injection: nonfinite/kill/torn/stall/ckpt-kill/torn-ckpt) ..."
-  if timeout -k 10 600 env JAX_PLATFORMS=cpu \
+  echo "[tier1] chaos smoke (fault injection: nonfinite/kill/torn/stall/ckpt-kill/torn-ckpt/hostloss/straggler) ..."
+  if timeout -k 10 900 env JAX_PLATFORMS=cpu \
       python scripts/chaos_smoke.py; then
     echo CHAOS_SMOKE=ok
   else
