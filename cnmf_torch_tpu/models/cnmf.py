@@ -696,14 +696,41 @@ class cNMF:
 
         if not batched:
             _credit_completed(jobs)
+            # the sequential lane solves through run_nmf, which resolves
+            # the same env-driven recipe per call — record it once here so
+            # sequential provenance matches the batched lane's. The ell
+            # flag (it feeds the amu cost-ratio rho) comes from run_nmf's
+            # own dispatch helper, so the recorded recipe is exactly the
+            # one every task will engage.
+            from ..ops.nmf import run_nmf_use_ell
+            from ..ops.recipe import resolve_recipe as _resolve_recipe
+
+            _seq_beta = beta_loss_to_float(_nmf_kwargs["beta_loss"])
+            _seq_ell = run_nmf_use_ell(
+                norm_counts.X, _seq_beta,
+                init=_nmf_kwargs.get("init", "random"),
+                algo=_nmf_kwargs.get("algo", "mu"),
+                fp_precision=_nmf_kwargs.get("fp_precision", "float"))
+            _seq_recipe = _resolve_recipe(
+                _seq_beta, _nmf_kwargs.get("mode", "online"),
+                algo=_nmf_kwargs.get("algo", "mu"), ell=_seq_ell)
+            self._events.emit("dispatch", decision="solver_recipe",
+                              context=_seq_recipe.as_context())
             self._save_factorize_provenance(
                 "sequential", worker_i,
-                {k: v for k, v in _nmf_kwargs.items() if k != "n_jobs"})
+                dict({k: v for k, v in _nmf_kwargs.items()
+                      if k != "n_jobs"},
+                     solver_recipe=_seq_recipe.label))
 
             def _solve_seq(k_r, seed_r):
                 kwargs = dict(_nmf_kwargs)
                 kwargs["random_state"] = int(seed_r)
                 kwargs["n_components"] = int(k_r)
+                # pin the RECORDED recipe — run_nmf must not re-resolve
+                # from env at solve time, or a knob mutation between the
+                # dispatch event above and this task would desync
+                # provenance from the engaged math
+                kwargs["recipe"] = _seq_recipe
                 spectra, _usages, err = self._nmf(norm_counts.X, kwargs)
                 return np.asarray(spectra), err
 
@@ -878,6 +905,7 @@ class cNMF:
             # ELL-encoded sweeps always take the per-K path (the packed
             # program's K_max-padded init is defined on the dense matrix)
             packed = (not use_ell
+                      and _nmf_kwargs.get("algo", "mu") == "mu"
                       and _nmf_kwargs["init"] == "random" and len(by_k) >= 4
                       and max((len(t) for t in by_k.values()), default=0)
                       * max(1, int(total_workers)) <= 32)
@@ -893,6 +921,21 @@ class cNMF:
         _h_tol_eff, _n_passes_eff, _h_tol_start = resolve_online_schedule(
             beta_loss_to_float(_nmf_kwargs["beta_loss"]),
             _nmf_kwargs.get("online_h_tol"), _nmf_kwargs.get("n_passes"))
+        # solver recipe (ISSUE 9, ops/recipe.py): WHICH convergence math
+        # the sweeps run — resolved once for the whole factorize from the
+        # accel knobs + β/mode/encoding, recorded whole in the dispatch
+        # event + provenance, and threaded into every sweep/warm call so
+        # the AOT warmer keys the exact programs the sweeps dispatch
+        from ..ops.recipe import resolve_recipe
+
+        recipe = resolve_recipe(
+            beta_val, _nmf_kwargs.get("mode", "online"),
+            algo=_nmf_kwargs.get("algo", "mu"), ell=use_ell,
+            n=int(norm_counts.X.shape[0]), g=int(norm_counts.X.shape[1]),
+            k=max(by_k) if by_k else None,
+            ell_width=X.width if use_ell else None)
+        self._events.emit("dispatch", decision="solver_recipe",
+                          context=recipe.as_context())
         self._save_factorize_provenance(
             "batched-packed" if packed else
             ("batched-ell" if use_ell else "batched"), worker_i,
@@ -900,6 +943,9 @@ class cNMF:
                  online_h_tol=_h_tol_eff, n_passes=_n_passes_eff,
                  online_h_tol_start=_h_tol_start,
                  sparse_path=("ell" if use_ell else "dense"),
+                 solver_recipe=recipe.label,
+                 inner_repeats=int(recipe.inner_repeats),
+                 kl_newton=bool(recipe.kl_newton),
                  mesh_devices=(1 if mesh is None
                                else int(np.prod(mesh.devices.shape)))))
 
@@ -923,7 +969,8 @@ class cNMF:
                 alpha_H=_nmf_kwargs.get("alpha_H", 0.0),
                 l1_ratio_H=_nmf_kwargs.get("l1_ratio_H", 0.0),
                 mesh=mesh, replicates_per_batch=replicates_per_batch,
-                n_rows=int(norm_counts.X.shape[0]) if use_ell else None)
+                n_rows=int(norm_counts.X.shape[0]) if use_ell else None,
+                recipe=recipe)
             return np.asarray(spectra_r), np.asarray(errs_r)
 
         if packed and by_k:
@@ -975,7 +1022,7 @@ class cNMF:
                 alpha_H=_nmf_kwargs.get("alpha_H", 0.0),
                 l1_ratio_H=_nmf_kwargs.get("l1_ratio_H", 0.0),
                 mesh=mesh, replicates_per_batch=replicates_per_batch,
-                on_slice=write_slice,
+                on_slice=write_slice, recipe=recipe,
                 telemetry_sink=lambda _idx, pay:
                     self._emit_replicates_event(pay))
             self._finish_resilience(guard, rerun_batched,
@@ -1005,7 +1052,8 @@ class cNMF:
                 alpha_H=_nmf_kwargs.get("alpha_H", 0.0),
                 l1_ratio_H=_nmf_kwargs.get("l1_ratio_H", 0.0),
                 mesh=mesh, replicates_per_batch=replicates_per_batch,
-                ell_dims=(X.width, X.t_width) if use_ell else None)
+                ell_dims=(X.width, X.t_width) if use_ell else None,
+                recipe=recipe)
             print("[Worker %d]. Warmed %d sweep programs concurrently."
                   % (worker_i, n_progs))
 
@@ -1068,7 +1116,7 @@ class cNMF:
                 alpha_H=_nmf_kwargs.get("alpha_H", 0.0),
                 l1_ratio_H=_nmf_kwargs.get("l1_ratio_H", 0.0),
                 mesh=mesh, replicates_per_batch=replicates_per_batch,
-                fetch=False,
+                fetch=False, recipe=recipe,
                 # pre-chunked ELL leaves carry padded rows; the sweep needs
                 # the true cell count for the init scale + program keys
                 n_rows=int(norm_counts.X.shape[0]) if use_ell else None,
@@ -1113,6 +1161,7 @@ class cNMF:
         self._events.emit("replicates", k=payload["k"], beta=payload["beta"],
                           mode=payload["mode"], cap=int(payload["cap"]),
                           cadence=payload["cadence"],
+                          recipe=payload.get("recipe"),
                           records=replicate_records(payload))
 
     def _write_iter_spectra(self, k, it, spectrum, columns):
@@ -1264,6 +1313,23 @@ class cNMF:
         print("[Worker %d]. Row-sharded factorize: %d cells over %d devices, "
               "%d tasks." % (worker_i, n_orig,
                              int(np.prod(mesh.devices.shape)), len(jobs)))
+        # solver recipe for the sharded pass program (ISSUE 9): only the
+        # dna lane applies here (the pass loop IS the amu repeat schedule
+        # natively); resolved once, recorded in dispatch + provenance,
+        # and pinned into the checkpoint identity below
+        from ..ops.recipe import resolve_recipe as _resolve_recipe
+
+        rs_beta = beta_loss_to_float(nmf_kwargs["beta_loss"])
+        # algo pinned to 'mu': the sharded pass implements the MU family
+        # only (the ledger's algo was already among its ignored keys)
+        recipe = _resolve_recipe(
+            rs_beta, "rowshard", algo="mu",
+            ell=not isinstance(Xd, jax.Array),
+            n=int(norm_counts.X.shape[0]), g=int(norm_counts.X.shape[1]),
+            k=max((int(run_params.iloc[i]["n_components"]) for i in jobs),
+                  default=None))
+        self._events.emit("dispatch", decision="solver_recipe",
+                          context=recipe.as_context())
         # the row-sharded block-coordinate solver ignores the ledger's
         # mode/batch_max_iter/online_chunk_size; record what actually runs
         self._save_factorize_provenance(
@@ -1275,6 +1341,8 @@ class cNMF:
              "chunk_max_iter": nmf_kwargs.get("online_chunk_max_iter", _DEFAULT_CHUNK_MAX_ITER),
              "alpha_W": nmf_kwargs.get("alpha_W", 0.0),
              "alpha_H": nmf_kwargs.get("alpha_H", 0.0),
+             "solver_recipe": recipe.label,
+             "kl_newton": bool(recipe.kl_newton),
              "mesh_devices": int(np.prod(mesh.devices.shape)),
              "ledger_keys_ignored": ["mode", "online_chunk_size"]})
 
@@ -1287,8 +1355,10 @@ class cNMF:
                   else None)
         # resolved-solver-recipe signature: pins the checkpoint to the
         # SETTINGS it was computed under, not just the matrix — a
-        # re-prepare with different iteration caps/regularization must
-        # restart the replicate, never splice two recipes' trajectories
+        # re-prepare with different iteration caps/regularization, or a
+        # knob flip that swaps the convergence math (plain MU vs the dna
+        # Newton lane), must restart the replicate, never splice two
+        # recipes' trajectories
         params_sig = repr(sorted({
             "init": str(nmf_kwargs.get("init", "random")),
             "tol": float(nmf_kwargs.get("tol", 1e-4)),
@@ -1299,6 +1369,7 @@ class cNMF:
             "l1_ratio_W": float(nmf_kwargs.get("l1_ratio_W", 0.0)),
             "alpha_H": float(nmf_kwargs.get("alpha_H", 0.0)),
             "l1_ratio_H": float(nmf_kwargs.get("l1_ratio_H", 0.0)),
+            "recipe": recipe.signature(),
         }.items()))
 
         def _make_ckpt(k_c, it_c, seed_c, attempt=0, force_resume=False):
@@ -1353,7 +1424,7 @@ class cNMF:
                 l1_ratio_H=nmf_kwargs.get("l1_ratio_H", 0.0),
                 n_orig=n_orig,
                 telemetry_sink=self._emit_replicates_event,
-                checkpoint=ckpt, heartbeat=heartbeat)
+                checkpoint=ckpt, heartbeat=heartbeat, recipe=recipe)
             return np.asarray(spectra), err
 
         def _remesh_after_loss(exc):

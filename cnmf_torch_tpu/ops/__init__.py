@@ -14,6 +14,7 @@ from .nmf import (
     nndsvd_init,
     run_nmf,
 )
+from .recipe import SolverRecipe, resolve_recipe
 from .ols import ols_all_cols
 from .stats import column_mean_var, normalize_total, row_sums, scale_columns
 
@@ -35,6 +36,8 @@ __all__ = [
     "nmf_fit_online",
     "nndsvd_init",
     "run_nmf",
+    "SolverRecipe",
+    "resolve_recipe",
     "ols_all_cols",
     "column_mean_var",
     "normalize_total",

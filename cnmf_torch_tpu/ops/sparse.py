@@ -60,6 +60,7 @@ __all__ = [
     "ell_w_table",
     "ell_wh_at_nz",
     "ell_kl_h_stats",
+    "ell_kl_h_newton_stats",
     "ell_kl_w_numer",
     "ell_kl_w_stats",
     "ell_is_h_stats",
@@ -399,9 +400,11 @@ def _wh_at_nz(cols, H, W, w_table=None):
     return acc
 
 
-def ell_wh_at_nz(x: EllMatrix, H, W):
-    """Public f32 SDDMM: ``wh[i, j] = H[i, :] @ W[:, cols[i, j]]``."""
-    return _wh_at_nz(x.cols, H, W)
+def ell_wh_at_nz(x: EllMatrix, H, W, w_table=None):
+    """Public f32 SDDMM: ``wh[i, j] = H[i, :] @ W[:, cols[i, j]]``.
+    ``w_table``: optional pre-gathered :func:`ell_w_table` (fixed-W
+    loops re-use it across candidate evaluations)."""
+    return _wh_at_nz(x.cols, H, W, w_table)
 
 
 def _h_numer(cols, ratio, W, w_table=None):
@@ -460,6 +463,34 @@ def ell_kl_h_stats(x: EllMatrix, H, W, bf16_ratio: bool = False,
     numer = _h_numer(x.cols, ratio, Wc, w_table)
     denom = jnp.broadcast_to(W.sum(axis=1)[None, :], H.shape)
     return numer, denom
+
+
+def ell_kl_h_newton_stats(x: EllMatrix, H, W, w_table=None):
+    """KL H-update statistics for the Diagonalized Newton recipe
+    (arXiv:1301.3389), nonzeros only, strict f32: the MU numerator
+    ``(X/WH) @ Wᵀ`` plus the diagonal Hessian
+    ``hess[i,c] = Σ_j X_ij W_cj² / WH_ij²`` — supported on X's nonzeros
+    exactly like the numerator, so the Newton lane costs one extra
+    squared-slab reduce per component over the same gathers. The
+    data-independent ``W.sum(axis=1)`` denominator is returned for the
+    MU fallback candidate. ``w_table`` must be an f32
+    :func:`ell_w_table` (the DNA recipe does not compose with the bf16
+    ratio chain — curvature is cancellation-sensitive)."""
+    wh = _wh_at_nz(x.cols, H, W, w_table)
+    whm = jnp.maximum(wh, jnp.asarray(EPS, wh.dtype))
+    ratio = x.vals / whm
+    r2 = ratio / whm
+    k = W.shape[0]
+    numers, hesses = [], []
+    for c in range(k):
+        slab = _slab(W, x.cols, w_table, c)
+        numers.append(jnp.sum((ratio * slab).astype(jnp.float32), axis=-1))
+        hesses.append(jnp.sum((r2 * slab * slab).astype(jnp.float32),
+                              axis=-1))
+    numer = jnp.stack(numers, axis=-1)
+    hess = jnp.stack(hesses, axis=-1)
+    denom = jnp.broadcast_to(W.sum(axis=1)[None, :], H.shape)
+    return numer, denom, hess
 
 
 def ell_kl_w_numer(x: EllMatrix, H, W, bf16_ratio: bool = False,

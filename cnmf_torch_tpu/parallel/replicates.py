@@ -33,6 +33,7 @@ from ..ops.nmf import (
     lane_health,
     nmf_fit_batch,
     nmf_fit_batch_bundled,
+    nmf_fit_batch_hals,
     nmf_fit_online,
     nndsvd_init,
     random_init,
@@ -41,15 +42,19 @@ from ..ops.nmf import (
     split_regularization,
 )
 from ..ops.nmf import EVAL_EVERY, SolverTelemetry
+from ..ops.recipe import SolverRecipe, resolve_recipe
 from ..ops.sparse import EllMatrix, ell_device_put
 
 
-def _sweep_telemetry_payload(k, beta, mode, seeds, cap, tm, errs):
+def _sweep_telemetry_payload(k, beta, mode, seeds, cap, tm, errs,
+                             recipe: SolverRecipe | None = None):
     """The dict a sweep's ``telemetry_sink`` receives. Array values are
     DEVICE arrays (one dispatch-ordered fetch per sweep already covers
     them) — callers ``np.asarray`` when they land events, so a
-    ``fetch=False`` pipeline keeps its overlap."""
-    return {
+    ``fetch=False`` pipeline keeps its overlap. ``recipe`` labels the
+    engaged solver recipe; the batch solvers' inner-update counts and
+    dna fallback-lane fractions ride along when tracked."""
+    out = {
         "k": int(k), "beta": float(beta), "mode": mode,
         "seeds": [int(s) for s in seeds],
         "cap": int(cap),
@@ -57,15 +62,28 @@ def _sweep_telemetry_payload(k, beta, mode, seeds, cap, tm, errs):
         "trace": tm.trace, "iters": tm.iters, "nonfinite": tm.nonfinite,
         "errs": errs,
     }
+    if recipe is not None:
+        out["recipe"] = recipe.label
+    if tm.inner_iters is not None:
+        out["inner_iters"] = tm.inner_iters
+    if tm.dna_fallback is not None:
+        out["dna_fallback"] = tm.dna_fallback
+    return out
 
 
 def _concat_telemetry(parts):
     if len(parts) == 1:
         return parts[0]
+
+    def cat(field):
+        leaves = [getattr(t, field) for t in parts]
+        if any(v is None for v in leaves):
+            return None
+        return jnp.concatenate(leaves)
+
     return SolverTelemetry(
-        trace=jnp.concatenate([t.trace for t in parts]),
-        iters=jnp.concatenate([t.iters for t in parts]),
-        nonfinite=jnp.concatenate([t.nonfinite for t in parts]))
+        trace=cat("trace"), iters=cat("iters"), nonfinite=cat("nonfinite"),
+        inner_iters=cat("inner_iters"), dna_fallback=cat("dna_fallback"))
 
 
 def _telemetry_requested(telemetry_sink) -> bool:
@@ -137,7 +155,8 @@ def _device_budget_elems() -> int:
 def auto_replicates_per_batch(n: int, g: int, k: int, beta: float = 2.0,
                               chunk: int | None = None, n_dev: int = 1,
                               budget_elems: int | None = None,
-                              ell_width: int | None = None) -> int:
+                              ell_width: int | None = None,
+                              kl_newton: bool = False) -> int:
     """How many vmapped replicates fit one device slice under the fp32
     element budget (device-derived via :func:`_device_budget_elems` when
     ``budget_elems`` is None).
@@ -157,6 +176,11 @@ def auto_replicates_per_batch(n: int, g: int, k: int, beta: float = 2.0,
     pre-gathered (chunk, width, k) W slab table (built once per chunk
     solve), plus a handful of (chunk, width) ratio/accumulator buffers;
     the IS hybrid still holds one dense WH + its reciprocal.
+
+    ``kl_newton``: the dna recipe additionally holds the two candidate
+    factor blocks and their reconstructions during the per-lane
+    selection — charge two more chunk x genes (dense) / chunk x width
+    (ELL) buffers per replicate.
     """
     if budget_elems is None:
         budget_elems = _device_budget_elems()
@@ -167,14 +191,19 @@ def auto_replicates_per_batch(n: int, g: int, k: int, beta: float = 2.0,
             per_rep += c * int(ell_width) * (k + 5)
             if beta == 0.0:
                 per_rep += 2 * c * g  # IS hybrid: dense WH + 1/WH
+            if kl_newton:
+                per_rep += 2 * c * int(ell_width)
         else:
             per_rep += 3 * c * g
+            if kl_newton:
+                per_rep += 2 * c * g
     return max(n_dev, int(budget_elems // max(per_rep, 1)))
 
 
 def _slice_specs(n: int, g: int, k: int, R: int, beta: float, mode: str,
                  online_chunk_size: int, replicates_per_batch: int | None,
-                 n_dev: int, ell_width: int | None = None):
+                 n_dev: int, ell_width: int | None = None,
+                 kl_newton: bool = False):
     """The ONE derivation of how a sweep's replicates split into device
     slices — shared by :func:`replicate_sweep` (execution) and
     :func:`warm_sweep_programs` (ahead-of-time compilation), so the warmer
@@ -185,7 +214,8 @@ def _slice_specs(n: int, g: int, k: int, R: int, beta: float, mode: str,
     if rpb is None:
         chunk = int(min(online_chunk_size, n)) if mode == "online" else n
         rpb = auto_replicates_per_batch(n, g, k, beta=beta, chunk=chunk,
-                                        n_dev=n_dev, ell_width=ell_width)
+                                        n_dev=n_dev, ell_width=ell_width,
+                                        kl_newton=kl_newton)
     # slices must stay mesh-multiples so every shard stays busy
     rpb = max(n_dev, (rpb // n_dev) * n_dev)
     specs = []
@@ -220,7 +250,8 @@ def warm_sweep_programs(n: int, g: int, k_to_count: dict,
                         replicates_per_batch: int | None = None,
                         online_h_tol: float | None = None,
                         max_workers: int | None = None,
-                        ell_dims: tuple | None = None) -> int:
+                        ell_dims: tuple | None = None,
+                        recipe: SolverRecipe | None = None) -> int:
     """Compile every sweep executable a K-sweep will need, CONCURRENTLY.
 
     A multi-K ``factorize`` compiles one program per (K, slice-size); the
@@ -240,10 +271,23 @@ def warm_sweep_programs(n: int, g: int, k_to_count: dict,
     those fixed widths — the warmer then lowers against the dual-ELL
     pytree structure (pre-chunked for mode='online') so the AOT compiles
     land in the same jit cache entries the ELL sweep dispatches into.
+    ``recipe``: the resolved solver recipe the sweeps will run —
+    recipe fields are part of the program cache key, so warming a
+    different recipe would put the compile wall back on the first sweep;
+    ``None`` resolves it exactly as :func:`replicate_sweep` does.
     """
     import concurrent.futures
 
     beta = beta_loss_to_float(beta_loss)
+    # default resolution mirrors replicate_sweep's PER-K resolution (the
+    # auto amu rho is k-dependent for beta=2): one recipe per K, or the
+    # caller's recipe for every K — warming a recipe the sweep won't
+    # dispatch would put the compile wall back on the first sweep call
+    per_k_recipe = {
+        int(kk): (recipe if recipe is not None else resolve_recipe(
+            beta, mode, ell=ell_dims is not None, n=n, g=g, k=int(kk),
+            ell_width=None if ell_dims is None else int(ell_dims[0])))
+        for kk in k_to_count}
     online_h_tol, n_passes, h_tol_start = resolve_online_schedule(
         beta, online_h_tol, n_passes)
     l1_W, l2_W = split_regularization(alpha_W, l1_ratio_W)
@@ -259,7 +303,8 @@ def warm_sweep_programs(n: int, g: int, k_to_count: dict,
         _, slices = _slice_specs(n, g, k, R, beta, mode, online_chunk_size,
                                  replicates_per_batch, n_dev,
                                  ell_width=(None if ell_dims is None
-                                            else int(ell_dims[0])))
+                                            else int(ell_dims[0])),
+                                 kl_newton=per_k_recipe[k].kl_newton)
         for _start, _r, r_pad in slices:
             specs.add((k, r_pad))
     if not specs:
@@ -281,7 +326,7 @@ def warm_sweep_programs(n: int, g: int, k_to_count: dict,
             l1_H, l2_H, l1_W, l2_W, mesh, bool(return_usages),
             h_tol_start=h_tol_start,
             bf16_ratio=resolve_bf16_ratio(beta, mode),
-            telemetry=telem)
+            telemetry=telem, **_recipe_statics(per_k_recipe[k]))
         if ell_dims is not None:
             w_e, wt_e = int(ell_dims[0]), int(ell_dims[1])
             if mode == "online":
@@ -310,6 +355,33 @@ def warm_sweep_programs(n: int, g: int, k_to_count: dict,
         # list() propagates the first compile error instead of hiding it
         list(ex.map(compile_one, sorted(specs)))
     return len(specs)
+
+
+def _slice_telemetry(tm: SolverTelemetry, r: int) -> SolverTelemetry:
+    """Trim a slice's telemetry to its real (unpadded) replicates."""
+    return SolverTelemetry(
+        trace=tm.trace[:r], iters=tm.iters[:r],
+        nonfinite=tm.nonfinite[:r],
+        inner_iters=None if tm.inner_iters is None else tm.inner_iters[:r],
+        dna_fallback=(None if tm.dna_fallback is None
+                      else tm.dna_fallback[:r]))
+
+
+def _recipe_statics(recipe: SolverRecipe) -> dict:
+    """The resolved recipe as :func:`_sweep_program` static kwargs (the
+    program-family algo plus the amu/dna fields) — one mapping so every
+    dispatch site and the AOT warmer key the same cache entries.
+
+    An identity mu recipe returns ``{}``: the call sites then invoke
+    ``_sweep_program`` with EXACTLY the argument signature a build
+    without the recipe layer uses, so ``CNMF_TPU_ACCEL=0`` (the default)
+    hits the same lru_cache entry — same program object, byte for byte
+    (pinned by tests/test_accel.py)."""
+    if recipe.algo == "mu" and recipe.is_identity:
+        return {}
+    return {"algo": "hals" if recipe.algo == "hals" else "mu",
+            "inner_repeats": int(recipe.inner_repeats),
+            "kl_newton": bool(recipe.kl_newton)}
 
 
 def _stacked_inits(X, k: int, seeds, init: str, n_rows: int | None = None):
@@ -374,7 +446,9 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
                    l1_H: float, l2_H: float, l1_W: float, l2_W: float,
                    mesh: Mesh | None, return_usages: bool,
                    packed: bool = False, h_tol_start: float | None = None,
-                   bf16_ratio: bool = False, telemetry: bool = False):
+                   bf16_ratio: bool = False, telemetry: bool = False,
+                   algo: str = "mu", inner_repeats: int = 1,
+                   kl_newton: bool = False):
     """Build (once per static configuration) the jitted sweep executable
     ``(X (n,g), seeds (R,)) -> (usages | (0,), spectra (R,k,g), errs (R,))``.
 
@@ -388,6 +462,15 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
     :class:`~cnmf_torch_tpu.ops.nmf.SolverTelemetry` to the outputs:
     (trace (R, TRACE_LEN), iters (R,), nonfinite (R,)), fetched by the
     caller in ONE device->host read alongside the spectra.
+
+    ``algo`` / ``inner_repeats`` / ``kl_newton`` are the resolved solver
+    recipe's static fields (ISSUE 9; ``ops/recipe.py``): ``algo='hals'``
+    routes the batch solve through ``nmf_fit_batch_hals`` and the online
+    solve through the ``halsvar`` inner solvers (β=2 only);
+    ``inner_repeats``/``kl_newton`` thread the amu/dna recipes into
+    ``nmf_fit_batch``/``nmf_fit_online``. The identity recipe
+    ``('mu', 1, False)`` hits the same cache entries (and compiles the
+    byte-identical programs) as a build without the recipe layer.
 
     ``packed=True`` builds the PACKED K-sweep variant: ``k`` is K_max, the
     program additionally takes the slice's actual component count (a traced
@@ -409,17 +492,39 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
     # folds the replicate axis into the packed lane axis, so on a >1-device
     # mesh GSPMD would have to reshard every iteration where the vmapped
     # solver keeps replicates device-local.
+    if algo not in ("mu", "hals"):
+        raise ValueError(f"unknown sweep algo {algo!r}")
+    if algo == "hals" and beta != 2.0:
+        raise ValueError("the hals recipe optimizes the Frobenius "
+                         "objective (beta=2)")
+    if kl_newton and beta != 1.0:
+        # loud on every path (bundled included, which has no Newton
+        # lane): telemetry/checkpoint identity must never claim dna for
+        # a sweep that ran plain MU
+        raise ValueError(
+            f"the dna recipe requires beta=1 (KL); this sweep has "
+            f"beta={beta}")
+
     stacked_solver = (mode == "batch" and beta == 2.0
-                      and bundle_width(k) > 1
+                      and bundle_width(k) > 1 and algo == "mu"
+                      and inner_repeats == 1
                       and (mesh is None
                            or int(np.prod(mesh.devices.shape)) == 1))
 
     if mode == "batch":
-        def solve(X, h0, w0):
-            return nmf_fit_batch(
-                X, h0, w0, beta=beta, tol=tol, max_iter=batch_max_iter,
-                l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W,
-                telemetry=telemetry)
+        if algo == "hals":
+            def solve(X, h0, w0):
+                return nmf_fit_batch_hals(
+                    X, h0, w0, tol=tol, max_iter=batch_max_iter,
+                    l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W,
+                    telemetry=telemetry)
+        else:
+            def solve(X, h0, w0):
+                return nmf_fit_batch(
+                    X, h0, w0, beta=beta, tol=tol, max_iter=batch_max_iter,
+                    l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W,
+                    telemetry=telemetry, inner_repeats=inner_repeats,
+                    kl_newton=kl_newton)
     elif mode == "online":
         def solve(X, h0, w0):
             Xc, Hc, _ = _chunk_rows(X, h0, chunk)
@@ -428,7 +533,9 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
                 chunk_max_iter=chunk_max_iter, n_passes=n_passes,
                 l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W,
                 h_tol_start=h_tol_start, bf16_ratio=bf16_ratio,
-                telemetry=telemetry)
+                telemetry=telemetry,
+                algo=("halsvar" if algo == "hals" else "mu"),
+                kl_newton=kl_newton)
             Hc, W, err = out[:3]
             H_flat = Hc.reshape(-1, k)[:n]
             return (H_flat, W, err, out[3]) if telemetry else \
@@ -439,6 +546,9 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
     if packed:
         if init != "random":
             raise ValueError("packed K-sweeps require init='random'")
+        if algo != "mu":
+            raise ValueError("packed K-sweeps run the mu-family recipes "
+                             "only; use per-K programs for hals")
 
         def sweep(X, seeds, k_actual):
             # batched padded random_init: all replicates of a slice share
@@ -515,7 +625,8 @@ def replicate_sweep_packed(X, ks, seeds, beta_loss="frobenius",
                            replicates_per_batch: int | None = None,
                            online_h_tol: float | None = None,
                            fetch: bool = True,
-                           on_slice=None, telemetry_sink=None):
+                           on_slice=None, telemetry_sink=None,
+                           recipe: SolverRecipe | None = None):
     """Run an entire multi-K sweep — ``len(seeds)`` (k, seed) tasks — as ONE
     compiled program at ``K_max``.
 
@@ -561,6 +672,12 @@ def replicate_sweep_packed(X, ks, seeds, beta_loss="frobenius",
         X = stream_to_device(X, dtype=jnp.float32)
     n, g = X.shape
     beta = beta_loss_to_float(beta_loss)
+    if recipe is None:
+        recipe = resolve_recipe(beta, mode, n=n, g=g,
+                                k=max((int(v) for v in ks), default=None))
+    if recipe.algo == "hals":
+        raise ValueError("packed K-sweeps run the mu-family recipes only; "
+                         "use per-K replicate_sweep calls for hals")
     online_h_tol, n_passes, h_tol_start = resolve_online_schedule(
         beta, online_h_tol, n_passes)
     ks = [int(v) for v in ks]
@@ -600,7 +717,7 @@ def replicate_sweep_packed(X, ks, seeds, beta_loss="frobenius",
         idxs = by_k[kv]
         _, slices = _slice_specs(n, g, kmax, len(idxs), beta, mode,
                                  online_chunk_size, replicates_per_batch,
-                                 n_dev)
+                                 n_dev, kl_newton=recipe.kl_newton)
         for start, r, r_pad in slices:
             sl_idx = idxs[start:start + r]
             sl_s = [seeds[i] for i in sl_idx]
@@ -613,17 +730,14 @@ def replicate_sweep_packed(X, ks, seeds, beta_loss="frobenius",
                 int(batch_max_iter), l1_H, l2_H, l1_W, l2_W, mesh,
                 bool(return_usages), packed=True, h_tol_start=h_tol_start,
                 bf16_ratio=resolve_bf16_ratio(beta, mode),
-                telemetry=want_telem)
+                telemetry=want_telem, **_recipe_statics(recipe))
             out = prog(X, np.asarray(sl_s, np.uint32), np.int32(kv))
             H, W, err = out[:3]
             if want_telem:
-                tm = out[3]
                 telemetry_sink(sl_idx, _sweep_telemetry_payload(
                     kv, beta, mode, [seeds[i] for i in sl_idx],
                     n_passes if mode == "online" else batch_max_iter,
-                    SolverTelemetry(trace=tm.trace[:r], iters=tm.iters[:r],
-                                    nonfinite=tm.nonfinite[:r]),
-                    err[:r]))
+                    _slice_telemetry(out[3], r), err[:r], recipe=recipe))
             if on_slice is not None:
                 on_slice(sl_idx, np.asarray(W[:r]), np.asarray(err[:r]))
                 continue
@@ -664,7 +778,8 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
                     mesh: Mesh | None = None, return_usages: bool = False,
                     replicates_per_batch: int | None = None,
                     online_h_tol: float | None = None, fetch: bool = True,
-                    n_rows: int | None = None, telemetry_sink=None):
+                    n_rows: int | None = None, telemetry_sink=None,
+                    recipe: SolverRecipe | None = None):
     """Run ``len(seeds)`` NMF replicates at one K as a batched XLA program.
 
     Returns ``(spectra (R, k, g), usages (R, n, k) | None, errs (R,))`` in
@@ -698,6 +813,13 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
     sweep results (no extra device syncs). Active only when
     ``CNMF_TPU_TELEMETRY`` is set; otherwise the sink is never called and
     the compiled programs are the unchanged telemetry-free ones.
+
+    ``recipe``: the resolved :class:`~cnmf_torch_tpu.ops.recipe.
+    SolverRecipe` (ISSUE 9) — ``hals`` routes the β=2 solves through the
+    HALS family, ``amu``/``dna`` thread the accelerated inner loops /
+    Diagonalized-Newton KL updates into the batch programs. ``None``
+    resolves one from the env knobs (default: plain MU, byte-identical
+    programs).
     """
     beta = beta_loss_to_float(beta_loss)
     if n_rows is not None:
@@ -772,11 +894,20 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
     l1_W, l2_W = split_regularization(alpha_W, l1_ratio_W)
     l1_H, l2_H = split_regularization(alpha_H, l1_ratio_H)
 
+    if recipe is None:
+        recipe = resolve_recipe(
+            beta, mode, ell=isinstance(X, EllMatrix), n=n, g=g, k=k,
+            ell_width=X.width if isinstance(X, EllMatrix) else None)
+    if recipe.algo == "hals" and beta != 2.0:
+        raise ValueError("the hals recipe optimizes the Frobenius "
+                         "objective; this sweep has beta=%g" % beta)
+
     n_dev = 1 if mesh is None else math.prod(mesh.devices.shape)
     replicates_per_batch, slices = _slice_specs(
         n, g, k, R, beta, mode, online_chunk_size, replicates_per_batch,
         n_dev,
-        ell_width=X.width if isinstance(X, EllMatrix) else None)
+        ell_width=X.width if isinstance(X, EllMatrix) else None,
+        kl_newton=recipe.kl_newton)
 
     if mesh is not None:
         target = NamedSharding(mesh, P())
@@ -804,16 +935,13 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
             l1_H, l2_H, l1_W, l2_W, mesh, bool(return_usages),
             h_tol_start=h_tol_start,
             bf16_ratio=resolve_bf16_ratio(beta, mode),
-            telemetry=want_telem)
+            telemetry=want_telem, **_recipe_statics(recipe))
         # async dispatch: every slice is enqueued before any result is read
         out = prog(X, np.asarray(sl, dtype=np.uint32))
         H, W, err = out[:3]
         parts.append((H[:r] if return_usages else None, W[:r], err[:r]))
         if want_telem:
-            tm = out[3]
-            telem_parts.append(SolverTelemetry(
-                trace=tm.trace[:r], iters=tm.iters[:r],
-                nonfinite=tm.nonfinite[:r]))
+            telem_parts.append(_slice_telemetry(out[3], r))
 
     if len(parts) == 1:
         usages_d, spectra_d, errs_d = parts[0]
@@ -827,7 +955,7 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
         telemetry_sink(_sweep_telemetry_payload(
             k, beta, mode, seeds,
             n_passes if mode == "online" else batch_max_iter,
-            _concat_telemetry(telem_parts), errs_d))
+            _concat_telemetry(telem_parts), errs_d, recipe=recipe))
 
     if not fetch:
         return spectra_d, usages_d, errs_d

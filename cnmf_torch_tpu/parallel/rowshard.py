@@ -247,11 +247,16 @@ def prepare_rowsharded(X, mesh: Mesh, stats: StreamStats | None = None,
 
 
 def _rowsharded_pass(X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
-                     l1_H, l2_H, l1_W, l2_W):
+                     l1_H, l2_H, l1_W, l2_W, kl_newton: bool = False):
     """One block-coordinate pass on this shard's rows + the global W update.
 
     Runs identically on every device; `psum` makes the W statistics global,
     so the replicated W stays bit-identical across shards.
+
+    ``kl_newton`` (static; ISSUE 9): the per-shard usage solve runs the
+    Diagonalized-Newton KL recipe (``ops/nmf.py:_chunk_h_solve``); the
+    psum'd W statistics and the pass structure are unchanged, so ICI
+    bytes per pass are identical.
 
     Returns ``(H_local, W, err, A, B)``. For beta=2, ``(A, B)`` are the
     pass's psum'd sufficient statistics (``H^T X``, ``H^T H``) — already
@@ -264,7 +269,7 @@ def _rowsharded_pass(X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
     A = B = None
     WWT = W @ W.T if beta == 2.0 else None
     H_local = _chunk_h_solve(X_local, H_local, W, WWT, beta, l1_H, l2_H,
-                             chunk_max_iter, h_tol)
+                             chunk_max_iter, h_tol, kl_newton=kl_newton)
     if beta == 2.0:
         A = jax.lax.psum(H_local.T @ X_local, axis)
         B = jax.lax.psum(H_local.T @ H_local, axis)
@@ -304,7 +309,8 @@ def _rowsharded_pass(X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
 
 def _rowsharded_solve_local(X_local, H_local, W, axis, beta, tol, h_tol,
                             n_passes, chunk_max_iter, l1_H, l2_H, l1_W, l2_W,
-                            telemetry: bool = False):
+                            telemetry: bool = False,
+                            kl_newton: bool = False):
     """Per-device block-coordinate solve loop (runs inside ``shard_map``):
     passes of :func:`_rowsharded_pass` until the psum'd objective's relative
     improvement drops below ``tol`` or ``n_passes`` is reached. Shared by the
@@ -324,7 +330,7 @@ def _rowsharded_solve_local(X_local, H_local, W, axis, beta, tol, h_tol,
             H_local, W, err_prev, err, it = carry
         H_local, W, err_new, _, _ = _rowsharded_pass(
             X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
-            l1_H, l2_H, l1_W, l2_W)
+            l1_H, l2_H, l1_W, l2_W, kl_newton=kl_newton)
         if telemetry:
             # pass it+1's objective lands at 0-based slot it (slot 0 holds
             # the first pass's err0 from the init below)
@@ -340,7 +346,7 @@ def _rowsharded_solve_local(X_local, H_local, W, axis, beta, tol, h_tol,
 
     H_local, W, err0, _, _ = _rowsharded_pass(
         X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
-        l1_H, l2_H, l1_W, l2_W)
+        l1_H, l2_H, l1_W, l2_W, kl_newton=kl_newton)
     init = (H_local, W, err0 * (1.0 + 2.0 * tol) + 1.0, err0, jnp.int32(1))
     if telemetry:
         init = init + (jnp.full((TRACE_LEN,), jnp.nan,
@@ -357,10 +363,10 @@ def _rowsharded_solve_local(X_local, H_local, W, axis, beta, tol, h_tol,
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "axis", "beta", "chunk_max_iter",
-                     "l1_H", "l2_H", "l1_W", "l2_W"),
+                     "l1_H", "l2_H", "l1_W", "l2_W", "kl_newton"),
 )
 def _rowshard_pass_jit(X, H, W, mesh, axis, beta, h_tol, chunk_max_iter,
-                       l1_H, l2_H, l1_W, l2_W):
+                       l1_H, l2_H, l1_W, l2_W, kl_newton: bool = False):
     """ONE block-coordinate pass as its own dispatch — the unit of the
     checkpointed host-driven loop (``_fit_rowsharded_checkpointed``). The
     per-device program is exactly the ``_rowsharded_pass`` body the fused
@@ -378,7 +384,7 @@ def _rowshard_pass_jit(X, H, W, mesh, axis, beta, h_tol, chunk_max_iter,
     def run(X_local, H_local, W):
         H_local, W, err, A, B = _rowsharded_pass(
             X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
-            l1_H, l2_H, l1_W, l2_W)
+            l1_H, l2_H, l1_W, l2_W, kl_newton=kl_newton)
         if with_stats:
             return H_local, W, err[None], A, B
         return H_local, W, err[None]
@@ -394,7 +400,8 @@ def _rowshard_pass_jit(X, H, W, mesh, axis, beta, h_tol, chunk_max_iter,
 def _fit_rowsharded_checkpointed(Xd, H0, W0, mesh, axis, beta, tol, h_tol,
                                  n_passes, chunk_max_iter,
                                  l1_H, l2_H, l1_W, l2_W, ckpt,
-                                 heartbeat=None, n_orig=None):
+                                 heartbeat=None, n_orig=None,
+                                 kl_newton: bool = False):
     """Host-driven pass loop with mid-run checkpoints — the checkpointed
     twin of :func:`_fit_rowsharded_jit`'s fused while_loop (same per-pass
     program, same f32 convergence test, same stopping rule; the loop
@@ -432,7 +439,7 @@ def _fit_rowsharded_checkpointed(Xd, H0, W0, mesh, axis, beta, tol, h_tol,
     def one_pass(H, W):
         return _rowshard_pass_jit(
             Xd, H, W, mesh, axis, beta, h_tol_j, int(chunk_max_iter),
-            l1_H, l2_H, l1_W, l2_W)
+            l1_H, l2_H, l1_W, l2_W, kl_newton=kl_newton)
 
     trace = np.full((TRACE_LEN,), np.nan, np.float32)
     A = B = None
@@ -533,11 +540,12 @@ def _fit_rowsharded_checkpointed(Xd, H0, W0, mesh, axis, beta, tol, h_tol,
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "axis", "beta", "n_passes", "chunk_max_iter",
-                     "l1_H", "l2_H", "l1_W", "l2_W", "telemetry"),
+                     "l1_H", "l2_H", "l1_W", "l2_W", "telemetry",
+                     "kl_newton"),
 )
 def _fit_rowsharded_jit(X, H0, W0, mesh, axis, beta, tol, h_tol, n_passes,
                         chunk_max_iter, l1_H, l2_H, l1_W, l2_W,
-                        telemetry: bool = False):
+                        telemetry: bool = False, kl_newton: bool = False):
     out_specs = ((P(axis, None), P(), P()) if not telemetry
                  else (P(axis, None), P(), P(), P(), P(), P()))
 
@@ -549,7 +557,8 @@ def _fit_rowsharded_jit(X, H0, W0, mesh, axis, beta, tol, h_tol, n_passes,
     def run(X_local, H_local, W):
         out = _rowsharded_solve_local(
             X_local, H_local, W, axis, beta, tol, h_tol, n_passes,
-            chunk_max_iter, l1_H, l2_H, l1_W, l2_W, telemetry=telemetry)
+            chunk_max_iter, l1_H, l2_H, l1_W, l2_W, telemetry=telemetry,
+            kl_newton=kl_newton)
         if telemetry:
             H_local, W, err, trace, passes, nonfin = out
             return (H_local, W, err[None], trace, passes[None],
@@ -573,7 +582,7 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
                        alpha_H: float = 0.0, l1_ratio_H: float = 0.0,
                        n_orig: int | None = None, init: str = "random",
                        telemetry_sink=None, checkpoint=None,
-                       heartbeat=None):
+                       heartbeat=None, recipe=None):
     """Factorize a cells-sharded X over ``mesh`` (1-D). Returns
     ``(H (n,k), W (k,g), err)`` as numpy arrays.
 
@@ -594,6 +603,16 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
     the pass cursor at every pass boundary of the checkpointed loop —
     pass-granular liveness for the elastic layer (the fused program is
     a single dispatch, so it cannot beat mid-run).
+
+    ``recipe``: the resolved :class:`~cnmf_torch_tpu.ops.recipe.
+    SolverRecipe` (ISSUE 9) — its ``kl_newton`` field threads the
+    Diagonalized-Newton β=1 usage solves into the pass program; the
+    ``amu`` repeat schedule is native here (the pass loop already
+    repeats the cheap usage solve per W update). ``None`` resolves from
+    the env knobs (default: plain MU, byte-identical programs). The
+    engaged recipe is labeled in the telemetry record, and callers fold
+    ``recipe.signature()`` into the checkpoint identity ``params`` so a
+    resumed run never splices two recipes' trajectories.
 
     ``X`` may be a host matrix (dense or CSR — streamed shard-by-shard to
     HBM without a host dense copy) or a device array already staged by
@@ -665,6 +684,25 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
     l1_W, l2_W = split_regularization(alpha_W, l1_ratio_W)
     l1_H, l2_H = split_regularization(alpha_H, l1_ratio_H)
 
+    if recipe is None:
+        from ..ops.recipe import resolve_recipe
+
+        recipe = resolve_recipe(beta, "rowshard",
+                                ell=isinstance(Xd, EllMatrix), n=int(n),
+                                g=int(g), k=int(k),
+                                ell_width=(Xd.width
+                                           if isinstance(Xd, EllMatrix)
+                                           else None))
+    if recipe.kl_newton and beta != 1.0:
+        # same contract as run_nmf/nmf_fit_batch: a caller-pinned dna
+        # recipe on a non-KL solve must fail loudly — silently running
+        # plain MU would leave telemetry and the checkpoint-identity
+        # signature describing math that never ran
+        raise ValueError(
+            f"recipe {recipe.label!r} requires beta=1 (KL), got "
+            f"beta={beta}")
+    kl_newton = bool(recipe.kl_newton)
+
     want_telem = False
     if telemetry_sink is not None:
         from ..utils.telemetry import telemetry_enabled
@@ -674,7 +712,8 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
         H, W, err, trace_np, passes, nonfin = _fit_rowsharded_checkpointed(
             Xd, H0, W0, mesh, axis, beta, float(tol), float(h_tol),
             int(n_passes), int(chunk_max_iter), l1_H, l2_H, l1_W, l2_W,
-            checkpoint, heartbeat=heartbeat, n_orig=n_orig)
+            checkpoint, heartbeat=heartbeat, n_orig=n_orig,
+            kl_newton=kl_newton)
         if want_telem:
             telemetry_sink({
                 "k": int(k), "beta": float(beta), "mode": "rowshard",
@@ -682,12 +721,13 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
                 "cadence": "pass", "trace": trace_np[None],
                 "iters": np.asarray([passes]),
                 "nonfinite": np.asarray([nonfin]),
-                "errs": np.asarray([err], np.float64)})
+                "errs": np.asarray([err], np.float64),
+                "recipe": recipe.label})
         return (np.asarray(H)[:n_orig], np.asarray(W), float(err))
     out = _fit_rowsharded_jit(
         Xd, H0, W0, mesh, axis, beta, jnp.float32(tol), jnp.float32(h_tol),
         int(n_passes), int(chunk_max_iter), l1_H, l2_H, l1_W, l2_W,
-        telemetry=want_telem)
+        telemetry=want_telem, kl_newton=kl_newton)
     H, W, err = out[:3]
     if want_telem:
         trace, passes, nonfin = out[3:]
@@ -695,7 +735,8 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
             "k": int(k), "beta": float(beta), "mode": "rowshard",
             "seeds": [int(seed)], "cap": int(n_passes), "cadence": "pass",
             "trace": trace[None], "iters": passes[None],
-            "nonfinite": nonfin[None], "errs": err[None]})
+            "nonfinite": nonfin[None], "errs": err[None],
+            "recipe": recipe.label})
     return (np.asarray(H)[:n_orig], np.asarray(W), float(err))
 
 

@@ -310,7 +310,8 @@ def _emit_fault(events, kind: str, context: dict):
         pass
 
 
-def _retrying(prep, context: str | None, events, heartbeat: dict | None = None):
+def _retrying(prep, context: str | None, events, heartbeat: dict | None = None,
+              cancelled: threading.Event | None = None):
     """Wrap a slab prep with the shard-granular retry policy: transient
     prep/transfer failures retry with bounded exponential backoff
     (``CNMF_TPU_SHARD_RETRIES`` / ``CNMF_TPU_SHARD_BACKOFF_S``) before
@@ -322,7 +323,13 @@ def _retrying(prep, context: str | None, events, heartbeat: dict | None = None):
     ``heartbeat[id(task)]`` at the start of every attempt — including
     after each backoff sleep — so the stall watchdog measures PER-ATTEMPT
     progress and legitimate retry/backoff time never masquerades as a
-    hang (the two knobs compose instead of conflicting)."""
+    hang (the two knobs compose instead of conflicting).
+
+    ``cancelled``: set by the pipeline when a stall conviction abandons
+    the worker threads — a thread that wakes from a hang (or from the
+    injected ``stall`` clause) afterwards must not start fresh prep work
+    against the dead pipeline (nothing will commit it, and a re-stage
+    may already be racing on the same source)."""
     retries = shard_retries()
     backoff = _env_float(SHARD_BACKOFF_ENV, 0.1, lo=0.0)
 
@@ -335,6 +342,11 @@ def _retrying(prep, context: str | None, events, heartbeat: dict | None = None):
                 heartbeat[id(task)] = time.monotonic()
             if attempt == 0:
                 _maybe_stall(context=context)
+            if cancelled is not None and cancelled.is_set():
+                raise ShardStallError(
+                    "staging call already aborted by the stall watchdog "
+                    "(context=%s, task=%s); abandoned worker skips fresh "
+                    "prep work" % (context, task))
             try:
                 return prep(task)
             except (ShardStallError, ShardUploadError, KeyboardInterrupt,
@@ -420,7 +432,9 @@ def run_pipeline(tasks, prep, commit, *, depth: int | None = None,
     # measures time since the slab's LAST attempt started, so retry
     # backoff sleeps (a different knob doing its job) never read as a hang
     heartbeat: dict = {}
-    prep = _retrying(prep, fault_context, events, heartbeat=heartbeat)
+    cancelled = threading.Event()
+    prep = _retrying(prep, fault_context, events, heartbeat=heartbeat,
+                     cancelled=cancelled)
 
     def await_result(task, fut):
         if stall_s <= 0:
@@ -467,7 +481,10 @@ def run_pipeline(tasks, prep, commit, *, depth: int | None = None,
     except ShardStallError:
         # a genuinely stalled worker cannot be joined without re-inheriting
         # the hang it was just converted from: abandon it (it finishes or
-        # dies with the relaunched process) and cancel the queue
+        # dies with the relaunched process) and cancel the queue; the
+        # cancelled flag stops an eventually-waking abandoned thread from
+        # starting fresh prep work against this dead pipeline
+        cancelled.set()
         ex.shutdown(wait=False, cancel_futures=True)
         raise
     except BaseException:

@@ -74,8 +74,14 @@ _DEFAULT_H_BUDGET = 256 << 20
 # digest, and the resolved solver-parameter signature — a mismatch on
 # any of them means the file describes a different solve (different
 # matrix OR different recipe) and is treated exactly like a torn
-# artifact. "params" is optional in ``meta`` (defaults to "") for
-# callers outside the pipeline.
+# artifact. The "params" signature includes the resolved SOLVER RECIPE
+# (ops/recipe.py: SolverRecipe.signature(), folded in by
+# models/cnmf.py): a CNMF_TPU_ACCEL/KL_NEWTON flip between runs changes
+# the convergence math itself, and a resume across it would splice a
+# plain-MU trajectory onto a Diagonalized-Newton one — the identity
+# mismatch makes such a resume restart the replicate instead (pinned by
+# tests/test_accel.py). "params" is optional in ``meta`` (defaults to
+# "") for callers outside the pipeline.
 _IDENTITY_KEYS = ("k", "iter", "seed", "attempt", "digest", "beta",
                   "params")
 

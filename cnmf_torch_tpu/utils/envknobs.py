@@ -69,6 +69,22 @@ REGISTRY: dict[str, Knob] = _knobs(
          "β∈{1,0} ELL sparse path: `0` force dense, `1` force ELL, a value "
          "in (0,1) replaces the auto density threshold (default 0.10, plus "
          "a width≤genes/8 ragged-row guard)"),
+    Knob("CNMF_TPU_ACCEL", "str", "`0`",
+         "iteration-count acceleration recipes (ISSUE 9): `0` pins plain "
+         "MU (programs byte-identical to a build without the recipe "
+         "layer), `1` forces acceleration wherever defined, `auto` "
+         "engages it for batch β∈{1,0} MU solves and derives amu/dna "
+         "from β — the chosen recipe lands in telemetry dispatch events, "
+         "provenance, and the checkpoint identity"),
+    Knob("CNMF_TPU_INNER_REPEATS", "int", "auto",
+         "accelerated-MU ρ (H sub-iterations per W update, arXiv "
+         "1107.5194); unset derives ρ from the static H-repeat vs "
+         "W-update cost ratio (n/g/k/ELL width), clamped to [2, 8]"),
+    Knob("CNMF_TPU_KL_NEWTON", "flag", "`1`",
+         "when acceleration is engaged, β=1 solves take the Diagonalized "
+         "Newton recipe (arXiv 1301.3389: diagonal-Hessian steps + "
+         "per-lane monotone MU fallback); `0` restricts engaged "
+         "acceleration to the MU repeat schedule"),
     Knob("CNMF_TPU_BF16_RATIO", "flag", "`1`",
          "bf16 X/WH/ratio intermediates for online KL/IS (1.78–2.09× on "
          "v5e); `0` restores strict f32 (announced once per process when "

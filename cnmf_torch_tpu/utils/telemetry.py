@@ -342,10 +342,14 @@ def replicate_records(payload) -> list[dict]:
     nonfin = np.asarray(payload["nonfinite"])
     errs = np.asarray(payload["errs"])
     cap = int(payload["cap"])
+    inner = (np.asarray(payload["inner_iters"])
+             if payload.get("inner_iters") is not None else None)
+    dna_fb = (np.asarray(payload["dna_fallback"])
+              if payload.get("dna_fallback") is not None else None)
     records = []
     for i, seed in enumerate(payload["seeds"]):
         tr = trace[i]
-        records.append({
+        rec = {
             "seed": int(seed),
             "err": float(errs[i]),
             "iters": int(iters[i]),
@@ -354,7 +358,15 @@ def replicate_records(payload) -> list[dict]:
             # NaN marks never-evaluated slots; what remains is the
             # objective trajectory at the solver's evaluation cadence
             "trace": [float(v) for v in tr[~np.isnan(tr)]],
-        })
+        }
+        # solver-recipe accounting (ISSUE 9; batch solvers only): total
+        # inner update applications, and the dna recipe's MU
+        # fallback-lane fraction — additive fields, absent elsewhere
+        if inner is not None:
+            rec["inner_iters"] = int(inner[i])
+        if dna_fb is not None:
+            rec["dna_fallback"] = round(float(dna_fb[i]), 4)
+        records.append(rec)
     return records
 
 
@@ -496,7 +508,10 @@ def summarize_events(events: list[dict]) -> dict:
             continue
         k = int(e["k"])
         ent = conv.setdefault(k, {"n": 0, "capped": 0, "nonfinite": 0,
-                                  "errs": [], "iters": []})
+                                  "errs": [], "iters": [], "recipes": set(),
+                                  "dna_fb": []})
+        if e.get("recipe"):
+            ent["recipes"].add(str(e["recipe"]))
         for rec in e["records"]:
             ent["n"] += 1
             ent["capped"] += bool(rec.get("capped"))
@@ -505,6 +520,9 @@ def summarize_events(events: list[dict]) -> dict:
             if isinstance(err, (int, float)) and math.isfinite(err):
                 ent["errs"].append(float(err))
             ent["iters"].append(int(rec.get("iters", 0)))
+            fb = rec.get("dna_fallback")
+            if isinstance(fb, (int, float)) and math.isfinite(fb):
+                ent["dna_fb"].append(float(fb))
     convergence = {}
     for k, ent in sorted(conv.items()):
         errs = ent["errs"]
@@ -513,6 +531,12 @@ def summarize_events(events: list[dict]) -> dict:
                "nonfinite": ent["nonfinite"],
                "mean_iters": round(sum(ent["iters"])
                                    / max(len(ent["iters"]), 1), 1)}
+        if ent["recipes"]:
+            # the engaged solver recipe(s) for this K (normally one)
+            row["recipe"] = "+".join(sorted(ent["recipes"]))
+        if ent["dna_fb"]:
+            row["dna_fallback_mean"] = round(
+                sum(ent["dna_fb"]) / len(ent["dna_fb"]), 4)
         if errs:
             lo, hi = min(errs), max(errs)
             med = sorted(errs)[len(errs) // 2]
@@ -695,18 +719,29 @@ def render_report(run_dir: str) -> str:
         lines.append("")
         lines.append("Replicate convergence (per K)")
         lines.append("-" * 29)
+        # recipe + dna-fallback columns (ISSUE 9): which convergence math
+        # ran, and — under the dna recipe — what fraction of lanes took
+        # the monotone MU fallback instead of the Newton step
+        any_fb = any(row.get("dna_fallback_mean") is not None
+                     for row in summary["convergence"].values())
         lines.append(f"  {'K':>4s} {'reps':>6s} {'capped':>8s} "
                      f"{'nonfin':>7s} {'mean it':>8s} {'err median':>12s} "
-                     f"{'rel spread':>11s}")
+                     f"{'rel spread':>11s} {'recipe':>12s}"
+                     + (f" {'dna fb':>7s}" if any_fb else ""))
         for k, row in summary["convergence"].items():
             med = row.get("err_median")
             spread = row.get("err_rel_spread")
-            lines.append(
+            fb = row.get("dna_fallback_mean")
+            line = (
                 f"  {k:>4s} {row['replicates']:>6d} "
                 f"{row['fraction_capped']:>7.1%} "
                 f"{row['nonfinite']:>7d} {row['mean_iters']:>8.1f} "
                 f"{(f'{med:.5g}' if med is not None else '-'):>12s} "
-                f"{(f'{spread:.2e}' if spread is not None else '-'):>11s}")
+                f"{(f'{spread:.2e}' if spread is not None else '-'):>11s} "
+                f"{row.get('recipe') or '-':>12s}")
+            if any_fb:
+                line += f" {(f'{fb:.1%}' if fb is not None else '-'):>7s}"
+            lines.append(line)
 
     if summary.get("faults") or summary.get("checkpoints"):
         lines.append("")

@@ -1,0 +1,116 @@
+"""Tier-1 accel parity smoke (ISSUE 9, wired in verify_tier1.sh).
+
+Runs a mini replicate sweep under each solver recipe — plain MU,
+accelerated-MU, Diagonalized Newton (β=1), and HALS (β=2) — and asserts:
+
+  * matched final objectives across the mu-family KL recipes (same
+    optimization problem, different iteration schemes: the accelerated
+    recipes must land within a small relative band of plain MU, and
+    never worse beyond it);
+  * HALS lands within the same band of batch MU on the Frobenius
+    objective;
+  * every engaged recipe is visible end-to-end in telemetry: the
+    ``dispatch`` events carry the full recipe context, the
+    ``replicates`` events carry the recipe label and (for dna) the
+    fallback-lane fraction, and the whole stream validates against the
+    event schema.
+
+Exit 0 on success; any assertion or schema failure exits nonzero and
+fails the gate.
+"""
+
+import os
+import sys
+import tempfile
+
+# package: sys.path[0] is scripts/, the package lives one level up
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["CNMF_TPU_TELEMETRY"] = "1"
+
+import numpy as np  # noqa: E402
+
+
+def fixture(n=200, g=80, k=4, seed=3):
+    rng = np.random.default_rng(seed)
+    usage = rng.dirichlet(np.ones(k) * 0.2, size=n)
+    spectra = rng.gamma(0.25, 1.0, size=(k, g)) * 40.0 / g
+    X = rng.poisson(usage @ spectra * 6.0).astype(np.float32)
+    X[X.sum(axis=1) == 0, 0] = 1.0
+    return X
+
+
+def main() -> int:
+    from cnmf_torch_tpu.ops.recipe import SolverRecipe, resolve_recipe
+    from cnmf_torch_tpu.parallel import replicate_sweep
+    from cnmf_torch_tpu.utils.telemetry import (EventLog, replicate_records,
+                                                summarize_events,
+                                                validate_events_file)
+
+    X = fixture()
+    seeds = [1, 2, 3]
+    tmp = tempfile.mkdtemp(prefix="accel_smoke_")
+    log = EventLog(os.path.join(tmp, "smoke.events.jsonl"))
+
+    payloads = {}
+
+    def run(label, beta_loss, recipe):
+        sink_box = []
+        _, _, errs = replicate_sweep(X, seeds, 4, beta_loss=beta_loss,
+                                     mode="batch", recipe=recipe,
+                                     telemetry_sink=sink_box.append)
+        assert np.isfinite(errs).all(), (label, errs)
+        log.emit("dispatch", decision="solver_recipe",
+                 context=recipe.as_context())
+        (pay,) = sink_box
+        assert pay.get("recipe") == recipe.label, (label, pay.get("recipe"))
+        log.emit("replicates", k=pay["k"], beta=pay["beta"],
+                 mode=pay["mode"], cap=int(pay["cap"]),
+                 cadence=pay["cadence"], recipe=pay["recipe"],
+                 records=replicate_records(pay))
+        payloads[label] = np.asarray(errs, np.float64)
+        print(f"[accel-smoke] {label:10s} errs={np.round(errs, 2)}")
+
+    run("mu", "kullback-leibler", SolverRecipe())
+    run("amu", "kullback-leibler",
+        SolverRecipe("amu", 3, False, "caller"))
+    run("dna", "kullback-leibler",
+        SolverRecipe("dna", 1, True, "caller"))
+    run("mu-f2", "frobenius", SolverRecipe())
+    run("hals", "frobenius", SolverRecipe("hals", 1, False, "caller"))
+
+    # matched final objectives: same problem, different iteration schemes
+    TOL = 2e-2
+    for label in ("amu", "dna"):
+        rel = np.abs(payloads[label] - payloads["mu"]) / payloads["mu"]
+        assert (rel < TOL).all(), (label, payloads[label], payloads["mu"])
+    rel = np.abs(payloads["hals"] - payloads["mu-f2"]) / payloads["mu-f2"]
+    assert (rel < TOL).all(), ("hals", payloads["hals"], payloads["mu-f2"])
+
+    # the auto lane resolves the documented recipes
+    assert resolve_recipe(1.0, "batch", accel="auto").label == "dna"
+    assert resolve_recipe(1.0, "batch").label == "mu"  # default: plain
+
+    # schema-valid stream + recipe/fallback visible in the summary
+    n_events = validate_events_file(log.path)
+    assert n_events >= 11, n_events  # manifest + 5x(dispatch+replicates)
+    from cnmf_torch_tpu.utils.telemetry import read_events
+
+    summary = summarize_events(read_events(log.path))
+    conv = summary["convergence"]["4"]
+    assert "dna" in conv["recipe"] and "hals" in conv["recipe"], conv
+    assert conv.get("dna_fallback_mean") is not None, conv
+    recipes_dispatched = [d["context"].get("recipe")
+                          for d in summary["dispatch"]
+                          if d.get("decision") == "solver_recipe"]
+    assert set(recipes_dispatched) == {"mu", "amu(rho=3)", "dna", "hals"}, \
+        recipes_dispatched
+    print(f"[accel-smoke] OK: {n_events} schema-valid events, recipes "
+          f"{sorted(set(recipes_dispatched))}, dna fallback "
+          f"{conv['dna_fallback_mean']:.1%}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -73,4 +73,19 @@ if [ "$rc" -eq 0 ]; then
     exit 1
   fi
 fi
+
+# accel parity smoke: a mini sweep under each solver recipe (plain MU /
+# accelerated-MU / Diagonalized-Newton KL / HALS) asserting matched
+# final objectives within tolerance and schema-valid dispatch +
+# replicates events carrying the engaged recipe (scripts/accel_smoke.py)
+if [ "$rc" -eq 0 ]; then
+  echo "[tier1] accel parity smoke (solver recipes: mu/amu/dna/hals) ..."
+  if timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      python scripts/accel_smoke.py; then
+    echo ACCEL_SMOKE=ok
+  else
+    echo ACCEL_SMOKE=fail
+    exit 1
+  fi
+fi
 exit $rc

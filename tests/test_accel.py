@@ -1,0 +1,392 @@
+"""Iteration-count acceleration layer (ISSUE 9): solver recipes, the
+Diagonalized-Newton KL safeguards, the accelerated-MU repeat schedule,
+recipe dispatch/telemetry plumbing, and the checkpoint identity pin."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from cnmf_torch_tpu.ops.nmf import (
+    _dna_h_step,
+    _dna_w_step,
+    beta_divergence,
+    nmf_fit_batch,
+    nmf_fit_batch_hals,
+    random_init,
+    run_nmf,
+)
+from cnmf_torch_tpu.ops.recipe import (
+    SolverRecipe,
+    auto_inner_repeats,
+    resolve_recipe,
+)
+from cnmf_torch_tpu.ops.sparse import csr_to_ell, ell_device_put, ell_w_table
+
+
+def _counts(n, g, k, seed, scale=6.0):
+    rng = np.random.default_rng(seed)
+    usage = rng.dirichlet(np.ones(k) * 0.2, size=n)
+    spectra = rng.gamma(0.25, 1.0, size=(k, g)) * 40.0 / g
+    X = rng.poisson(usage @ spectra * scale).astype(np.float32)
+    X[X.sum(axis=1) == 0, 0] = 1.0
+    return X
+
+
+def _sparse_counts(n=240, g=100, k=4, seed=11, scale=0.8):
+    X = _counts(n, g, k, seed, scale=scale)
+    return sp.csr_matrix(X)
+
+
+# ---------------------------------------------------------------------------
+# recipe resolution
+# ---------------------------------------------------------------------------
+
+class TestRecipeResolution:
+    def test_default_is_identity_plain_mu(self, monkeypatch):
+        monkeypatch.delenv("CNMF_TPU_ACCEL", raising=False)
+        rec = resolve_recipe(1.0, "batch")
+        assert rec.algo == "mu" and rec.is_identity
+        assert rec.label == "mu"
+
+    def test_auto_lane_picks_dna_for_kl_amu_for_is(self):
+        assert resolve_recipe(1.0, "batch", accel="auto").label == "dna"
+        amu = resolve_recipe(0.0, "batch", accel="auto")
+        assert amu.algo == "amu" and amu.inner_repeats >= 2
+        # auto stays off outside the batch lane (online/rowshard pass
+        # loops already repeat the cheap H solve per W update)
+        assert resolve_recipe(1.0, "online", accel="auto").is_identity
+        # forcing engages the dna lane wherever _chunk_h_solve runs
+        assert resolve_recipe(1.0, "online", accel="1").label == "dna"
+        assert resolve_recipe(1.0, "rowshard", accel="1").label == "dna"
+
+    def test_env_knobs_pin_fields(self, monkeypatch):
+        monkeypatch.setenv("CNMF_TPU_ACCEL", "1")
+        monkeypatch.setenv("CNMF_TPU_KL_NEWTON", "0")
+        monkeypatch.setenv("CNMF_TPU_INNER_REPEATS", "5")
+        rec = resolve_recipe(1.0, "batch")
+        assert rec.algo == "amu" and rec.inner_repeats == 5
+        monkeypatch.setenv("CNMF_TPU_ACCEL", "0")
+        assert resolve_recipe(1.0, "batch").is_identity
+        monkeypatch.setenv("CNMF_TPU_ACCEL", "bogus")
+        with pytest.raises(ValueError, match="CNMF_TPU_ACCEL"):
+            resolve_recipe(1.0, "batch")
+
+    def test_halsvar_maps_to_hals_recipe(self):
+        rec = resolve_recipe(2.0, "batch", algo="halsvar")
+        assert rec.algo == "hals" and rec.is_identity
+
+    def test_signature_distinguishes_recipes(self):
+        sigs = {SolverRecipe().signature(),
+                SolverRecipe("dna", 1, True, "env").signature(),
+                SolverRecipe("amu", 3, False, "env").signature(),
+                SolverRecipe("amu", 4, False, "env").signature(),
+                SolverRecipe("hals").signature()}
+        assert len(sigs) == 5
+
+    def test_auto_inner_repeats_cost_ratio(self):
+        # dense beta!=2: repeat == full WH pass -> the mild schedule
+        assert auto_inner_repeats(1.0, 1000, 500, 8) == 2
+        # ELL: repeats re-use the slab table -> one more
+        assert auto_inner_repeats(1.0, 1000, 500, 8, ell_width=64) == 3
+        # beta=2: repeats are k-sized against hoisted stats -> capped max
+        assert auto_inner_repeats(2.0, 1000, 500, 8) == 8
+        # width-free resolution (run_nmf resolves before staging) must
+        # land the same ELL schedule — the width cancels in the ratio
+        assert auto_inner_repeats(1.0, ell=True) == 3
+        assert auto_inner_repeats(1.0, 1000, 500, 8, ell=True) == 3
+        assert resolve_recipe(0.0, "batch", accel="1", kl_newton=False,
+                              ell=True).inner_repeats == 3
+        assert SolverRecipe("amu", auto_inner_repeats(1.0), False, "auto")
+
+
+# ---------------------------------------------------------------------------
+# satellite (a): DNA + fallback composite is monotone per outer step
+# ---------------------------------------------------------------------------
+
+class TestDnaMonotone:
+    @pytest.mark.parametrize("seed", [0, 7, 23])
+    def test_dense_composite_monotone(self, seed):
+        X = jnp.asarray(_counts(120, 60, 4, seed))
+        H, W = random_init(jax.random.key(seed), 120, 60, 4, jnp.mean(X))
+        step_h = jax.jit(lambda x, h, w: _dna_h_step(x, h, w, 0.0, 0.0))
+        step_w = jax.jit(lambda x, h, w: _dna_w_step(x, h, w, 0.0, 0.0))
+        err = float(beta_divergence(X, H, W, beta=1.0))
+        for _ in range(25):
+            H, _ = step_h(X, H, W)
+            W, _ = step_w(X, H, W)
+            err_new = float(beta_divergence(X, H, W, beta=1.0))
+            # strict per-outer-step monotonicity up to f32 evaluation noise
+            assert err_new <= err * (1 + 1e-6) + 1e-3, (err, err_new)
+            err = err_new
+
+    def test_ell_h_step_monotone_and_matches_dense(self):
+        Xs = _sparse_counts()
+        Xd = jnp.asarray(Xs.toarray())
+        ell = ell_device_put(csr_to_ell(Xs))
+        H, W = random_init(jax.random.key(5), Xs.shape[0], Xs.shape[1], 4,
+                           jnp.asarray(np.float32(Xs.mean())))
+        table = ell_w_table(W, ell.cols)
+        He, fbe = _dna_h_step(ell, H, W, 0.0, 0.0, w_table=table)
+        Hd, fbd = _dna_h_step(Xd, H, W, 0.0, 0.0)
+        # same math, nonzero-only evaluation: candidates agree to f32
+        np.testing.assert_allclose(np.asarray(He), np.asarray(Hd),
+                                   rtol=2e-4, atol=2e-4)
+        err0 = float(beta_divergence(ell, H, W, beta=1.0))
+        err1 = float(beta_divergence(ell, He, W, beta=1.0))
+        assert err1 <= err0 * (1 + 1e-6) + 1e-3
+
+    def test_solver_trace_monotone_and_fallback_reported(self):
+        X = jnp.asarray(_counts(150, 70, 4, 2))
+        H0, W0 = random_init(jax.random.key(9), 150, 70, 4, jnp.mean(X))
+        _, _, err, tm = nmf_fit_batch(X, H0, W0, beta=1.0, tol=0.0,
+                                      max_iter=80, telemetry=True,
+                                      kl_newton=True)
+        tr = np.asarray(tm.trace)
+        tr = tr[~np.isnan(tr)]
+        assert (np.diff(tr) <= np.abs(tr[:-1]) * 1e-6 + 1e-3).all(), tr
+        assert 0.0 <= float(tm.dna_fallback) <= 1.0
+        assert int(tm.inner_iters) == int(tm.iters)
+
+    def test_dna_converges_in_fewer_iterations_than_mu(self):
+        """The point of the recipe: outer iterations to a fixed KL
+        tolerance drop by >=1.5x vs plain MU (the bench measures 4-6x at
+        production shapes; this pins the property at test scale)."""
+        X = jnp.asarray(_counts(200, 90, 5, 1))
+        H0, W0 = random_init(jax.random.key(4), 200, 90, 5, jnp.mean(X))
+        cap = 300
+
+        def to_tol(kl_newton):
+            _, _, err, tm = nmf_fit_batch(X, H0, W0, beta=1.0, tol=0.0,
+                                          max_iter=cap, telemetry=True,
+                                          kl_newton=kl_newton)
+            return np.asarray(tm.trace), float(err)
+
+        tr_mu, err_mu = to_tol(False)
+        tr_dna, err_dna = to_tol(True)
+        target = min(err_mu, err_dna) * 1.001
+
+        def first_hit(tr):
+            tr = tr[~np.isnan(tr)]
+            hit = np.nonzero(tr <= target)[0]
+            return (hit[0] + 1) if len(hit) else len(tr)
+
+        assert first_hit(tr_mu) >= 1.5 * first_hit(tr_dna), (
+            first_hit(tr_mu), first_hit(tr_dna))
+
+
+# ---------------------------------------------------------------------------
+# satellite (b): accelerated-MU reaches a tighter objective in equal
+# outer iterations on the sparse fixture
+# ---------------------------------------------------------------------------
+
+def test_amu_tighter_objective_equal_outer_iterations():
+    Xs = _sparse_counts(n=300, g=140, k=5, seed=9, scale=0.7)
+    ell = ell_device_put(csr_to_ell(Xs))
+    H0, W0 = random_init(jax.random.key(3), Xs.shape[0], Xs.shape[1], 5,
+                         jnp.asarray(np.float32(Xs.mean())))
+
+    def err_at(rho, cap=40):
+        _, _, err, tm = nmf_fit_batch(ell, H0, W0, beta=1.0, tol=0.0,
+                                      max_iter=cap, telemetry=True,
+                                      inner_repeats=rho)
+        # the identity program (rho=1) carries no inner accumulator
+        inner = tm.inner_iters if tm.inner_iters is not None else tm.iters
+        return float(err), int(inner)
+
+    err_mu, inner_mu = err_at(1)
+    err_amu, inner_amu = err_at(3)
+    assert inner_mu == 40 and inner_amu > 40
+    assert err_amu <= err_mu, (err_amu, err_mu)
+
+
+# ---------------------------------------------------------------------------
+# satellite (c): CNMF_TPU_ACCEL=0 programs are byte-identical
+# ---------------------------------------------------------------------------
+
+class TestAccelOffByteIdentical:
+    def test_resolved_identity_recipe_hits_the_same_program_cache(
+            self, monkeypatch):
+        """The telemetry-flag guarantee style: with the knob off, the
+        sweep dispatches the EXACT lru_cache entry a build without the
+        recipe layer would (identity statics == the pre-layer defaults),
+        so the compiled executable is the same object, byte for byte."""
+        from cnmf_torch_tpu.parallel.replicates import (_recipe_statics,
+                                                        _sweep_program)
+
+        monkeypatch.setenv("CNMF_TPU_ACCEL", "0")
+        rec = resolve_recipe(1.0, "batch")
+        assert rec.is_identity
+        args = (100, 40, 4, 2, "random", "batch", 1.0, 1e-4, 1e-3, 100,
+                50, 20, 60, 0.0, 0.0, 0.0, 0.0, None, False)
+        prog_default = _sweep_program(*args)
+        prog_recipe = _sweep_program(*args, **_recipe_statics(rec))
+        assert prog_default is prog_recipe
+
+    def test_identity_lowering_matches_defaults(self):
+        """The jitted solver's lowered HLO with the identity recipe
+        explicitly passed equals the no-argument default lowering — no
+        inner while_loop, no Newton lanes, nothing."""
+        X = jnp.asarray(_counts(60, 30, 3, 0))
+        H0, W0 = random_init(jax.random.key(0), 60, 30, 3, jnp.mean(X))
+        base = nmf_fit_batch.lower(X, H0, W0, beta=1.0,
+                                   max_iter=20).as_text()
+        ident = nmf_fit_batch.lower(X, H0, W0, beta=1.0, max_iter=20,
+                                    inner_repeats=1,
+                                    kl_newton=False).as_text()
+        assert base == ident
+        # with telemetry on, the identity program must still carry NO
+        # inner/fallback accumulators (the pre-recipe-layer carry shape)
+        _, _, _, tm = nmf_fit_batch(X, H0, W0, beta=1.0, max_iter=5,
+                                    telemetry=True)
+        assert tm.inner_iters is None and tm.dna_fallback is None
+
+
+# ---------------------------------------------------------------------------
+# satellite (d): checkpoint resume across a recipe change restarts
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_resume_across_recipe_change_restarts(tmp_path):
+    from cnmf_torch_tpu.runtime.checkpoint import PassCheckpointer
+
+    path = tmp_path / "ckpt.k4.it0.npz"
+    k, g, n = 4, 30, 50
+
+    def meta(recipe_sig):
+        return {"k": k, "iter": 0, "seed": 1, "attempt": 0,
+                "digest": "deadbeef", "beta": 1.0,
+                "params": f"tol=1e-4,{recipe_sig}"}
+
+    mu_sig = SolverRecipe().signature()
+    dna_sig = SolverRecipe("dna", 1, True, "env").signature()
+    writer = PassCheckpointer(path, 1, meta=meta(mu_sig))
+    writer.save(pass_idx=3, err_prev=2.0, err=1.5,
+                trace=np.full(8, np.nan, np.float32),
+                W=np.ones((k, g), np.float32),
+                A=np.zeros((k, g), np.float32),
+                B=np.zeros((k, k), np.float32),
+                H=np.ones((n, k), np.float32))
+
+    # same recipe: resume trusts the file
+    same = PassCheckpointer(path, 1, meta=meta(mu_sig), resume=True)
+    state = same.load(n_rows=n, n_genes=g)
+    assert state is not None and int(state["pass_idx"]) == 3
+
+    # recipe change (mu -> dna): identity mismatch, replicate restarts
+    writer.save(pass_idx=3, err_prev=2.0, err=1.5,
+                trace=np.full(8, np.nan, np.float32),
+                W=np.ones((k, g), np.float32),
+                A=np.zeros((k, g), np.float32),
+                B=np.zeros((k, k), np.float32))
+    with pytest.warns(RuntimeWarning, match="failed validation"):
+        changed = PassCheckpointer(path, 1, meta=meta(dna_sig), resume=True)
+        assert changed.load(n_rows=n, n_genes=g) is None
+    assert not path.exists()  # discarded, not silently spliced
+
+
+# ---------------------------------------------------------------------------
+# HALS recipe wiring (satellite: dispatch site + sklearn parity)
+# ---------------------------------------------------------------------------
+
+class TestHalsRecipe:
+    def test_hals_batch_matches_sklearn_cd(self):
+        """sklearn's 'cd' solver IS coordinate descent on the Frobenius
+        objective — the same family as HALS. From the same init both
+        must land at near-identical objectives."""
+        sklearn = pytest.importorskip("sklearn.decomposition")
+        X = _counts(150, 60, 4, 13, scale=20.0)
+        Xj = jnp.asarray(X)
+        H0, W0 = random_init(jax.random.key(2), 150, 60, 4, jnp.mean(Xj))
+        H, W, err = nmf_fit_batch_hals(Xj, H0, W0, tol=1e-6, max_iter=400)
+        model = sklearn.NMF(n_components=4, init="custom", solver="cd",
+                            tol=1e-6, max_iter=400)
+        # np.array copies: sklearn's cd solver writes in place, and
+        # buffers exported from jax arrays are read-only
+        Wsk = model.fit_transform(X, W=np.array(H0, X.dtype),
+                                  H=np.array(W0, X.dtype))
+        err_sk = 0.5 * np.linalg.norm(X - Wsk @ model.components_) ** 2
+        assert float(err) <= err_sk * 1.02, (float(err), err_sk)
+
+    def test_hals_recipe_dispatches_through_sweeps(self, monkeypatch):
+        from cnmf_torch_tpu.parallel import replicate_sweep
+
+        X = _counts(120, 50, 4, 3, scale=12.0)
+        monkeypatch.setenv("CNMF_TPU_TELEMETRY", "1")
+        pays = []
+        spectra, _, errs = replicate_sweep(
+            X, [1, 2], 4, mode="batch",
+            recipe=SolverRecipe("hals", 1, False, "caller"),
+            telemetry_sink=pays.append)
+        assert spectra.shape == (2, 4, 50) and np.isfinite(errs).all()
+        assert pays[0]["recipe"] == "hals"
+        # the hals objective is at least as good as plain batch MU's
+        _, _, errs_mu = replicate_sweep(X, [1, 2], 4, mode="batch")
+        assert (errs <= errs_mu * 1.01).all(), (errs, errs_mu)
+
+    def test_hals_recipe_rejects_kl(self):
+        from cnmf_torch_tpu.parallel import replicate_sweep
+
+        with pytest.raises(ValueError, match="[Ff]robenius"):
+            replicate_sweep(_counts(60, 30, 3, 1), [1], 3,
+                            beta_loss="kullback-leibler", mode="batch",
+                            recipe=SolverRecipe("hals", 1, False, "caller"))
+
+
+# ---------------------------------------------------------------------------
+# dispatch plumbing: run_nmf, online/rowshard dna, payload fields
+# ---------------------------------------------------------------------------
+
+class TestRecipeDispatch:
+    def test_run_nmf_recipe_objective_parity(self):
+        X = _counts(150, 60, 4, 21)
+        errs = {}
+        for rec in (None, SolverRecipe("dna", 1, True, "caller"),
+                    SolverRecipe("amu", 3, False, "caller")):
+            label = "mu" if rec is None else rec.label
+            _, _, errs[label] = run_nmf(
+                X, 4, beta_loss="kullback-leibler", mode="batch",
+                random_state=5, batch_max_iter=200, recipe=rec)
+        base = errs.pop("mu")
+        for label, e in errs.items():
+            assert abs(e - base) / base < 2e-2, (label, e, base)
+
+    def test_run_nmf_dna_rejects_wrong_beta(self):
+        with pytest.raises(ValueError, match="beta=1"):
+            run_nmf(_counts(60, 30, 3, 1), 3, beta_loss="frobenius",
+                    mode="batch",
+                    recipe=SolverRecipe("dna", 1, True, "caller"))
+
+    def test_rowshard_dna_matches_mu_class(self):
+        from jax.sharding import Mesh
+
+        from cnmf_torch_tpu.parallel.rowshard import nmf_fit_rowsharded
+
+        X = _counts(200, 60, 4, 8)
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("cells",))
+        _, _, err_mu = nmf_fit_rowsharded(
+            X, 4, mesh, beta_loss="kullback-leibler", seed=3, n_passes=8)
+        _, _, err_dna = nmf_fit_rowsharded(
+            X, 4, mesh, beta_loss="kullback-leibler", seed=3, n_passes=8,
+            recipe=SolverRecipe("dna", 1, True, "caller"))
+        assert np.isfinite(err_dna)
+        assert err_dna <= err_mu * 1.02, (err_dna, err_mu)
+
+    def test_payload_and_records_carry_recipe_accounting(self, monkeypatch):
+        from cnmf_torch_tpu.parallel import replicate_sweep
+        from cnmf_torch_tpu.utils.telemetry import replicate_records
+
+        monkeypatch.setenv("CNMF_TPU_TELEMETRY", "1")
+        X = _counts(120, 50, 4, 3)
+        pays = []
+        replicate_sweep(X, [1, 2], 4, beta_loss="kullback-leibler",
+                        mode="batch",
+                        recipe=SolverRecipe("dna", 1, True, "caller"),
+                        telemetry_sink=pays.append)
+        (pay,) = pays
+        assert pay["recipe"] == "dna"
+        recs = replicate_records(pay)
+        assert all("inner_iters" in r and "dna_fallback" in r for r in recs)
+        assert all(0.0 <= r["dna_fallback"] <= 1.0 for r in recs)

@@ -254,6 +254,34 @@ def test_stall_watchdog_excludes_retry_backoff(monkeypatch):
     assert seen == [0, 1, 2, 3]
 
 
+def test_abandoned_worker_skips_fresh_prep_after_stall(monkeypatch):
+    """A worker thread that wakes from a hang after the stall watchdog
+    abandoned the pipeline must not start fresh prep work: nothing will
+    ever commit it, and it races whatever re-stage replaced the call.
+    (The injected ``stall`` clause sleeps before prep, so the waking
+    zombie used to densify its slab seconds later — inside whichever
+    unrelated test happened to be running by then.)"""
+    import time
+
+    from cnmf_torch_tpu.parallel.streaming import ShardStallError
+
+    monkeypatch.setenv("CNMF_TPU_FAULT_SPEC",
+                       "stall:context=zomb,seconds=0.8")
+    monkeypatch.setenv(streaming.STALL_ENV, "0.2")
+    ran = []
+
+    def prep(i):
+        ran.append(i)
+        return i
+
+    with pytest.raises(ShardStallError):
+        run_pipeline(range(4), prep, lambda i, p: None, depth=2, threads=1,
+                     fault_context="zomb")
+    n_at_abort = len(ran)
+    time.sleep(1.2)   # past the injected wake
+    assert len(ran) == n_at_abort, "abandoned worker started fresh prep"
+
+
 def test_stall_fault_injection_through_staging(mesh, monkeypatch):
     """The `stall` chaos clause (runtime/faults.py) fires inside a real
     staging call and the watchdog converts it into ShardStallError within
