@@ -68,10 +68,10 @@ _CHIP_PEAKS = {
 }
 
 TIERS = ["north_star", "anchor", "kl", "accel", "mfu", "rowshard",
-         "harmony"]
+         "ingest", "harmony"]
 TIER_TIMEOUT_S = {"north_star": 2400, "anchor": 1200, "kl": 1800,
                   "accel": 1200, "mfu": 900, "rowshard": 1500,
-                  "harmony": 1500}
+                  "ingest": 1200, "harmony": 1500}
 
 
 def synthetic_pbmc_like(n=2700, g=2000, k_true=12, seed=0, scale=400.0):
@@ -941,6 +941,97 @@ def bench_rowshard():
     }
 
 
+def bench_ingest():
+    """ISSUE 10 tier: out-of-core shard-store ingestion. Measures the
+    prepare-side store write, the disk->host->device streamed staging
+    (read GB/s + disk/h2d overlap + host slab-residency peak vs the
+    budget), and the slab-looped pass wall against the resident pass —
+    plus the process RSS peak, the signal the "host footprint bounded by
+    the budget, not matrix size" claim is judged by."""
+    import tempfile
+
+    import jax
+    import scipy.sparse as sp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from cnmf_torch_tpu.parallel.rowshard import nmf_fit_rowsharded
+    from cnmf_torch_tpu.parallel.streaming import (StreamStats,
+                                                   stream_store_sharded)
+    from cnmf_torch_tpu.utils import shardstore
+
+    n, g, density = 200_000, 2000, 0.05
+    rng = np.random.default_rng(17)
+    X = sp.random(n, g, density=density, format="csr", random_state=7,
+                  data_rvs=lambda size: rng.gamma(2.0, 1.0, size).astype(
+                      np.float32)).astype(np.float32)
+    csr_bytes = int(X.data.nbytes + X.indices.nbytes + X.indptr.nbytes)
+    budget = 256 << 20
+    os.environ[shardstore.OOC_BUDGET_ENV] = str(budget)
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("cells",))
+    out = {"cells": n, "genes": g, "csr_gb": round(csr_bytes / 1e9, 3),
+           "budget_bytes": budget}
+    store_dir = tempfile.mkdtemp(prefix="bench_ingest_store_")
+    try:
+        t0 = time.perf_counter()
+        shardstore.write_shard_store(store_dir, X)
+        write_s = time.perf_counter() - t0
+        store = shardstore.open_shard_store(store_dir)
+        out.update(
+            store_write_seconds=round(write_s, 3),
+            store_write_gb_per_s=round(store.store_bytes / 1e9 / write_s, 2),
+            store_bytes=int(store.store_bytes),
+            slabs=len(store.slabs))
+
+        # streamed resident staging: disk -> host prep -> h2d pipeline
+        stats = StreamStats()
+        sharding = NamedSharding(mesh, P("cells", None))
+        cursor = shardstore.SlabCursor(store)
+        t0 = time.perf_counter()
+        Xd = stream_store_sharded(cursor, sharding, stats=stats)
+        _device_sync(Xd)
+        stage_s = time.perf_counter() - t0
+        out.update(
+            stage_seconds=round(stage_s, 3),
+            stage_dense_equiv_gb_per_s=round(n * g * 4 / 1e9 / stage_s, 2),
+            disk_read_gb_per_s=round(stats.read_gb_per_s(), 2),
+            disk_read_seconds=round(stats.disk_s, 3),
+            overlap_fraction=round(stats.overlap_fraction, 3),
+            host_peak_bytes=int(stats.host_peak_bytes),
+            host_peak_under_budget=bool(stats.host_peak_bytes <= budget))
+
+        # resident pass wall (store-backed staging, bit-identical programs)
+        n_passes = 3
+        nmf_fit_rowsharded(Xd, 9, mesh, seed=1, n_passes=1, n_orig=n)
+        t0 = time.perf_counter()
+        _, _, err = nmf_fit_rowsharded(Xd, 9, mesh, seed=2,
+                                       n_passes=n_passes, n_orig=n)
+        resident_s = time.perf_counter() - t0
+        assert np.isfinite(err)
+        del Xd
+
+        # slab-looped pass wall: per-device shard forced over the budget
+        # so every pass re-streams X group-wise from the store
+        os.environ[shardstore.OOC_SHARD_BYTES_ENV] = str(budget // 4)
+        try:
+            t0 = time.perf_counter()
+            _, _, err2 = nmf_fit_rowsharded(store, 9, mesh, seed=2,
+                                            n_passes=n_passes)
+            ooc_s = time.perf_counter() - t0
+        finally:
+            os.environ.pop(shardstore.OOC_SHARD_BYTES_ENV, None)
+        assert np.isfinite(err2)
+        out.update(
+            resident_pass_seconds=round(resident_s, 3),
+            slab_loop_pass_seconds=round(ooc_s, 3),
+            slab_loop_overhead_x=round(ooc_s / max(resident_s, 1e-9), 2),
+            host_rss_peak_bytes=int(shardstore.host_rss_peak_bytes()),
+            telemetry=_tier_telemetry())
+        return out
+    finally:
+        os.environ.pop(shardstore.OOC_BUDGET_ENV, None)
+        shardstore.remove_store(store_dir)
+
+
 def bench_harmony():
     """Config 4 shape (Baron islets: ~8.5k cells, 4 donors): Preprocess
     (HVG -> PCA -> Harmony -> gene-space MOE ridge) -> cNMF e2e."""
@@ -1060,7 +1151,8 @@ def main():
         enable_persistent_compilation_cache()
         fn = {"north_star": bench_north_star, "anchor": bench_anchor,
               "kl": bench_kl, "accel": bench_accel, "mfu": bench_mfu,
-              "rowshard": bench_rowshard, "harmony": bench_harmony}[args.tier]
+              "rowshard": bench_rowshard, "ingest": bench_ingest,
+              "harmony": bench_harmony}[args.tier]
         result = fn()
         with open(args.out, "w") as f:
             json.dump(result, f)

@@ -517,26 +517,34 @@ def run_pipeline(counts: str, output_dir: str, name: str,
         obj.k_selection_plot(close_fig=True)
 
     if clean:
-        # the reference's `rm .../cnmf_tmp/*.iter_*.df.npz`
-        # (run_parallel.py:64): per-replicate spectra are redundant once
-        # merged_spectra exists. Also sweep pid-suffixed atomic-write
-        # temp files orphaned by killed workers (utils/anndata_lite
-        # .atomic_artifact) — no reader ever trusts them, but they
-        # accumulate across preemptions; all workers have exited by here,
-        # so none are live.
-        run_dir = os.path.join(output_dir, name)
-        for pattern in (os.path.join("cnmf_tmp", "*.iter_*.df.npz"),
-                        # pass checkpoints are normally discarded when
-                        # their replicate's artifact lands; a worker that
-                        # exhausted its respawn budget can leave one behind
-                        os.path.join("cnmf_tmp", "*.ckpt.k_*.npz"),
-                        # liveness stamps (CNMF_TPU_HEARTBEAT_S) are
-                        # meaningful only while their writer is alive
-                        os.path.join("cnmf_tmp", "*.heartbeat.*.json"),
-                        # atomic-write temp orphans land wherever their
-                        # artifact lives: intermediates in cnmf_tmp/, the
-                        # txt/stats finals in the run dir itself
-                        os.path.join("cnmf_tmp", "*.tmp-*"),
-                        "*.tmp-*"):
-            for f in glob.glob(os.path.join(run_dir, pattern)):
-                os.remove(f)
+        _clean_run_dir(os.path.join(output_dir, name))
+
+
+def _clean_run_dir(run_dir: str):
+    """The reference's `rm .../cnmf_tmp/*.iter_*.df.npz`
+    (run_parallel.py:64): per-replicate spectra are redundant once
+    merged_spectra exists. Also sweep pid-suffixed atomic-write temp
+    files orphaned by killed workers (utils/anndata_lite
+    .atomic_artifact) — no reader ever trusts them, but they accumulate
+    across preemptions; all workers have exited by here, so none are
+    live. The shard store itself SURVIVES (a prepare artifact, reusable
+    on resume — and under CNMF_TPU_OOC=1 the only copy of the matrix);
+    only its temp orphans are swept."""
+    for pattern in (os.path.join("cnmf_tmp", "*.iter_*.df.npz"),
+                    # pass checkpoints are normally discarded when
+                    # their replicate's artifact lands; a worker that
+                    # exhausted its respawn budget can leave one behind
+                    os.path.join("cnmf_tmp", "*.ckpt.k_*.npz"),
+                    # liveness stamps (CNMF_TPU_HEARTBEAT_S) are
+                    # meaningful only while their writer is alive
+                    os.path.join("cnmf_tmp", "*.heartbeat.*.json"),
+                    # atomic-write temp orphans land wherever their
+                    # artifact lives: intermediates in cnmf_tmp/, the
+                    # txt/stats finals in the run dir itself, shard-store
+                    # slabs inside the store directory (ISSUE 10)
+                    os.path.join("cnmf_tmp", "*.tmp-*"),
+                    os.path.join("cnmf_tmp", "*.norm_counts.store",
+                                 "*.tmp-*"),
+                    "*.tmp-*"):
+        for f in glob.glob(os.path.join(run_dir, pattern)):
+            os.remove(f)

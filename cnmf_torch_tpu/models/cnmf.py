@@ -300,7 +300,13 @@ class cNMF:
                 tpm.var.index[gene_stats.high_var.values])
 
         norm_counts = counts[:, high_variance_genes_filter].copy()
-        norm_counts.X = norm_counts.X.astype(np.float64)
+        # no f64 working copy (ISSUE 10 satellite): the old
+        # ``astype(np.float64)`` here doubled prepare's peak host memory
+        # for a matrix every solver consumes as f32/bf16. Float64 now
+        # lives ONLY in the column-moment accumulators (ops/stats.py) and
+        # the per-quotient division; the stored values are the f32
+        # rounding of the exact f64 quotients — bit-identical to staging
+        # the old f64 artifact (integer counts are f32-exact).
 
         n = counts.X.shape[0]
         sub_var1 = None
@@ -314,7 +320,8 @@ class cNMF:
             # (sc.pp.scale semantics, cnmf.py:675)
             norm_counts.X, _ = scale_columns(norm_counts.X, ddof=1,
                                              zero_std_to_one=True,
-                                             precomputed_var=sub_var1)
+                                             precomputed_var=sub_var1,
+                                             out_dtype=np.float32)
             if np.isnan(norm_counts.X.data).sum() > 0:
                 print("Warning NaNs in normalized counts matrix")
         else:
@@ -322,7 +329,8 @@ class cNMF:
             # only warns (cnmf.py:679)
             norm_counts.X, _ = scale_columns(norm_counts.X, ddof=1,
                                              zero_std_to_one=False,
-                                             precomputed_var=sub_var1)
+                                             precomputed_var=sub_var1,
+                                             out_dtype=np.float32)
             if np.isnan(norm_counts.X).sum().sum() > 0:
                 print("Warning NaNs in normalized counts matrix")
 
@@ -341,9 +349,161 @@ class cNMF:
         return norm_counts
 
     def save_norm_counts(self, norm_counts):
+        """Persist the normalized matrix: the h5ad artifact and/or the
+        out-of-core row-slab shard store (ISSUE 10, utils/shardstore.py).
+
+        ``CNMF_TPU_OOC=auto`` (default) additionally writes the store
+        when the matrix's host footprint exceeds the slab budget —
+        factorize workers then stream only their own row-range slabs
+        from disk instead of each materializing the full matrix.
+        ``=1`` forces the store AND makes it authoritative: the h5ad
+        normalized-counts copy is SKIPPED (the two used to double-write
+        the matrix), with the fallback noted loudly here and in the
+        factorize provenance. ``=0`` keeps the h5ad-only legacy path.
+        A store the current mode does not write is REMOVED — a stale
+        store from an earlier prepare must never hijack factorize."""
+        from ..utils import shardstore
+
         # a re-prepare invalidates any consensus-stage device residency
         self._dev_cache.clear()
-        write_h5ad(self.paths["normalized_counts"], norm_counts)
+        mode = shardstore.ooc_mode()
+        write_store = mode == "1" or (
+            mode == "auto"
+            and shardstore.host_matrix_bytes(norm_counts.X)
+            > shardstore.ooc_budget_bytes())
+        # remove-store -> write-h5ad -> write-store ordering: each write
+        # is individually atomic, so a crash at ANY point leaves a
+        # consistent pair — h5ad-only, store-only (OOC=1), or both from
+        # the same prepare. A stale store can then only predate this
+        # protocol (or be tampered with), which worker 0's fresh-run
+        # sweep catches via the metadata cross-check (_store_stale).
+        shardstore.remove_store(self.paths["shard_store"])
+        if mode == "1" and write_store:
+            # the store is authoritative: skip the h5ad double-write (a
+            # second full copy of the matrix on disk + a second full
+            # serialization pass). Remove any stale copy so no reader
+            # can fall back to an older prepare's matrix.
+            print("prepare: CNMF_TPU_OOC=1 — normalized counts live in "
+                  "the shard store only (h5ad copy skipped); consensus "
+                  "and legacy readers assemble from the store.")
+            try:
+                os.unlink(self.paths["normalized_counts"])
+            except OSError:
+                pass
+        else:
+            write_h5ad(self.paths["normalized_counts"], norm_counts)
+        if write_store:
+            with self._timer.stage("prepare.shard_store"):
+                shardstore.write_shard_store(
+                    self.paths["shard_store"], norm_counts.X,
+                    obs_names=norm_counts.obs.index,
+                    var_names=norm_counts.var.index, events=self._events)
+
+    def _probe_store(self):
+        """The shard store for this run, or ``None`` (absent, invalid, or
+        ``CNMF_TPU_OOC=0``)."""
+        from ..utils import shardstore
+
+        if shardstore.ooc_mode() == "0":
+            return None
+        store, _reason = shardstore.probe_shard_store(
+            self.paths["shard_store"])
+        return store
+
+    def _read_norm_counts(self, store=None):
+        """The normalized counts as an AnnDataLite: the h5ad when it
+        exists, else assembled from the shard store (the authoritative
+        source under ``CNMF_TPU_OOC=1``) — loudly, since assembly
+        materializes the full matrix on host and callers above the slab
+        budget should stream instead."""
+        if os.path.exists(self.paths["normalized_counts"]):
+            return read_h5ad(self.paths["normalized_counts"])
+        if store is None:
+            store = self._probe_store()
+        if store is None:
+            from ..utils import shardstore
+
+            # a store directory that EXISTS but failed validation, with
+            # no h5ad to fall back to, deserves its own diagnosis — the
+            # raw h5ad FileNotFoundError would point at the wrong artifact
+            _, reason = shardstore.probe_shard_store(
+                self.paths["shard_store"])
+            if reason is not None and reason != "missing":
+                raise shardstore.TornShardError(
+                    "normalized counts are unreadable: the h5ad copy is "
+                    "absent (store-authoritative prepare) and the shard "
+                    "store failed validation — re-run prepare. (%s)"
+                    % reason)
+            # no store and no h5ad: surface the h5ad error path callers
+            # have always seen
+            return read_h5ad(self.paths["normalized_counts"])
+        warnings.warn(
+            "normalized_counts h5ad is absent (CNMF_TPU_OOC=1 store-"
+            "authoritative prepare); assembling the full matrix from the "
+            "shard store on host — streaming consumers should pass the "
+            "store instead", RuntimeWarning, stacklevel=2)
+        return self._store_anndata(store, with_matrix=True)
+
+    @staticmethod
+    def _store_anndata(store, with_matrix=False):
+        """AnnDataLite view of a shard store: metadata always (shape +
+        obs/var names — what factorize's dispatch and artifact writers
+        need); the matrix itself only on request (``with_matrix`` — the
+        fits-in-budget path), otherwise an all-zero CSR placeholder of
+        the right shape that no solver ever consumes."""
+        X = (store.to_matrix() if with_matrix
+             else sp.csr_matrix(store.shape, dtype=np.float32))
+        obs = pd.DataFrame(index=pd.Index(store.obs_names()
+                                          or [str(i) for i in
+                                              range(store.shape[0])]))
+        var = pd.DataFrame(index=pd.Index(store.var_names()
+                                          or [str(j) for j in
+                                              range(store.shape[1])]))
+        return AnnDataLite(X, obs=obs, var=var)
+
+    def _store_stale(self, store) -> bool:
+        """True when the store disagrees with the current prepare's h5ad
+        on shape or gene index — metadata-only reads on both sides, so
+        the check never materializes a matrix. (``save_norm_counts``
+        orders remove-store -> write-h5ad -> write-store, so a crash can
+        only leave consistent pairs; this catches pre-crash debris and
+        manual tampering.) With no h5ad the store is authoritative
+        (``CNMF_TPU_OOC=1``) and never stale by this test."""
+        from ..utils.anndata_lite import peek_h5ad_shape, peek_h5ad_var_names
+
+        path = self.paths["normalized_counts"]
+        if not os.path.exists(path):
+            return False
+        try:
+            if peek_h5ad_shape(path) != store.shape:
+                return True
+            h5_var = peek_h5ad_var_names(path)
+            return (h5_var is not None
+                    and list(h5_var) != list(store.var_names()))
+        except Exception as exc:
+            warnings.warn(
+                "shard store staleness probe failed (%s); treating the "
+                "store as stale" % (exc,), RuntimeWarning, stacklevel=2)
+            return True
+
+    def _sweep_stale_store(self, store) -> bool:
+        """Worker 0's fresh-run sweep (ISSUE 10 satellite): remove
+        orphaned shard-store atomic-write temps, and delete a store whose
+        manifest mismatches the current prepare so it can never hijack
+        this run's ingestion. True when the store was removed (callers
+        must fall back to the h5ad)."""
+        from ..utils import shardstore
+
+        shardstore.sweep_store_temps(self.paths["shard_store"])
+        if store is not None and self._store_stale(store):
+            warnings.warn(
+                "shard store at %s does not match the current prepare's "
+                "normalized_counts h5ad — removing the stale store "
+                "(factorize falls back to the h5ad)"
+                % self.paths["shard_store"], RuntimeWarning, stacklevel=2)
+            shardstore.remove_store(self.paths["shard_store"])
+            return True
+        return False
 
     # ------------------------------------------------------------------
     # replicate ledger + solver config
@@ -520,7 +680,21 @@ class cNMF:
         from ..runtime import faults, resilience
 
         run_params = load_df_from_npz(self.paths["nmf_replicate_parameters"])
-        norm_counts = read_h5ad(self.paths["normalized_counts"])
+        # out-of-core ingestion (ISSUE 10, utils/shardstore.py): when a
+        # shard store exists (and CNMF_TPU_OOC != 0), factorize defers
+        # materializing the matrix — the rowshard/2-D paths stream slabs
+        # straight from disk with host residency bounded by
+        # CNMF_TPU_OOC_BUDGET_BYTES, and only the resident solver paths
+        # load/assemble the full matrix (below, once dispatch is known)
+        store = self._probe_store()
+        if store is not None:
+            norm_counts = self._store_anndata(store)
+        elif os.path.exists(self.paths["normalized_counts"]):
+            norm_counts = read_h5ad(self.paths["normalized_counts"])
+        else:
+            # no valid store AND no h5ad: _read_norm_counts raises the
+            # torn-store diagnosis (or the classic h5ad error)
+            norm_counts = self._read_norm_counts()
         with open(self.paths["nmf_run_parameters"]) as f:
             _nmf_kwargs = yaml.load(f, Loader=yaml.FullLoader)
 
@@ -538,6 +712,14 @@ class cNMF:
                 resilience.sweep_stale_ledgers(
                     self.paths["resilience_ledger"],
                     max(int(total_workers), 1))
+                # ISSUE 10 satellite: also sweep shard-store debris — a
+                # killed prepare's atomic-write temps, and a stale store
+                # whose manifest no longer matches the current prepare
+                # (it must never hijack this run's ingestion)
+                if self._sweep_stale_store(store):
+                    store = None
+                    norm_counts = read_h5ad(
+                        self.paths["normalized_counts"])
         else:
             # torn-artifact-proof resume: probe AND validate the on-disk
             # artifacts of this worker's own ledger shard. The persisted
@@ -597,6 +779,17 @@ class cNMF:
         if skip_completed_runs:
             for ctx in deferred_torn:
                 self._events.emit("fault", kind="torn_artifact", context=ctx)
+        if store is not None:
+            # emitted only now: the FIRST emit flushes the telemetry
+            # manifest, which must carry the ledger block set just above
+            self._events.emit(
+                "dispatch", decision="ooc_ingest",
+                context={"slabs": len(store.slabs),
+                         "store_bytes": int(store.store_bytes),
+                         "format": store.format,
+                         "rows": int(store.n_rows),
+                         "h5ad_present": os.path.exists(
+                             self.paths["normalized_counts"])})
 
         # 2-D replicates x cells mesh (multi-host layout, parallel/multihost):
         # mesh="2d" auto-builds it; a Mesh with those two axes routes as-is
@@ -608,7 +801,8 @@ class cNMF:
             if mesh == "2d":
                 mesh = mesh_2d()
             self._factorize_2d(jobs, run_params, norm_counts, _nmf_kwargs,
-                               mesh, worker_i, replicates_per_batch)
+                               mesh, worker_i, replicates_per_batch,
+                               store=store)
             return
 
         # quarantine + reseeded-retry bookkeeping (runtime/resilience.py):
@@ -691,8 +885,15 @@ class cNMF:
                                        _nmf_kwargs, mesh, worker_i,
                                        guard=guard,
                                        resume=skip_completed_runs,
-                                       heartbeat=heartbeat)
+                                       heartbeat=heartbeat, store=store)
             return
+
+        if store is not None:
+            # resident solver paths (batched/sequential) need the matrix
+            # on host: the h5ad when prepare kept it (bit-identical, no
+            # store read), else assembled from the store (CNMF_TPU_OOC=1,
+            # loud — streaming consumers take the rowshard path above)
+            norm_counts = self._read_norm_counts(store)
 
         if not batched:
             _credit_completed(jobs)
@@ -1221,7 +1422,7 @@ class cNMF:
 
     def _factorize_rowsharded(self, jobs, run_params, norm_counts,
                               nmf_kwargs, mesh, worker_i, guard=None,
-                              resume=False, heartbeat=None):
+                              resume=False, heartbeat=None, store=None):
         """Atlas-scale factorize: cells sharded over the mesh, replicates
         sequential. X streams host→HBM once (shard-sized CSR blocks, no host
         dense copy) and is reused by every replicate; padded rows contribute
@@ -1249,6 +1450,7 @@ class cNMF:
 
         from ..parallel.streaming import (ShardStallError, ShardUploadError,
                                           StreamStats)
+        from ..utils.shardstore import TornShardError
         from ..runtime import checkpoint as ckpt_mod
         from ..runtime import elastic, faults, resilience
 
@@ -1278,24 +1480,56 @@ class cNMF:
         elastic_on = (elastic.elastic_enabled()
                       and jax.process_count() == 1)
 
+        rs_beta = beta_loss_to_float(nmf_kwargs["beta_loss"])
+
         def _stage(mesh_):
             """Stage (or re-stage, after a degraded re-mesh) X onto
-            ``mesh_`` through the streaming engine."""
+            ``mesh_`` through the streaming engine. Store-backed runs
+            (ISSUE 10) stream slabs straight from disk — host residency
+            bounded by the slab budget, staged array bit-identical to the
+            in-memory path; a shard over the per-device resident budget
+            skips staging entirely and returns the STORE, which
+            ``nmf_fit_rowsharded`` runs as a slab-looped pass per solve."""
             stage_stats = StreamStats() if self._events.enabled else None
             try:
-                Xd_, n_orig_ = prepare_rowsharded(norm_counts.X, mesh_,
-                                                  stats=stage_stats,
-                                                  events=self._events,
-                                                  liveness=heartbeat)
-            except (ShardUploadError, ShardStallError) as exc:
-                # exhausted/stalled shards land in the PR-4 ledger before
-                # the abort: the staged array cannot be completed, so
-                # there is no degraded mode here — but the audit trail
-                # (and the launcher's respawn, which re-stages) must see
-                # WHY the worker died
+                if store is not None:
+                    from ..parallel.rowshard import store_dispatch
+
+                    # force_dense: this path stages dense like its
+                    # in-memory twin (store-backed runs stay BIT-identical
+                    # to in-memory runs on the same ledger), so the
+                    # resident-budget decision is sized with dense bytes
+                    _, slab_loop = store_dispatch(
+                        store, mesh_, rs_beta,
+                        init=nmf_kwargs.get("init", "random"),
+                        force_dense=True)
+                    if slab_loop:
+                        print("[Worker %d]. Store-backed shard exceeds "
+                              "the per-device resident budget — running "
+                              "slab-looped out-of-core passes "
+                              "(CNMF_TPU_OOC_SHARD_BYTES)." % worker_i)
+                        return store, store.n_rows
+                    Xd_, n_orig_ = prepare_rowsharded(
+                        store, mesh_, stats=stage_stats,
+                        events=self._events, liveness=heartbeat)
+                else:
+                    Xd_, n_orig_ = prepare_rowsharded(norm_counts.X, mesh_,
+                                                      stats=stage_stats,
+                                                      events=self._events,
+                                                      liveness=heartbeat)
+            except (ShardUploadError, ShardStallError,
+                    TornShardError) as exc:
+                # exhausted/stalled shards (and store slabs that failed
+                # digest validation past the retry budget) land in the
+                # PR-4 ledger before the abort: the staged array cannot
+                # be completed, so there is no degraded mode here — but
+                # the audit trail (and the launcher's respawn, which
+                # re-stages) must see WHY the worker died
                 guard.record_shard_fault(
                     "shard_stall" if isinstance(exc, ShardStallError)
-                    else "shard_upload_failed",
+                    else ("shard_read_torn"
+                          if isinstance(exc, TornShardError)
+                          else "shard_upload_failed"),
                     {"stage": "rowshard_stage_x", "error": str(exc)})
                 guard.finalize()
                 raise
@@ -1318,13 +1552,15 @@ class cNMF:
         # natively); resolved once, recorded in dispatch + provenance,
         # and pinned into the checkpoint identity below
         from ..ops.recipe import resolve_recipe as _resolve_recipe
+        from ..ops.sparse import EllMatrix as _EllMatrix
 
-        rs_beta = beta_loss_to_float(nmf_kwargs["beta_loss"])
         # algo pinned to 'mu': the sharded pass implements the MU family
-        # only (the ledger's algo was already among its ignored keys)
+        # only (the ledger's algo was already among its ignored keys).
+        # A store handed back by _stage (the slab-looped deep tier) runs
+        # the dense pass program — only an EllMatrix means ELL kernels.
         recipe = _resolve_recipe(
             rs_beta, "rowshard", algo="mu",
-            ell=not isinstance(Xd, jax.Array),
+            ell=isinstance(Xd, _EllMatrix),
             n=int(norm_counts.X.shape[0]), g=int(norm_counts.X.shape[1]),
             k=max((int(run_params.iloc[i]["n_components"]) for i in jobs),
                   default=None))
@@ -1344,22 +1580,35 @@ class cNMF:
              "solver_recipe": recipe.label,
              "kl_newton": bool(recipe.kl_newton),
              "mesh_devices": int(np.prod(mesh.devices.shape)),
+             "ooc_ingest": (None if store is None else
+                            ("slab_loop" if not isinstance(
+                                Xd, (jax.Array, _EllMatrix))
+                             else "store_resident")),
              "ledger_keys_ignored": ["mode", "online_chunk_size"]})
 
         # mid-run checkpoint policy: cadence from the env (0 disables —
         # the solver then compiles the exact pre-checkpoint fused
-        # programs); the input digest pins a checkpoint to THIS matrix
+        # programs); the input digest pins a checkpoint to THIS matrix.
+        # Store-backed runs pin the STORE digest instead (ISSUE 10): it
+        # folds every slab's content digest, so a resume across a
+        # re-prepare (new store) restarts instead of splicing two
+        # matrices' trajectories — and the placeholder AnnData a
+        # store-authoritative run carries is never hashed.
         ckpt_every = ckpt_mod.ckpt_every_passes()
-        beta_val = beta_loss_to_float(nmf_kwargs["beta_loss"])
-        digest = (ckpt_mod.input_digest(norm_counts.X) if ckpt_every > 0
-                  else None)
+        beta_val = rs_beta
+        if ckpt_every <= 0:
+            digest = None
+        elif store is not None:
+            digest = "store:" + store.store_digest
+        else:
+            digest = ckpt_mod.input_digest(norm_counts.X)
         # resolved-solver-recipe signature: pins the checkpoint to the
         # SETTINGS it was computed under, not just the matrix — a
         # re-prepare with different iteration caps/regularization, or a
         # knob flip that swaps the convergence math (plain MU vs the dna
         # Newton lane), must restart the replicate, never splice two
         # recipes' trajectories
-        params_sig = repr(sorted({
+        params_base = {
             "init": str(nmf_kwargs.get("init", "random")),
             "tol": float(nmf_kwargs.get("tol", 1e-4)),
             "n_passes": int(n_passes_eff),
@@ -1370,7 +1619,23 @@ class cNMF:
             "alpha_H": float(nmf_kwargs.get("alpha_H", 0.0)),
             "l1_ratio_H": float(nmf_kwargs.get("l1_ratio_H", 0.0)),
             "recipe": recipe.signature(),
-        }.items()))
+        }
+
+        def _params_sig():
+            """Identity signature including the ENGAGED ingest tier: the
+            slab-looped pass is block-coordinate (group-wise H, online W
+            flavor) while the resident pass solves each shard jointly —
+            a respawn whose shard-budget decision flipped (a different
+            CNMF_TPU_OOC_SHARD_BYTES, or the device-derived default
+            moving with free memory) must RESTART the replicate, never
+            splice one tier's trajectory into the other's algorithm.
+            Read from the live topo cell so an elastic re-mesh that flips
+            the tier invalidates the old cursor too."""
+            tier = ("slab_loop"
+                    if not isinstance(topo["Xd"], (jax.Array, _EllMatrix))
+                    else "resident")
+            return repr(sorted(dict(params_base,
+                                    ingest_tier=tier).items()))
 
         def _make_ckpt(k_c, it_c, seed_c, attempt=0, force_resume=False):
             """Checkpoint policy for one (k, iter) solve. Retry attempts
@@ -1404,7 +1669,7 @@ class cNMF:
                 path, ckpt_every,
                 meta={"k": int(k_c), "iter": int(it_c), "seed": int(seed_c),
                       "attempt": int(attempt), "digest": digest,
-                      "beta": float(beta_val), "params": params_sig},
+                      "beta": float(beta_val), "params": _params_sig()},
                 events=self._events, worker=worker_i,
                 resume=(bool(resume or force_resume) if int(attempt) == 0
                         else True))
@@ -1424,7 +1689,10 @@ class cNMF:
                 l1_ratio_H=nmf_kwargs.get("l1_ratio_H", 0.0),
                 n_orig=n_orig,
                 telemetry_sink=self._emit_replicates_event,
-                checkpoint=ckpt, heartbeat=heartbeat, recipe=recipe)
+                checkpoint=ckpt, heartbeat=heartbeat, recipe=recipe,
+                events=self._events,
+                store_slab_loop=not isinstance(
+                    topo["Xd"], (jax.Array, _EllMatrix)))
             return np.asarray(spectra), err
 
         def _remesh_after_loss(exc):
@@ -1523,7 +1791,8 @@ class cNMF:
                                 worker_i)
 
     def _factorize_2d(self, jobs, run_params, norm_counts, nmf_kwargs,
-                      mesh, worker_i, replicates_per_batch=None):
+                      mesh, worker_i, replicates_per_batch=None,
+                      store=None):
         """Factorize over the 2-D (replicates, cells) mesh — the multi-host
         layout (``parallel/multihost.py``): each replicate row-shards its
         cells over the mesh's cell axis (psum'd W statistics on ICI), the
@@ -1549,7 +1818,12 @@ class cNMF:
             heartbeat.beat(phase="stage_x_2d", force=True)
         elastic_on = elastic.elastic_enabled()
 
-        Xd = stage_x_2d(norm_counts.X, mesh, events=self._events,
+        # store-backed pods (ISSUE 10): each process streams ONLY the
+        # store slabs overlapping its addressable cell shards from disk
+        # — stage_x_2d's _shard_slices enumerates addressable devices, so
+        # no process ever materializes the full matrix in host RAM
+        x_src = store if store is not None else norm_counts.X
+        Xd = stage_x_2d(x_src, mesh, events=self._events,
                         liveness=heartbeat)
         _, n_passes_eff, _ = resolve_online_schedule(
             beta_loss_to_float(nmf_kwargs["beta_loss"]), 0.05,
@@ -1635,7 +1909,7 @@ class cNMF:
                                   k),
                         RuntimeWarning, stacklevel=2)
                     _delete_staged(Xd)
-                    Xd = stage_x_2d(norm_counts.X, mesh,
+                    Xd = stage_x_2d(x_src, mesh,
                                     events=self._events,
                                     liveness=heartbeat)
                     self._events.emit(
@@ -2044,7 +2318,11 @@ class cNMF:
                 and int(k) <= _packed_dims[1]):
             _packed_dims = None  # partial-run ledger over-estimate: fall back
         if norm_counts is None:
-            norm_counts = read_h5ad(self.paths["normalized_counts"])
+            # under a store-authoritative prepare (CNMF_TPU_OOC=1) the
+            # h5ad is absent: assemble from the store — bit-identical
+            # (slabs are row slices of the same buffers), and consensus
+            # operates on the resident matrix like always
+            norm_counts = self._read_norm_counts()
 
         density_threshold_str = str(density_threshold)
         if skip_density_and_return_after_stats:
@@ -2332,7 +2610,7 @@ class cNMF:
         import concurrent.futures
 
         run_params = load_df_from_npz(self.paths["nmf_replicate_parameters"])
-        norm_counts = read_h5ad(self.paths["normalized_counts"])
+        norm_counts = self._read_norm_counts()
         ks_sorted = sorted(set(run_params.n_components))
         if not ks_sorted:
             raise ValueError(

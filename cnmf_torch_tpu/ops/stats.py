@@ -259,7 +259,7 @@ def normalize_total(adata, target_sum: float = 1e6, inplace: bool = False,
 
 
 def scale_columns(X, ddof: int = 1, zero_std_to_one: bool = True,
-                  precomputed_var=None):
+                  precomputed_var=None, out_dtype=None):
     """Scale columns to unit variance WITHOUT centering.
 
     ``zero_std_to_one=True`` mirrors ``sc.pp.scale(zero_center=False)``
@@ -271,6 +271,13 @@ def scale_columns(X, ddof: int = 1, zero_std_to_one: bool = True,
     ``precomputed_var``: per-column variance ALREADY at the requested ddof
     (prepare threads it from its one staged moment pass; the scaling itself
     is then a single O(nnz) host op).
+
+    ``out_dtype`` (ISSUE 10 satellite): land the scaled values at this
+    dtype while every quotient is still computed in float64 — the result
+    is the ``out_dtype`` rounding of the exact f64 division, identical to
+    casting the old f64 output, but the full-size f64 matrix never exists
+    (the division streams through bounded blocks into the preallocated
+    output). ``None`` keeps the legacy f64 result.
     """
     if precomputed_var is not None:
         var = np.asarray(precomputed_var, dtype=np.float64)
@@ -288,11 +295,33 @@ def scale_columns(X, ddof: int = 1, zero_std_to_one: bool = True,
     if sp.issparse(X):
         Xcsr = X.tocsr()
         with np.errstate(divide="ignore", invalid="ignore"):
-            data = Xcsr.data / div[Xcsr.indices]
+            if out_dtype is None:
+                data = Xcsr.data / div[Xcsr.indices]
+            else:
+                # blocked f64 divide cast into the preallocated output:
+                # the transient is one block, not an nnz-sized f64 copy
+                data = np.empty(Xcsr.data.shape, dtype=out_dtype)
+                step = 1 << 24
+                for lo in range(0, data.size, step):
+                    hi = min(lo + step, data.size)
+                    np.divide(Xcsr.data[lo:hi].astype(np.float64,
+                                                      copy=False),
+                              div[Xcsr.indices[lo:hi]], out=data[lo:hi],
+                              casting="unsafe")
         out = sp.csr_matrix((data, Xcsr.indices.copy(), Xcsr.indptr.copy()), shape=Xcsr.shape)
     else:
+        Xd = np.asarray(X)
         with np.errstate(divide="ignore", invalid="ignore"):
-            out = np.asarray(X) / div[None, :]
+            if out_dtype is None:
+                out = Xd / div[None, :]
+            else:
+                out = np.empty(Xd.shape, dtype=out_dtype)
+                step = max(1, (1 << 27) // max(Xd.shape[1] * 8, 1))
+                for lo in range(0, Xd.shape[0], step):
+                    hi = min(lo + step, Xd.shape[0])
+                    np.divide(Xd[lo:hi].astype(np.float64, copy=False),
+                              div[None, :], out=out[lo:hi],
+                              casting="unsafe")
     return out, std
 
 

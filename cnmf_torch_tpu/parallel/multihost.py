@@ -514,7 +514,14 @@ def stage_x_2d(X, mesh: Mesh, dtype=jnp.float32, events=None,
     cells axis, replicated over the replicate axis; one shard-sized CSR
     block densifies at a time (no whole-matrix host densify).
     ``liveness`` is stamped per committed slab (heartbeat — a long stage
-    must not read as a wedge at the next barrier)."""
+    must not read as a wedge at the next barrier).
+
+    ``X`` may also be a shard store or :class:`~cnmf_torch_tpu.utils.
+    shardstore.SlabCursor` (out-of-core ingestion, ISSUE 10): each pod
+    process then reads ONLY the store slabs overlapping its addressable
+    cell shards from disk — no process ever materializes the full matrix
+    in host RAM, which is exactly the N-hosts x full-matrix multiplier
+    the single-controller load path used to pay."""
     Xd, _pad = stream_rows_to_mesh(X, mesh, mesh.axis_names[1], dtype=dtype,
                                    events=events, liveness=liveness)
     return Xd

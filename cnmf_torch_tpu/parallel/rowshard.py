@@ -32,7 +32,9 @@ from .streaming import (
     StreamStats,
     _stream_csr_sharded,
     _stream_dense_sharded,
+    stream_store_sharded,
 )
+from ..utils.shardstore import ShardStore, SlabCursor
 
 from ..ops.nmf import (
     EPS,
@@ -61,7 +63,7 @@ from ..ops.sparse import (
 
 __all__ = ["nmf_fit_rowsharded", "fit_h_rowsharded", "refit_w_rowsharded",
            "pad_rows_to_mesh", "stream_rows_to_mesh", "stream_ell_to_mesh",
-           "prepare_rowsharded", "lane_health"]
+           "prepare_rowsharded", "lane_health", "store_dispatch"]
 
 
 def pad_rows_to_mesh(X, multiple: int):
@@ -112,6 +114,21 @@ def stream_rows_to_mesh(X, mesh: Mesh, axis: str, dtype=jnp.float32,
         raise ValueError(
             f"pad_multiple={multiple} must be a multiple of the mesh axis "
             f"size {n_shards} so shards stay equal-sized")
+    if isinstance(X, (ShardStore, SlabCursor)):
+        # out-of-core ingestion (ISSUE 10): rows stream straight from the
+        # shard store's per-slab files through the three-stage pipeline —
+        # the full matrix never exists in host RAM, and each process
+        # reads ONLY the slabs overlapping its addressable shards. The
+        # assembled device array is bit-identical to staging the
+        # in-memory matrix (values are placed, never summed).
+        cursor = (X if isinstance(X, SlabCursor)
+                  else SlabCursor(X, events=events))
+        n = cursor.n_rows
+        pad = (-n) % multiple
+        sharding = NamedSharding(mesh, P(axis, None))
+        return stream_store_sharded(cursor, sharding, dtype, stats=stats,
+                                    events=events, liveness=liveness,
+                                    pad_rows=pad), pad
     X, pad = pad_rows_to_mesh(X, multiple)
     sharding = NamedSharding(mesh, P(axis, None))
     if sp.issparse(X):
@@ -138,19 +155,60 @@ def stream_ell_to_mesh(X, mesh: Mesh, axis: str, width: int | None = None,
     The ELL width is the GLOBAL max row nnz (padded to a lane multiple) so
     every shard compiles one program at one static shape. Returns
     ``(EllMatrix with (n, width) leaves sharded P(axis, None), pad)``.
+
+    ``X`` may also be a :class:`~cnmf_torch_tpu.utils.shardstore.
+    ShardStore` (out-of-core ingestion): each shard's CSR rows assemble
+    from ONLY the slabs overlapping that shard — host residency is one
+    shard's CSR at a time (nnz-scaled), never the full matrix — and the
+    manifest's per-slab max-row-nnz gives the global ELL width without a
+    data pass. The converted leaves are bit-identical to the in-memory
+    path (same rows, same widths, same ``csr_to_ell``).
     """
-    if not sp.issparse(X):
-        raise TypeError("stream_ell_to_mesh takes a scipy-sparse matrix")
+    store = X if isinstance(X, ShardStore) else None
+    if store is None and not sp.issparse(X):
+        raise TypeError(
+            "stream_ell_to_mesh takes a scipy-sparse matrix or a ShardStore")
     n_shards = dict(mesh.shape)[axis]
     multiple = int(pad_multiple) if pad_multiple else n_shards
     if multiple % n_shards:
         raise ValueError(
             f"pad_multiple={multiple} must be a multiple of the mesh axis "
             f"size {n_shards} so shards stay equal-sized")
-    X, pad = pad_rows_to_mesh(X.tocsr(), multiple)
-    n, g = X.shape
-    if width is None:
-        width = ell_row_width(X)
+    if store is not None:
+        if store.format != "csr":
+            raise TypeError("stream_ell_to_mesh needs a CSR-format store")
+        n_data, g = store.shape
+        pad = (-n_data) % multiple
+        n = n_data + pad
+        nnz_total = store.nnz
+
+        def take_rows(lo, hi):
+            """Shard rows [lo, hi) as CSR — reads only overlapping slabs;
+            rows past the true row count are the mesh padding (zero)."""
+            parts = []
+            if lo < n_data:
+                parts.append(store.row_block(lo, min(hi, n_data),
+                                             events=events))
+            tail = hi - max(lo, n_data)
+            if tail > 0:
+                parts.append(sp.csr_matrix((tail, g), dtype=np.float32))
+            return (sp.vstack(parts).tocsr() if len(parts) > 1
+                    else parts[0].tocsr())
+
+        if width is None:
+            from ..ops.sparse import _pad_width
+
+            width = _pad_width(int(store.max_row_nnz) if n_data else 1)
+    else:
+        X, pad = pad_rows_to_mesh(X.tocsr(), multiple)
+        n, g = X.shape
+        nnz_total = X.nnz
+
+        def take_rows(lo, hi):
+            return X[lo:hi]
+
+        if width is None:
+            width = ell_row_width(X)
     # the GLOBAL transpose width must be derived from ALL shards, not just
     # this process's addressable ones: every process holds the same host
     # CSR and shards are equal row blocks, so scanning every block keeps
@@ -160,13 +218,32 @@ def stream_ell_to_mesh(X, mesh: Mesh, axis: str, width: int | None = None,
     # whole block's nnz) on this path.
     rows_per_shard = n // n_shards
     t_width = 8
-    if g and X.nnz:
-        ip = X.indptr
-        for s0 in range(0, n, rows_per_shard):
-            lo, hi = ip[s0], ip[min(s0 + rows_per_shard, n)]
-            if hi > lo:
-                t_width = max(t_width, int(np.bincount(
-                    X.indices[lo:hi], minlength=g).max()))
+    if g and nnz_total:
+        if store is not None:
+            # same per-shard column-count maxima, accumulated slab-wise
+            # (one pass over slab index arrays — no data assembly)
+            for s0 in range(0, n, rows_per_shard):
+                s1 = min(s0 + rows_per_shard, n_data)
+                if s1 <= s0:
+                    continue
+                counts = np.zeros((g,), dtype=np.int64)
+                for si in store.slab_indices_for_rows(s0, s1):
+                    blk = store.read_slab(si, events=events)
+                    meta = store.slabs[si]
+                    a = max(s0 - meta["row0"], 0)
+                    b = min(s1, meta["row1"]) - meta["row0"]
+                    seg = blk[a:b]
+                    if seg.nnz:
+                        counts += np.bincount(seg.indices, minlength=g)
+                if counts.size:
+                    t_width = max(t_width, int(counts.max()))
+        else:
+            ip = X.indptr
+            for s0 in range(0, n, rows_per_shard):
+                lo, hi = ip[s0], ip[min(s0 + rows_per_shard, n)]
+                if hi > lo:
+                    t_width = max(t_width, int(np.bincount(
+                        X.indices[lo:hi], minlength=g).max()))
     # one static transpose width across shards => one compiled program
     t_width = -(-t_width // 8) * 8
     sharding = NamedSharding(mesh, P(axis, None))
@@ -195,7 +272,8 @@ def stream_ell_to_mesh(X, mesh: Mesh, axis: str, width: int | None = None,
     def prep(dev):
         lo, hi = bounds[dev]
         t0 = time.perf_counter()
-        ell = csr_to_ell(X[lo:hi], width=int(width), t_width=int(t_width))
+        ell = csr_to_ell(take_rows(lo, hi), width=int(width),
+                         t_width=int(t_width))
         host = (ell.vals, ell.cols, ell.rows_t, ell.perm_t)
         t1 = time.perf_counter()
         parts = tuple(jax.device_put(a, dev) for a in host)
@@ -537,6 +615,352 @@ def _fit_rowsharded_checkpointed(Xd, H0, W0, mesh, axis, beta, tol, h_tol,
     return H, W, float(err), trace, it, nonfin
 
 
+def store_dispatch(store, mesh, beta, init: str = "random",
+                   force_dense: bool = False):
+    """How a store-backed rowshard solve will ingest on this mesh:
+    ``(use_ell, slab_loop)``. ``use_ell`` mirrors the in-memory dispatch
+    exactly (manifest density/width stand in for the host scan);
+    ``slab_loop`` is True when the per-device resident shard would
+    exceed the OOC shard budget AND the dense random-init lane (the only
+    one with a slab-looped pass program) applies.
+
+    ``force_dense`` (the model path): `cNMF._factorize_rowsharded` stages
+    DENSE like its in-memory twin (store-backed runs must stay
+    bit-identical to in-memory runs on the same ledger), so its budget
+    decision must be sized with dense shard bytes — sizing with ELL
+    bytes while staging dense would under-estimate the resident
+    footprint by the dense/ELL ratio in exactly the over-budget regime."""
+    n_dev = int(np.prod(mesh.devices.shape))
+    n = store.n_rows
+    per_dev_rows = max(-(-n // n_dev), 1) if n else 1
+    use_ell = False
+    w_ell = 0
+    if not force_dense and store.format == "csr" and init == "random":
+        from ..ops.sparse import _pad_width
+
+        w_ell = _pad_width(int(store.max_row_nnz) if n else 1)
+        use_ell = resolve_sparse_beta(beta, density=store.density,
+                                      width=w_ell, g=store.n_genes)
+    shard_bytes = per_dev_rows * (w_ell * 8 if use_ell
+                                  else store.n_genes * 4)
+    over = shard_bytes > _ooc_shard_budget_bytes()
+    if over and (use_ell or init != "random"):
+        import warnings
+
+        warnings.warn(
+            "shard store: per-device shard (%d bytes) exceeds the "
+            "resident budget but the %s lane has no slab-looped pass "
+            "program — staging resident anyway"
+            % (shard_bytes, "ELL" if use_ell else f"init={init!r}"),
+            RuntimeWarning, stacklevel=2)
+        over = False
+    return use_ell, over and beta in (2.0, 1.0, 0.0)
+
+
+def _ooc_shard_budget_bytes() -> int:
+    """Per-device resident-shard budget for store-backed solves:
+    ``CNMF_TPU_OOC_SHARD_BYTES`` when set, else the reported device
+    headroom (derated like the staged-refit budget; a conservative 8 GB
+    on backends without memory stats — CPU tests then always stage
+    resident unless the knob forces the slab loop)."""
+    from ..utils.shardstore import ooc_shard_bytes
+
+    explicit = ooc_shard_bytes()
+    if explicit > 0:
+        return explicit
+    return _staged_refit_budget_bytes()
+
+
+def _nmf_fit_rowsharded_ooc_entry(store, k, mesh, axis, beta, *, seed, tol,
+                                  h_tol, n_passes, chunk_max_iter, alpha_W,
+                                  l1_ratio_W, alpha_H, l1_ratio_H,
+                                  telemetry_sink=None, checkpoint=None,
+                                  heartbeat=None, recipe=None, events=None):
+    """Dispatch shim for the slab-looped tier: resolves regularization +
+    recipe exactly like the resident path, runs
+    :func:`_fit_rowsharded_ooc`, and emits the same telemetry payload
+    shape (``mode='rowshard-ooc'``)."""
+    l1_W, l2_W = split_regularization(alpha_W, l1_ratio_W)
+    l1_H, l2_H = split_regularization(alpha_H, l1_ratio_H)
+    n_orig, g = store.shape
+    if recipe is None:
+        from ..ops.recipe import resolve_recipe
+
+        recipe = resolve_recipe(beta, "rowshard", ell=False, n=int(n_orig),
+                                g=int(g), k=int(k))
+    if recipe.kl_newton and beta != 1.0:
+        raise ValueError(
+            f"recipe {recipe.label!r} requires beta=1 (KL), got "
+            f"beta={beta}")
+    ckpt = (checkpoint if checkpoint is not None
+            and getattr(checkpoint, "every", 0) > 0 else None)
+    stats = StreamStats()
+    H, W, err, trace_np, passes, nonfin = _fit_rowsharded_ooc(
+        store, int(k), mesh, axis, beta, int(seed), float(tol),
+        float(h_tol), int(n_passes), int(chunk_max_iter), l1_H, l2_H,
+        l1_W, l2_W, _ooc_shard_budget_bytes(), ckpt=ckpt,
+        heartbeat=heartbeat, kl_newton=bool(recipe.kl_newton),
+        events=events, stats=stats)
+    if events is not None:
+        try:
+            events.emit_stream("rowshard_ooc_passes", stats)
+        except Exception:
+            pass
+    if telemetry_sink is not None:
+        from ..utils.telemetry import telemetry_enabled
+
+        if telemetry_enabled():
+            telemetry_sink({
+                "k": int(k), "beta": float(beta), "mode": "rowshard-ooc",
+                "seeds": [int(seed)], "cap": int(n_passes),
+                "cadence": "pass", "trace": trace_np[None],
+                "iters": np.asarray([passes]),
+                "nonfinite": np.asarray([nonfin]),
+                "errs": np.asarray([err], np.float64),
+                "recipe": recipe.label})
+    return (np.asarray(H)[:n_orig], np.asarray(W), float(err))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "axis", "beta", "chunk_max_iter",
+                     "l1_H", "l2_H", "kl_newton"),
+)
+def _ooc_group_pass_jit(Xg, Hg, W, A, B, err_acc, mesh, axis, beta, h_tol,
+                        chunk_max_iter, l1_H, l2_H, kl_newton: bool = False):
+    """One GROUP's contribution to a slab-looped out-of-core pass
+    (ISSUE 10): solve this group's usage block with W frozen, then fold
+    its psum'd statistics into the carried accumulators — strictly
+    sequential adds across groups, so the pass is deterministic no matter
+    how the disk pipeline overlapped the staging.
+
+    beta=2 (``A``/``B`` carried): returns ``(Hg, A', B', err')`` — the
+    W-subproblem solves ONCE per pass from the accumulated stats
+    (``nmf_fit_online``'s block-coordinate flavor; the objective is
+    evaluated against the pass-start W). beta in {1, 0} (``A``/``B``
+    are numer/denom placeholders): returns the group's psum'd MU
+    numerator/denominator for the caller's per-group online W step."""
+    with_stats = beta == 2.0
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), P(), P(), P(), P()),
+        out_specs=(P(axis, None), P(), P(), P()),
+    )
+    def run(x, h, W, A, B, err_acc):
+        WWT = W @ W.T if with_stats else None
+        h = _chunk_h_solve(x, h, W, WWT, beta, l1_H, l2_H, chunk_max_iter,
+                           h_tol, kl_newton=kl_newton)
+        if with_stats:
+            A = A + jax.lax.psum(h.T @ x, axis)
+            B = B + jax.lax.psum(h.T @ h, axis)
+            err = err_acc + jax.lax.psum(
+                _beta_div_dense(x, h @ W, beta), axis)[None]
+            return h, A, B, err
+        WH = jnp.maximum(h @ W, EPS)
+        if beta == 1.0:
+            numer = jax.lax.psum(h.T @ (x / WH), axis)
+            denom = jnp.broadcast_to(
+                jax.lax.psum(h.sum(axis=0), axis)[:, None], W.shape)
+        else:  # beta == 0.0 (itakura-saito)
+            numer = jax.lax.psum(h.T @ (x / (WH * WH)), axis)
+            denom = jax.lax.psum(h.T @ (1.0 / WH), axis)
+        err = err_acc + jax.lax.psum(
+            _beta_div_dense(x, WH, beta), axis)[None]
+        return h, numer, denom, err
+
+    return run(Xg, Hg, W, A, B, err_acc)
+
+
+# l1_W/l2_W are static: _apply_rate branches on their truthiness in
+# Python (regularization is resolved once per solve, so one compile)
+_solve_w_from_stats_jit = jax.jit(
+    _solve_w_from_stats, static_argnames=("l1_W", "l2_W", "max_iter"))
+
+
+def _fit_rowsharded_ooc(store, k, mesh, axis, beta, seed, tol, h_tol,
+                        n_passes, chunk_max_iter, l1_H, l2_H, l1_W, l2_W,
+                        shard_budget, ckpt=None, heartbeat=None,
+                        kl_newton: bool = False, events=None,
+                        stats: StreamStats | None = None):
+    """Slab-looped out-of-core rowsharded solve: X NEVER becomes resident
+    — each pass streams slab GROUPS (per-device resident bytes bounded by
+    ``shard_budget``) from the shard store through the three-stage disk
+    pipeline, solves each group's usage block with W frozen, and
+    accumulates the same tiny ``(A, B)`` pass statistics the resident
+    pass psums (MPI-FAUN / distributed out-of-memory NMF: global factor
+    state + local data blocks). The usage matrix H stays resident as
+    per-group sharded blocks (k/g-fold smaller than X).
+
+    Semantics: the ONLINE solver's block-coordinate pass
+    (``ops/nmf.py:nmf_fit_online``) with the group as the chunk — beta=2
+    solves W once per pass from the accumulated statistics, beta in
+    {1, 0} takes one MU W step per group. Group granularity makes this
+    tier solver-tolerance-equivalent to the resident pass program, NOT
+    bit-identical (the resident shard solves its usage block jointly);
+    the store-backed RESIDENT path keeps bit-parity — this tier only
+    engages when the shard cannot be resident at all. Stopping rule,
+    pass caps, checkpoint protocol (``ckpt``: the full
+    ``PassCheckpointer`` contract incl. the H byte budget and the store
+    digest in the identity), heartbeat stamps, and the ``hostloss`` /
+    ``shard_read`` chaos hooks mirror ``_fit_rowsharded_checkpointed``.
+
+    Returns ``(H (n_pad, k) np, W np, err, trace, passes, nonfinite)``.
+    """
+    from ..runtime.faults import maybe_hostloss
+
+    n_orig, g = store.shape
+    n_dev = int(np.prod(mesh.devices.shape))
+    per_dev_rows = max(8, int(shard_budget) // max(g * 4, 1))
+    per_dev_rows = min(per_dev_rows, max(-(-n_orig // n_dev), 1))
+    group_rows = per_dev_rows * n_dev
+    n_groups = max(-(-n_orig // group_rows), 1)
+    n_pad = n_groups * group_rows
+
+    row_sh = NamedSharding(mesh, P(axis, None))
+    rep_sh = NamedSharding(mesh, P())
+    f32 = np.float32
+    h_tol_j = jnp.float32(h_tol)
+
+    def _split_h(H_full):
+        """(n_pad, k) host/device array -> per-group sharded blocks."""
+        out = []
+        for gi in range(n_groups):
+            blk = jnp.asarray(np.asarray(
+                H_full[gi * group_rows:(gi + 1) * group_rows], np.float32))
+            out.append(jax.device_put(blk, row_sh))
+        return out
+
+    key = jax.random.key(int(seed) & 0x7FFFFFFF)
+    # the manifest's exact f64 value sum stands in for the resident
+    # path's on-device mean — no data pass needed before the first slab
+    x_mean = jnp.float32(store.value_sum / max(n_pad * g, 1))
+    H0_full, W0 = random_init(key, n_pad, g, int(k), x_mean)
+    H_groups = _split_h(H0_full)
+    del H0_full
+    W = jax.device_put(W0, rep_sh)
+
+    def stage_group(gi):
+        lo = gi * group_rows
+        hi = min(lo + group_rows, n_orig)
+        cursor = SlabCursor(store, rows=(lo, hi), events=events)
+        return stream_store_sharded(
+            cursor, row_sh, jnp.float32, stats=stats, events=events,
+            liveness=heartbeat, pad_rows=group_rows - (hi - lo))
+
+    zero_A = jax.device_put(jnp.zeros((int(k), g), jnp.float32), rep_sh)
+    zero_B = jax.device_put(jnp.zeros((int(k), int(k)), jnp.float32),
+                            rep_sh)
+    zero_err = jax.device_put(jnp.zeros((1,), jnp.float32), rep_sh)
+
+    def one_pass(W):
+        A, B, err_acc = zero_A, zero_B, zero_err
+        for gi in range(n_groups):
+            Xg = stage_group(gi)
+            Hg, A, B, err_acc = _ooc_group_pass_jit(
+                Xg, H_groups[gi], W, A, B, err_acc, mesh, axis, beta,
+                h_tol_j, int(chunk_max_iter), l1_H, l2_H,
+                kl_newton=kl_newton)
+            if beta != 2.0:
+                # online flavor: one MU W step per group from its own
+                # statistics (cross-group accumulation would mix
+                # inconsistent (h, W) pairs — nmf_fit_online's contract)
+                W = _apply_rate(W, A, B, l1_W, l2_W, gamma=mu_gamma(beta))
+                A, B = zero_A, zero_B
+            jax.block_until_ready(Hg)
+            _delete_group(Xg)
+            H_groups[gi] = Hg
+            if heartbeat is not None:
+                heartbeat.beat(phase="ooc_group", cursor=gi)
+        if beta == 2.0:
+            W = _solve_w_from_stats_jit(W, A, B, l1_W, l2_W,
+                                        int(chunk_max_iter), h_tol_j)
+            return W, float(np.asarray(err_acc)[0]), A, B
+        return W, float(np.asarray(err_acc)[0]), None, None
+
+    trace = np.full((TRACE_LEN,), np.nan, np.float32)
+    A = B = None
+    ran_pass = False
+    state = (ckpt.load(n_rows_min=n_orig, n_genes=g)
+             if ckpt is not None and ckpt.every > 0 else None)
+    if state is not None:
+        W = jax.device_put(jnp.asarray(state["W"]), rep_sh)
+        if state["H"] is not None:
+            h_np = np.asarray(state["H"], np.float32)
+            if h_np.shape[0] > n_pad:
+                h_np = h_np[:n_pad]
+            elif h_np.shape[0] < n_pad:
+                h_np = np.pad(h_np, ((0, n_pad - h_np.shape[0]), (0, 0)))
+            H_groups = _split_h(h_np)
+        it = int(state["pass_idx"])
+        err_prev, err = f32(state["err_prev"]), f32(state["err"])
+        n_tr = min(len(state["trace"]), TRACE_LEN)
+        trace[:n_tr] = state["trace"][:n_tr]
+        A, B = state["A"], state["B"]
+    else:
+        W, err0, A, B = one_pass(W)
+        ran_pass = True
+        err = f32(err0)
+        err_prev = f32(err * f32(1.0 + 2.0 * tol) + f32(1.0))
+        it = 1
+        trace[0] = err
+
+    def _gather_h():
+        if n_pad * int(k) * 4 > ckpt.h_budget:
+            return None
+        return np.concatenate([np.asarray(Hg) for Hg in H_groups], axis=0)
+
+    def _save():
+        ckpt.save(pass_idx=it, err_prev=err_prev, err=err, trace=trace,
+                  W=np.asarray(W),
+                  A=(np.asarray(A) if A is not None
+                     else np.zeros((int(k), g), np.float32)),
+                  B=(np.asarray(B) if B is not None
+                     else np.zeros((int(k), int(k)), np.float32)),
+                  H=_gather_h())
+
+    def _pass_boundary():
+        if heartbeat is not None:
+            heartbeat.beat(phase="ooc_pass", cursor=it)
+        maybe_hostloss(context="pass")
+
+    ckpt_on = ckpt is not None and ckpt.every > 0
+    if ran_pass and ckpt_on and it % ckpt.every == 0 and ckpt.due():
+        _save()
+    _pass_boundary()
+
+    def active() -> bool:
+        if it >= int(n_passes):
+            return False
+        if it < 2:
+            return True
+        rel = (f32(err_prev) - f32(err)) / max(f32(err_prev), f32(EPS))
+        return bool(rel >= f32(tol))
+
+    while active():
+        W, err_new, A, B = one_pass(W)
+        ran_pass = True
+        err_prev, err = err, f32(err_new)
+        it += 1
+        trace[min(it - 1, TRACE_LEN - 1)] = err
+        if ckpt_on and it % ckpt.every == 0 and ckpt.due():
+            _save()
+        _pass_boundary()
+
+    H = np.concatenate([np.asarray(Hg) for Hg in H_groups], axis=0)
+    nonfin = not bool(np.isfinite(f32(err)))
+    return H, np.asarray(W), float(err), trace, it, nonfin
+
+
+def _delete_group(Xg):
+    """Free a staged group's device buffers ahead of the next group's
+    upload (best-effort; see ``models.cnmf._delete_staged``)."""
+    try:
+        Xg.delete()
+    except Exception:
+        pass
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "axis", "beta", "n_passes", "chunk_max_iter",
@@ -582,7 +1006,8 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
                        alpha_H: float = 0.0, l1_ratio_H: float = 0.0,
                        n_orig: int | None = None, init: str = "random",
                        telemetry_sink=None, checkpoint=None,
-                       heartbeat=None, recipe=None):
+                       heartbeat=None, recipe=None, events=None,
+                       store_slab_loop: bool = False):
     """Factorize a cells-sharded X over ``mesh`` (1-D). Returns
     ``(H (n,k), W (k,g), err)`` as numpy arrays.
 
@@ -638,10 +1063,37 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
         raise ValueError(
             f"nmf_fit_rowsharded supports beta in {{2, 1, 0}}, got {beta}")
     axis = mesh.axis_names[0]
+    ooc_deep = False
     if isinstance(X, (jax.Array, EllMatrix)):
         Xd = X
         if n_orig is None:
             n_orig = int(X.shape[0])
+    elif isinstance(X, ShardStore):
+        # out-of-core ingestion (ISSUE 10): X streams from the shard
+        # store. Under the per-device shard budget it stages RESIDENT
+        # through the disk pipeline — the assembled array (and therefore
+        # every downstream program) is bit-identical to the in-memory
+        # path; over the budget the dense random-init solve runs the
+        # slab-looped pass program instead (solver tolerance).
+        store = X
+        n_orig = store.n_rows
+        if store_slab_loop:
+            # the caller (cNMF._factorize_rowsharded) already sized the
+            # budget decision — with DENSE bytes, since its staging twin
+            # is dense — and handed the store over specifically for the
+            # slab-looped tier; re-deciding here with ELL sizing could
+            # disagree and silently re-stage resident once per replicate
+            use_ell, ooc_deep = False, True
+        else:
+            use_ell, ooc_deep = store_dispatch(store, mesh, beta,
+                                               init=init)
+        if ooc_deep:
+            Xd = None
+        elif use_ell:
+            Xd, _ = stream_ell_to_mesh(store, mesh, axis, events=events)
+        else:
+            Xd, _ = stream_rows_to_mesh(store, mesh, axis, events=events,
+                                        liveness=heartbeat)
     else:
         n_orig = int(X.shape[0])
         if (sp.issparse(X) and init == "random" and resolve_sparse_beta(
@@ -654,6 +1106,14 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
             Xd, _ = stream_ell_to_mesh(X, mesh, axis)
         else:
             Xd, _ = stream_rows_to_mesh(X, mesh, axis)
+    if ooc_deep:
+        return _nmf_fit_rowsharded_ooc_entry(
+            X, int(k), mesh, axis, beta, seed=seed, tol=tol, h_tol=h_tol,
+            n_passes=n_passes, chunk_max_iter=chunk_max_iter,
+            alpha_W=alpha_W, l1_ratio_W=l1_ratio_W, alpha_H=alpha_H,
+            l1_ratio_H=l1_ratio_H, telemetry_sink=telemetry_sink,
+            checkpoint=checkpoint, heartbeat=heartbeat, recipe=recipe,
+            events=events)
     n, g = Xd.shape
 
     key = jax.random.key(int(seed) & 0x7FFFFFFF)
@@ -1011,6 +1471,22 @@ def fit_h_rowsharded(X, W, mesh: Mesh, h_tol: float = 0.05,
         Xd = X
         if n_orig is None:
             n_orig = int(X.shape[0])
+    elif isinstance(X, ShardStore):
+        # store-backed refit: rows stream from disk (host-bounded), then
+        # the identical fixed-W solve runs on the resident sharded array
+        n_orig = X.n_rows
+        use_ell = False
+        if X.format == "csr":
+            from ..ops.sparse import _pad_width
+
+            use_ell = resolve_sparse_beta(
+                beta, density=X.density,
+                width=_pad_width(int(X.max_row_nnz) if n_orig else 1),
+                g=X.n_genes)
+        if use_ell:
+            Xd, _ = stream_ell_to_mesh(X, mesh, axis)
+        else:
+            Xd, _ = stream_rows_to_mesh(X, mesh, axis)
     else:
         n_orig = int(X.shape[0])
         if sp.issparse(X) and resolve_sparse_beta(

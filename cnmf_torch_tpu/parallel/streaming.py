@@ -91,7 +91,7 @@ __all__ = ["StreamStats", "SlabBufferPool", "run_pipeline", "nnz_bucket",
            "stream_threads", "stream_depth", "stream_to_device",
            "stream_put_leaves", "DENSIFY_SLAB_ROWS",
            "ShardStallError", "ShardUploadError",
-           "shard_retries", "stream_stall_s"]
+           "shard_retries", "stream_stall_s", "stream_store_sharded"]
 
 # rows per on-device scatter / dense slab. TPU scatter materializes
 # sort/workspace temporaries proportional to its OUTPUT, so densifying a
@@ -195,22 +195,31 @@ class StreamStats:
         self.wall_s = 0.0
         self.nbytes = 0
         self.slabs = 0
+        # disk-producer stage (out-of-core shard-store ingestion,
+        # utils/shardstore.py): read wall + bytes read from disk, and the
+        # host slab-residency high-water mark of the staging call
+        self.disk_s = 0.0
+        self.disk_nbytes = 0
+        self.host_peak_bytes = 0
 
     def add(self, host_prep_s=0.0, h2d_s=0.0, device_s=0.0, nbytes=0,
-            slabs=0):
+            slabs=0, disk_s=0.0, disk_nbytes=0):
         with self._lock:
             self.host_prep_s += host_prep_s
             self.h2d_s += h2d_s
             self.device_s += device_s
             self.nbytes += nbytes
             self.slabs += slabs
+            self.disk_s += disk_s
+            self.disk_nbytes += disk_nbytes
 
     @property
     def overlap_fraction(self) -> float:
         """How much of the phase work ran concurrently: 0 on the serial
         path (phase walls sum to the end-to-end wall), approaching 1 when
-        prep, transfer, and device work fully hide behind each other."""
-        busy = self.host_prep_s + self.h2d_s + self.device_s
+        disk read, prep, transfer, and device work fully hide behind each
+        other."""
+        busy = self.host_prep_s + self.h2d_s + self.device_s + self.disk_s
         if busy <= 0.0 or self.wall_s <= 0.0:
             return 0.0
         return max(0.0, min(1.0, 1.0 - self.wall_s / busy))
@@ -218,11 +227,20 @@ class StreamStats:
     def gb_per_s(self) -> float:
         return (self.nbytes / self.wall_s / 1e9) if self.wall_s > 0 else 0.0
 
+    def read_gb_per_s(self) -> float:
+        """Disk-read throughput of the producer stage (0 when the staging
+        call had no disk source)."""
+        return (self.disk_nbytes / self.disk_s / 1e9) if self.disk_s > 0 \
+            else 0.0
+
     def record_to(self, timer, prefix: str):
         """Write one row per phase (plus the wall) into a StageTimer so
         overlap is inspectable post-hoc from the timings TSV."""
         if timer is None:
             return
+        if self.disk_s > 0:
+            timer.record(f"{prefix}/disk", self.disk_s,
+                         nbytes=self.disk_nbytes)
         timer.record(f"{prefix}/host_prep", self.host_prep_s)
         timer.record(f"{prefix}/h2d", self.h2d_s, nbytes=self.nbytes)
         timer.record(f"{prefix}/device", self.device_s)
@@ -233,7 +251,8 @@ class StreamStats:
     def __repr__(self):
         return (f"StreamStats(wall={self.wall_s:.3f}s "
                 f"prep={self.host_prep_s:.3f}s h2d={self.h2d_s:.3f}s "
-                f"device={self.device_s:.3f}s bytes={self.nbytes} "
+                f"device={self.device_s:.3f}s disk={self.disk_s:.3f}s "
+                f"bytes={self.nbytes} "
                 f"slabs={self.slabs} overlap={self.overlap_fraction:.2f})")
 
 
@@ -335,7 +354,7 @@ def _retrying(prep, context: str | None, events, heartbeat: dict | None = None,
 
     from ..runtime.faults import maybe_stall as _maybe_stall
 
-    def wrapped(task):
+    def wrapped(task, *extra):
         attempt = 0
         while True:
             if heartbeat is not None:
@@ -348,11 +367,20 @@ def _retrying(prep, context: str | None, events, heartbeat: dict | None = None,
                     "(context=%s, task=%s); abandoned worker skips fresh "
                     "prep work" % (context, task))
             try:
-                return prep(task)
+                return prep(task, *extra)
             except (ShardStallError, ShardUploadError, KeyboardInterrupt,
                     SystemExit):
                 raise
             except Exception as exc:
+                # a TornShardError already burned read_slab's OWN
+                # disk-retry ladder — re-running it here would square the
+                # retries and misreport disk corruption as a transfer
+                # fault (ShardUploadError). Lazy type lookup: shardstore
+                # imports this module.
+                from ..utils.shardstore import TornShardError
+
+                if isinstance(exc, TornShardError):
+                    raise
                 attempt += 1
                 ctx = {"context": str(context), "task": str(task),
                        "attempt": attempt,
@@ -384,7 +412,7 @@ def _retrying(prep, context: str | None, events, heartbeat: dict | None = None,
 
 def run_pipeline(tasks, prep, commit, *, depth: int | None = None,
                  threads: int | None = None, fault_context: str | None = None,
-                 events=None, liveness=None):
+                 events=None, liveness=None, source=None):
     """Sliding-window pipeline: ``prep(task)`` on worker threads, with at
     most ``depth`` tasks prepared-but-uncommitted; ``commit(task,
     payload)`` on the caller thread in exact submission order (donated
@@ -392,6 +420,16 @@ def run_pipeline(tasks, prep, commit, *, depth: int | None = None,
 
     ``depth <= 1``, ``threads <= 0``, or a single task degrade to the
     serial loop — bit-identical behavior, no threads spawned.
+
+    ``source`` (out-of-core ingestion, ISSUE 10): an optional
+    DISK-PRODUCER stage — ``source(task)`` runs on its own single reader
+    thread ahead of the prep workers (disk is one spindle/page cache;
+    parallel reads just seek-thrash), read-ahead bounded by the same
+    sliding window, and ``prep`` is then called as ``prep(task, raw)``.
+    The three stages — disk read, host prep, h2d transfer — overlap
+    across slabs; a transient prep/transfer retry reuses the already-read
+    ``raw`` (no disk re-read), while the source carries its own retry
+    wrapper for read-side faults.
 
     Fault containment (ISSUE 6): every prep rides the shard-granular
     retry wrapper (:func:`_retrying`); on the threaded path the commit
@@ -414,6 +452,7 @@ def run_pipeline(tasks, prep, commit, *, depth: int | None = None,
     if depth is None:
         depth = stream_depth(threads=threads)
     stall_s = stream_stall_s()
+    read_context = f"{fault_context or 'stream'}:read"
 
     def _committed(i: int):
         if liveness is not None:
@@ -421,6 +460,16 @@ def run_pipeline(tasks, prep, commit, *, depth: int | None = None,
                           cursor=i)
 
     if depth <= 1 or threads <= 0 or len(tasks) <= 1:
+        if source is not None:
+            src_serial = _retrying(source, read_context, events)
+            prep_serial = _retrying(prep, fault_context, events)
+            for i, t in enumerate(tasks):
+                # read once per task; a transient prep/transfer retry
+                # then reuses the SAME raw payload (mirrors the threaded
+                # path's cached future — no disk re-read per prep retry)
+                commit(t, prep_serial(t, src_serial(t)))
+                _committed(i)
+            return
         serial_prep = _retrying(prep, fault_context, events)
         for i, t in enumerate(tasks):
             commit(t, serial_prep(t))
@@ -433,6 +482,21 @@ def run_pipeline(tasks, prep, commit, *, depth: int | None = None,
     # backoff sleeps (a different knob doing its job) never read as a hang
     heartbeat: dict = {}
     cancelled = threading.Event()
+    src_ex = None
+    if source is not None:
+        src_wrapped = _retrying(source, read_context, events,
+                                heartbeat=heartbeat, cancelled=cancelled)
+        src_ex = concurrent.futures.ThreadPoolExecutor(
+            1, thread_name_prefix="cnmf-stream-disk")
+        base_prep = prep
+
+        def prep(task, raw_fut):  # noqa: F811 — staged twin of the bare prep
+            # a failed read future already burned the source's own retry
+            # ladder; .result() re-raising here is final, while a
+            # transient prep/transfer failure retries against the SAME
+            # raw payload (no disk re-read)
+            return base_prep(task, raw_fut.result())
+
     prep = _retrying(prep, fault_context, events, heartbeat=heartbeat,
                      cancelled=cancelled)
 
@@ -472,7 +536,14 @@ def run_pipeline(tasks, prep, commit, *, depth: int | None = None,
                 commit(tt, await_result(tt, fut))
                 _committed(n_done)
                 n_done += 1
-            pending.append((t, ex.submit(prep, t)))
+            if src_ex is not None:
+                # the reader thread runs ahead within the same sliding
+                # window: at most `depth` raw slabs are read-but-unprepped,
+                # so disk read-ahead respects the host-bytes budget too
+                pending.append((t, ex.submit(prep, t,
+                                             src_ex.submit(src_wrapped, t))))
+            else:
+                pending.append((t, ex.submit(prep, t)))
         while pending:
             tt, fut = pending.popleft()
             commit(tt, await_result(tt, fut))
@@ -485,15 +556,21 @@ def run_pipeline(tasks, prep, commit, *, depth: int | None = None,
         # cancelled flag stops an eventually-waking abandoned thread from
         # starting fresh prep work against this dead pipeline
         cancelled.set()
+        if src_ex is not None:
+            src_ex.shutdown(wait=False, cancel_futures=True)
         ex.shutdown(wait=False, cancel_futures=True)
         raise
     except BaseException:
         # every other failure drains cleanly: workers are alive, so waiting
         # is safe and preserves the old invariant that no worker outlives a
         # failed staging call (no zombie transfers racing a re-stage)
+        if src_ex is not None:
+            src_ex.shutdown(wait=True, cancel_futures=True)
         ex.shutdown(wait=True, cancel_futures=True)
         raise
     else:
+        if src_ex is not None:
+            src_ex.shutdown(wait=True)
         ex.shutdown(wait=True)
 
 
@@ -801,6 +878,190 @@ def _stream_dense_sharded(X, sharding, dtype,
     if stats is not None:
         stats.add(device_s=time.perf_counter() - t0)
         stats.wall_s += time.perf_counter() - t_wall
+    return out
+
+
+def stream_store_sharded(cursor, sharding, dtype=jnp.float32, *,
+                         stats: StreamStats | None = None, events=None,
+                         liveness=None, pad_rows: int = 0):
+    """Out-of-core ingestion (ISSUE 10): stage a shard-store row range
+    straight from DISK into a dense sharded device array through the
+    three-stage pipeline — slab reads on the single disk-producer thread,
+    host prep (row slicing / densify) on the stream workers, transfers
+    awaited in-worker, donated on-device assembly on the caller thread.
+    The full matrix never exists in host RAM: in-flight host slab bytes
+    are bounded by ``CNMF_TPU_OOC_BUDGET_BYTES`` (depth clamp; one slab
+    is the irreducible floor), and the realized high-water mark lands in
+    ``stats.host_peak_bytes`` so the bound is asserted, not assumed.
+
+    ``cursor``: a :class:`~cnmf_torch_tpu.utils.shardstore.SlabCursor`
+    (row-range view — each worker/host stages ONLY the slabs overlapping
+    its own rows). ``pad_rows`` appends that many zero rows (mesh-multiple
+    padding; they cost no disk reads — shard buffers start zeroed).
+    Values are placed, never summed, so the assembled array is
+    bit-identical to staging the in-memory matrix regardless of slab
+    boundaries."""
+    from ..utils.shardstore import ooc_budget_bytes
+
+    t_wall = time.perf_counter()
+    store = cursor.store
+    base = cursor.rows[0]
+    n_data = cursor.n_rows
+    n_out = n_data + int(pad_rows)
+    g = store.n_genes
+    val_dtype = np.dtype(dtype)
+    shards = _shard_slices(sharding, (n_out, g))
+    transport = (_csr_transport([dev for dev, _, _ in shards])
+                 if store.format == "csr" else "dense")
+
+    segs = cursor.tasks()  # (slab_i, global_lo, global_hi)
+    per_dev = []
+    max_seg_rows = 1
+    max_raw = 0
+    empty_devs = []
+    for dev, start, stop in shards:
+        dev_tasks = []
+        for (si, glo, ghi) in segs:
+            olo = max(glo - base, start)
+            ohi = min(ghi - base, stop)
+            if ohi > olo:
+                dev_tasks.append((dev, start, stop, olo, ohi, si))
+                max_seg_rows = max(max_seg_rows, ohi - olo)
+                max_raw = max(max_raw, int(store.slabs[si]["raw_bytes"]))
+        if dev_tasks:
+            per_dev.append(dev_tasks)
+        else:
+            empty_devs.append((dev, start, stop))
+            per_dev.append([])
+    tasks = _interleave(per_dev)
+
+    prep_bytes = max_seg_rows * g * val_dtype.itemsize \
+        if transport == "dense" else max_raw
+    task_bytes = max(max_raw + prep_bytes, 1)
+    threads = stream_threads()
+    depth = stream_depth(slab_bytes=task_bytes, threads=threads, windows=2)
+    # the OOC budget bounds the SUM of the three live windows (disk
+    # read-ahead, prep/transfer, commit drain), so each gets a third
+    depth = max(1, min(depth, ooc_budget_bytes() // (task_bytes * 3)))
+
+    asm = _ShardAssembler(val_dtype)
+    for group in per_dev:
+        if not group:
+            continue
+        dev, start, stop = group[0][0], group[0][1], group[0][2]
+        seg_rows = sum(t[4] - t[3] for t in group)
+        # n_slabs=1 lets the assembler adopt a single sub as the whole
+        # shard — only valid when that sub covers every row of the shard
+        asm.expect(dev, len(group)
+                   if seg_rows == stop - start and len(group) == 1 else
+                   max(len(group), 2))
+    residency = cursor.residency
+
+    def source(task):
+        dev, start, stop, olo, ohi, si = task
+        t0 = time.perf_counter()
+        raw = cursor.read(si)  # digest-verified; charges residency
+        if stats is not None:
+            stats.add(disk_s=time.perf_counter() - t0,
+                      disk_nbytes=int(store.slabs[si]["raw_bytes"]))
+        return raw
+
+    def prep(task, raw):
+        """Slice the slab to this shard's rows and upload. Returns
+        ``(staged, densify_rows, release_cbs)`` — the release callbacks
+        run only after the on-device consumer is done with the staged
+        buffers (a CPU backend's device_put may zero-copy-alias host
+        memory, so releasing earlier would lie to the accounting)."""
+        dev, start, stop, olo, ohi, si = task
+        rows = ohi - olo
+        meta = store.slabs[si]
+        a = (base + olo) - int(meta["row0"])
+        b = a + rows
+        t0 = time.perf_counter()
+        if store.format == "csr":
+            seg = raw[a:b]
+            if transport == "dense":
+                blk = seg.toarray()
+                if blk.dtype != val_dtype:
+                    blk = blk.astype(val_dtype)
+                residency.charge(blk.nbytes)
+                cursor.release(si)  # the dense copy replaces the raw slab
+                t1 = time.perf_counter()
+                sub = jax.device_put(blk, dev)
+                jax.block_until_ready(sub)
+                t2 = time.perf_counter()
+                if stats is not None:
+                    stats.add(host_prep_s=t1 - t0, h2d_s=t2 - t1, slabs=1,
+                              nbytes=blk.nbytes)
+                nb = blk.nbytes
+                return (sub, None,
+                        [lambda: residency.release(nb)])
+            vals = np.ascontiguousarray(seg.data.astype(val_dtype,
+                                                        copy=False))
+            cols = seg.indices.astype(
+                np.int16 if g < 2 ** 15 else np.int32, copy=False)
+            indptr = seg.indptr.astype(np.int32, copy=False)
+            t1 = time.perf_counter()
+            parts = (jax.device_put(vals, dev), jax.device_put(cols, dev),
+                     jax.device_put(indptr, dev))
+            jax.block_until_ready(parts)
+            t2 = time.perf_counter()
+            if stats is not None:
+                stats.add(host_prep_s=t1 - t0, h2d_s=t2 - t1, slabs=1,
+                          nbytes=vals.nbytes + cols.nbytes + indptr.nbytes)
+            return (parts, rows, [lambda: cursor.release(si)])
+        blk = np.ascontiguousarray(np.asarray(raw[a:b], dtype=val_dtype))
+        t1 = time.perf_counter()
+        sub = jax.device_put(blk, dev)
+        jax.block_until_ready(sub)
+        t2 = time.perf_counter()
+        if stats is not None:
+            stats.add(host_prep_s=t1 - t0, h2d_s=t2 - t1, slabs=1,
+                      nbytes=blk.nbytes)
+        return (sub, None, [lambda: cursor.release(si)])
+
+    inflight: collections.deque = collections.deque()
+
+    def _drain_one():
+        sub, cbs = inflight.popleft()
+        jax.block_until_ready(sub)
+        for cb in cbs:
+            cb()
+
+    def commit(task, payload):
+        dev, start, stop, olo, ohi, si = task
+        staged, densify_rows, cbs = payload
+        t0 = time.perf_counter()
+        if densify_rows is not None:
+            sub = _csr_densify(*staged, rows=int(densify_rows), g=int(g))
+        else:
+            sub = staged
+        inflight.append((sub, cbs))
+        if len(inflight) >= depth:
+            _drain_one()
+        asm.place(dev, sub, olo - start, stop - start, int(g))
+        if stats is not None:
+            stats.add(device_s=time.perf_counter() - t0)
+
+    run_pipeline(tasks, prep, commit, depth=depth, threads=threads,
+                 fault_context="stream_store", events=events,
+                 liveness=liveness, source=source)
+
+    t0 = time.perf_counter()
+    while inflight:
+        _drain_one()
+    for dev, start, stop in empty_devs:
+        # shards made entirely of pad rows: all zeros, no disk reads
+        asm._big[dev] = _zeros_builder(dev, stop - start, int(g),
+                                       val_dtype)()
+    blocks = asm.blocks([dev for dev, _, _ in shards])
+    jax.block_until_ready(blocks)
+    out = jax.make_array_from_single_device_arrays((n_out, g), sharding,
+                                                   blocks)
+    if stats is not None:
+        stats.add(device_s=time.perf_counter() - t0)
+        stats.wall_s += time.perf_counter() - t_wall
+        stats.host_peak_bytes = max(stats.host_peak_bytes, residency.peak)
     return out
 
 

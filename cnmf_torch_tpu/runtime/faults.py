@@ -24,7 +24,10 @@ spec into injected faults at fixed hook points in the pipeline:
     over the survivors. Default ``limit`` 1 (one loss per process);
   * ``straggler`` — sleep ``seconds=N`` (default 1) at per-task hooks
     of a matching worker, turning it into a deterministic straggler so
-    the launcher's ``CNMF_TPU_STRAGGLER_S`` containment is testable.
+    the launcher's ``CNMF_TPU_STRAGGLER_S`` containment is testable;
+  * ``shard_read`` — corrupt the next shard-store slab READ (the
+    reader's digest validation must detect it and re-read from disk —
+    ``utils/shardstore.py``). Default ``limit`` 1.
 
 Spec grammar (semicolon-separated clauses)::
 
@@ -63,12 +66,13 @@ __all__ = [
     "maybe_stall",
     "maybe_hostloss",
     "maybe_straggle",
+    "maybe_shard_read",
 ]
 
 FAULT_SPEC_ENV = "CNMF_TPU_FAULT_SPEC"
 
 _KINDS = ("nonfinite", "kill", "torn", "upload", "stall", "hostloss",
-          "straggler")
+          "straggler", "shard_read")
 _CONTROL_KEYS = ("after", "limit", "once")
 
 
@@ -420,6 +424,26 @@ def maybe_straggle(context=None, worker=None) -> float:
         time.sleep(secs)
         return secs
     return 0.0
+
+
+def maybe_shard_read(context=None, worker=None) -> bool:
+    """True when a ``shard_read`` clause matches — the injectable form of
+    a torn/bit-rotted shard-store slab READ (a truncated page-cache read,
+    an NFS blip, silent disk corruption). The shard-store reader
+    (``utils/shardstore.py``) corrupts the slab it just loaded when this
+    fires, so its content-digest validation MUST detect the damage and
+    the retry loop re-reads from disk — exactly the reader-side
+    containment the ooc smoke gate asserts. ``limit`` defaults to 1 (one
+    torn read per clause; the re-read then sees clean bytes)."""
+    spec = active_spec()
+    if spec is None:
+        return False
+    for clause in spec:
+        if clause.kind != "shard_read":
+            continue
+        if _clause_fires(clause, context, worker, default_limit=1):
+            return True
+    return False
 
 
 def maybe_fail(kind: str, **ctx) -> None:

@@ -325,6 +325,20 @@ def peek_h5ad_shape(filename: str) -> tuple[int, int]:
         return tuple(int(s) for s in node.attrs["shape"])
 
 
+def peek_h5ad_var_names(filename: str):
+    """The var (gene) index from the file metadata alone — no matrix
+    read. The shard-store staleness sweep (ISSUE 10) compares it against
+    a store manifest without materializing either matrix."""
+    import h5py
+
+    with h5py.File(filename, "r") as f:
+        if "var" not in f:
+            return None
+        g = f["var"]
+        index_name = _decode(g.attrs.get("_index", "_index"))
+        return [str(_decode(v)) for v in _read_array_like(g[index_name])]
+
+
 def read_h5ad(filename: str) -> AnnDataLite:
     import h5py
 

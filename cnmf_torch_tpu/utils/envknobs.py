@@ -120,6 +120,29 @@ REGISTRY: dict[str, Knob] = _knobs(
          "per-slab progress watchdog on the pipelined staging path: a "
          "transfer hung longer than this raises `ShardStallError` "
          "(diagnosable, checkpoint-resumable) instead of hanging the mesh"),
+    # -- out-of-core ingestion (utils/shardstore.py) -----------------------
+    Knob("CNMF_TPU_OOC", "str", "auto",
+         "out-of-core shard-store ingestion: `auto` writes the row-slab "
+         "store at prepare when the normalized matrix exceeds the slab "
+         "budget and factorize streams from it when present; `1` forces "
+         "the store (the h5ad normalized-counts copy is then skipped — "
+         "the store is authoritative); `0` disables writing AND reading"),
+    Knob("CNMF_TPU_OOC_BUDGET_BYTES", "int", "`1<<30`",
+         "per-worker HOST slab-residency budget for store-backed "
+         "ingestion: in-flight slab buffers stay under it (depth clamp + "
+         "slab sizing), so factorize's host footprint is bounded by the "
+         "budget, not the matrix size; also the `auto` store-write "
+         "threshold at prepare"),
+    Knob("CNMF_TPU_OOC_SLAB_ROWS", "int", "`0` (auto)",
+         "rows per shard-store slab at write time; `0` derives from the "
+         "slab budget (slab bytes ≤ budget/4, floored at 256 rows)"),
+    Knob("CNMF_TPU_OOC_SHARD_BYTES", "int", "`0` (device-derived)",
+         "per-DEVICE resident-shard budget for the rowsharded solver: a "
+         "store-backed shard larger than this runs each pass as a loop "
+         "over streamed X slab groups (tiny (A,B) statistics resident, X "
+         "re-read per pass — solver-tolerance, not bit-identical); `0` "
+         "derives from reported device memory (effectively resident on "
+         "backends without memory stats)"),
     # -- checkpointing / multihost ----------------------------------------
     Knob("CNMF_TPU_CKPT_EVERY_PASSES", "int", "`1`",
          "mid-run checkpoint cadence for the rowsharded solver, in solver "

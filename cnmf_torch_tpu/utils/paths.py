@@ -73,6 +73,13 @@ def build_paths(output_dir: str, name: str, create: bool = True) -> dict:
         # treat as deliberately absent. Worker-templated like provenance.
         "resilience_ledger": os.path.join(tmp, name + ".resilience.w%d.json"),
 
+        # TPU-build addition (ISSUE 10): out-of-core row-slab shard store
+        # (utils/shardstore.py) written at prepare next to the normalized
+        # h5ad — per-slab npz shards + a digest-validated manifest, so
+        # factorize workers stream only their own row-range slabs from
+        # disk instead of each materializing the full matrix in host RAM.
+        "shard_store": os.path.join(tmp, name + ".norm_counts.store"),
+
         # TPU-build addition (ISSUE 6): per-replicate mid-run pass
         # checkpoint (runtime/checkpoint.py) — (A, B)/W/cursor state the
         # rowsharded factorize persists every CNMF_TPU_CKPT_EVERY_PASSES

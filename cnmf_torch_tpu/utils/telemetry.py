@@ -205,9 +205,14 @@ class EventLog:
         self.emit("memory", stage=stage, devices=device_memory_snapshot())
 
     def emit_stream(self, context: str, stats):
-        """Fold one ``StreamStats`` into the event stream."""
+        """Fold one ``StreamStats`` into the event stream. Staging calls
+        with a disk-producer stage (out-of-core shard-store ingestion,
+        ISSUE 10) additionally carry the disk wall/bytes/read-GB/s and
+        the host slab-residency high-water mark — the report's
+        "Ingestion" table and the bench ``ingest`` tier read them back."""
         if not self.enabled or stats is None:
             return
+        disk_s = float(getattr(stats, "disk_s", 0.0))
         self.emit(
             "stream", context=context, wall_s=round(stats.wall_s, 4),
             host_prep_s=round(stats.host_prep_s, 4),
@@ -215,7 +220,14 @@ class EventLog:
             device_s=round(stats.device_s, 4),
             nbytes=int(stats.nbytes), slabs=int(stats.slabs),
             gb_per_s=round(stats.gb_per_s(), 3),
-            overlap_fraction=round(stats.overlap_fraction, 3))
+            overlap_fraction=round(stats.overlap_fraction, 3),
+            disk_s=round(disk_s, 4) if disk_s > 0 else None,
+            disk_nbytes=(int(stats.disk_nbytes) if disk_s > 0 else None),
+            disk_gb_per_s=(round(stats.read_gb_per_s(), 3)
+                           if disk_s > 0 else None),
+            host_peak_bytes=(int(stats.host_peak_bytes)
+                             if getattr(stats, "host_peak_bytes", 0) > 0
+                             else None))
 
     # -- internals -----------------------------------------------------
 
@@ -502,6 +514,40 @@ def summarize_events(events: list[dict]) -> dict:
              "overlap_fraction": e.get("overlap_fraction")}
             for e in streams]
 
+    # out-of-core ingestion (ISSUE 10): the shard store written at
+    # prepare (dispatch decision=shard_store_write), factorize's store
+    # engagement (decision=ooc_ingest), and the disk-producer staging
+    # walls carried by store-backed stream events
+    disk_streams = [e for e in streams if e.get("disk_nbytes")]
+    store_ev = next((e for e in events if e["t"] == "dispatch"
+                     and e.get("decision") == "shard_store_write"), None)
+    ooc_ev = next((e for e in events if e["t"] == "dispatch"
+                   and e.get("decision") == "ooc_ingest"), None)
+    if disk_streams or store_ev or ooc_ev:
+        ing: dict = {}
+        ctx = (ooc_ev or store_ev or {}).get("context") or {}
+        for key in ("slabs", "store_bytes", "format", "rows"):
+            if ctx.get(key) is not None:
+                ing[key] = ctx[key]
+        if disk_streams:
+            disk_s = sum(float(e.get("disk_s") or 0.0)
+                         for e in disk_streams)
+            disk_b = sum(int(e.get("disk_nbytes") or 0)
+                         for e in disk_streams)
+            ing["disk_read_nbytes"] = disk_b
+            ing["disk_read_gb_per_s"] = (round(disk_b / disk_s / 1e9, 3)
+                                         if disk_s > 0 else 0.0)
+            fracs = [float(e["overlap_fraction"]) for e in disk_streams
+                     if e.get("overlap_fraction") is not None]
+            if fracs:
+                ing["overlap_fraction"] = round(sum(fracs) / len(fracs), 3)
+            peaks = [int(e.get("host_peak_bytes") or 0)
+                     for e in disk_streams]
+            if any(peaks):
+                ing["host_peak_bytes"] = max(peaks)
+        if ing:
+            summary["ingestion"] = ing
+
     conv: dict = {}
     for e in events:
         if e["t"] != "replicates":
@@ -714,6 +760,31 @@ def render_report(run_dir: str) -> str:
                 f"{_fmt_bytes(s['nbytes']):>10s}  "
                 f"{(f'{gbps:.2f} GB/s' if gbps is not None else ''):>11s}  "
                 f"overlap {s.get('overlap_fraction', 0):.2f}")
+
+    ing = summary.get("ingestion")
+    if ing:
+        lines.append("")
+        lines.append("Ingestion (out-of-core shard store)")
+        lines.append("-" * 35)
+        if ing.get("store_bytes") is not None:
+            lines.append(
+                f"  {'store size':<28s} {_fmt_bytes(ing['store_bytes']):>10s}"
+                f"  ({ing.get('slabs', '?')} slab(s), "
+                f"{ing.get('format', '?')}, {ing.get('rows', '?')} rows)")
+        elif ing.get("slabs") is not None:
+            lines.append(f"  {'slabs':<28s} {ing['slabs']:>10d}")
+        if ing.get("disk_read_nbytes") is not None:
+            lines.append(
+                f"  {'disk read':<28s}"
+                f" {_fmt_bytes(ing['disk_read_nbytes']):>10s}"
+                f"  ({ing.get('disk_read_gb_per_s', 0.0):.2f} GB/s)")
+        if ing.get("overlap_fraction") is not None:
+            lines.append(f"  {'disk/h2d overlap fraction':<28s}"
+                         f" {ing['overlap_fraction']:>10.2f}")
+        if ing.get("host_peak_bytes") is not None:
+            lines.append(
+                f"  {'host slab residency peak':<28s}"
+                f" {_fmt_bytes(ing['host_peak_bytes']):>10s}")
 
     if summary.get("convergence"):
         lines.append("")

@@ -74,6 +74,22 @@ if [ "$rc" -eq 0 ]; then
   fi
 fi
 
+# ooc smoke: mini pipeline with the slab budget forced below the fixture
+# size — prepare writes the shard store, factorize streams every slab
+# from disk, and the merged spectra + consensus must be BIT-identical to
+# the resident run; a shard_read-injected torn slab must be detected by
+# the digest check and healed by a disk re-read (scripts/ooc_smoke.py)
+if [ "$rc" -eq 0 ]; then
+  echo "[tier1] ooc smoke (shard-store ingestion: bit parity + torn-slab re-read) ..."
+  if timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      python scripts/ooc_smoke.py; then
+    echo OOC_SMOKE=ok
+  else
+    echo OOC_SMOKE=fail
+    exit 1
+  fi
+fi
+
 # accel parity smoke: a mini sweep under each solver recipe (plain MU /
 # accelerated-MU / Diagonalized-Newton KL / HALS) asserting matched
 # final objectives within tolerance and schema-valid dispatch +

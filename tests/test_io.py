@@ -122,11 +122,14 @@ def test_paths_registry(tmp_path):
     paths = build_paths(str(tmp_path), "run1")
     # every key of the reference registry (cnmf.py:423-455) plus
     # factorize_provenance (records the engaged solver path),
-    # resilience_ledger (quarantine/retry records, ISSUE 5), and
-    # pass_checkpoint (mid-run pass-statistics checkpoint, ISSUE 6)
-    assert len(paths) == 27
+    # resilience_ledger (quarantine/retry records, ISSUE 5),
+    # pass_checkpoint (mid-run pass-statistics checkpoint, ISSUE 6), and
+    # shard_store (out-of-core row-slab store, ISSUE 10)
+    assert len(paths) == 28
     assert "factorize_provenance" in paths
     assert "resilience_ledger" in paths
+    assert paths["shard_store"] == str(
+        tmp_path / "run1" / "cnmf_tmp" / "run1.norm_counts.store")
     assert paths["resilience_ledger"] % 2 == str(
         tmp_path / "run1" / "cnmf_tmp" / "run1.resilience.w2.json")
     assert paths["pass_checkpoint"] % (7, 3) == str(

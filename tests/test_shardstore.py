@@ -1,0 +1,580 @@
+"""Out-of-core shard-store ingestion (ISSUE 10): writer/reader round
+trips, digest-validated torn-read containment, per-worker slab ownership,
+store-backed staging parity (dense / CSR / ELL / 2-D, ragged + all-zero
+slabs), the slab-residency budget accounting, the slab-looped rowshard
+tier, and the prepare-side store lifecycle (auto threshold, h5ad skip,
+stale-store sweep, f32 norm counts).
+
+Runs on the simulated multi-device CPU mesh from conftest.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+import scipy.sparse as sp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cnmf_torch_tpu.utils import shardstore as ss
+from cnmf_torch_tpu.utils.shardstore import (
+    HostResidency,
+    ShardStore,
+    SlabCursor,
+    TornShardError,
+    open_shard_store,
+    probe_shard_store,
+    write_shard_store,
+)
+
+
+@pytest.fixture()
+def mesh():
+    return Mesh(np.asarray(jax.devices()[:4]), ("cells",))
+
+
+def _dense(n=219, g=37, seed=0):
+    return np.abs(np.random.default_rng(seed).random((n, g))
+                  ).astype(np.float32)
+
+
+def _csr(n=219, g=37, seed=1, density=0.15):
+    X = sp.random(n, g, density=density, format="lil", random_state=seed)
+    X[40:60, :] = 0.0              # a fully-zero row band spanning a slab
+    X[n - 1, :] = 0.0              # empty final row (ragged tail)
+    return sp.csr_matrix(X).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# writer / reader round trips
+# ---------------------------------------------------------------------------
+
+def test_write_open_roundtrip_dense(tmp_path):
+    X = _dense()
+    man = write_shard_store(tmp_path / "st", X, slab_rows=50)
+    store = open_shard_store(tmp_path / "st")
+    assert store.shape == X.shape and store.format == "dense"
+    # ragged final slab: 219 rows at 50/slab -> 5 slabs, last is 19 rows
+    assert len(store.slabs) == 5
+    assert store.slabs[-1]["row1"] - store.slabs[-1]["row0"] == 19
+    assert np.array_equal(store.to_matrix(), X)
+    assert man["store_digest"] == store.store_digest
+
+
+def test_write_open_roundtrip_csr(tmp_path):
+    X = _csr()
+    write_shard_store(tmp_path / "st", X, slab_rows=64)
+    store = open_shard_store(tmp_path / "st")
+    assert store.format == "csr"
+    assert store.nnz == X.nnz
+    assert store.max_row_nnz == int(np.diff(X.indptr).max())
+    out = store.to_matrix()
+    assert sp.issparse(out)
+    assert np.array_equal(out.toarray(), X.toarray())
+
+
+def test_names_roundtrip_and_row_block(tmp_path):
+    X = _csr(101, 23)
+    obs = [f"cell{i}" for i in range(101)]
+    var = [f"gene{j}" for j in range(23)]
+    write_shard_store(tmp_path / "st", X, obs_names=obs, var_names=var,
+                      slab_rows=40)
+    store = open_shard_store(tmp_path / "st")
+    assert store.obs_names() == obs and store.var_names() == var
+    blk = store.row_block(35, 85)  # spans slabs 0, 1, 2
+    assert np.array_equal(blk.toarray(), X[35:85].toarray())
+
+
+def test_store_write_is_f32(tmp_path):
+    X = np.random.default_rng(2).random((40, 8)).astype(np.float64)
+    write_shard_store(tmp_path / "st", X, slab_rows=16)
+    store = open_shard_store(tmp_path / "st")
+    assert store.dtype == np.float32
+    assert store.read_slab(0).dtype == np.float32
+
+
+def test_rewrite_clears_stale_slabs(tmp_path):
+    write_shard_store(tmp_path / "st", _dense(219), slab_rows=20)  # 11 slabs
+    write_shard_store(tmp_path / "st", _dense(60), slab_rows=30)   # 2 slabs
+    store = open_shard_store(tmp_path / "st")
+    assert len(store.slabs) == 2
+    files = [f for f in os.listdir(tmp_path / "st") if f.startswith("slab_")]
+    assert len(files) == 2  # no orphans a manifest never references
+
+
+# ---------------------------------------------------------------------------
+# validation + torn-read containment
+# ---------------------------------------------------------------------------
+
+def test_open_rejects_structural_damage(tmp_path):
+    X = _dense(100, 10)
+    write_shard_store(tmp_path / "st", X, slab_rows=40)
+    man_path = tmp_path / "st" / "manifest.json"
+    man = json.loads(man_path.read_text())
+
+    os.unlink(tmp_path / "st" / man["slabs"][1]["file"])
+    with pytest.raises(TornShardError, match="missing"):
+        open_shard_store(tmp_path / "st")
+
+    write_shard_store(tmp_path / "st", X, slab_rows=40)
+    man = json.loads(man_path.read_text())
+    man["slabs"][1]["row0"] += 1  # ranges no longer a contiguous partition
+    man_path.write_text(json.dumps(man))
+    with pytest.raises(TornShardError, match="contiguous"):
+        open_shard_store(tmp_path / "st")
+
+    man_path.write_text("{not json")
+    store, reason = probe_shard_store(tmp_path / "st")
+    assert store is None and "manifest" in reason
+    assert probe_shard_store(tmp_path / "missing") == (None, "missing")
+
+
+def test_torn_slab_detected_and_fails_loudly(tmp_path):
+    X = _dense(80, 12)
+    write_shard_store(tmp_path / "st", X, slab_rows=40)
+    store = open_shard_store(tmp_path / "st")
+    path = os.path.join(store.dir, store.slabs[1]["file"])
+    with open(path, "r+b") as f:  # persistent corruption: flip one byte
+        f.seek(30)
+        b = f.read(1)
+        f.seek(30)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.warns(RuntimeWarning, match="re-reading from disk"):
+        with pytest.raises(TornShardError, match="after"):
+            store.read_slab(1)
+    assert np.array_equal(store.read_slab(0), X[:40])  # slab 0 untouched
+
+
+def test_injected_torn_read_heals_by_reread(tmp_path, monkeypatch):
+    from cnmf_torch_tpu.runtime import faults
+
+    X = _csr(90, 15)
+    write_shard_store(tmp_path / "st", X, slab_rows=30)
+    store = open_shard_store(tmp_path / "st")
+    # distinct spec string per test: the parsed-clause cache keys on the
+    # raw spec, and clause hit counters live inside the cached objects
+    monkeypatch.setenv(faults.FAULT_SPEC_ENV,
+                       "shard_read:context=slab,limit=1")
+    with pytest.warns(RuntimeWarning, match="re-reading from disk"):
+        blk = store.read_slab(0)
+    # healed: the re-read saw clean bytes, data is exact
+    assert np.array_equal(blk.toarray(), X[:30].toarray())
+    # clause limit=1: subsequent reads are clean
+    assert np.array_equal(store.read_slab(1).toarray(),
+                          X[30:60].toarray())
+
+
+# ---------------------------------------------------------------------------
+# ownership: per-worker / per-host row ranges
+# ---------------------------------------------------------------------------
+
+def test_worker_ranges_partition_and_ownership(tmp_path):
+    X = _dense(219)
+    write_shard_store(tmp_path / "st", X, slab_rows=50)  # 5 slabs
+    store = open_shard_store(tmp_path / "st")
+    ranges = store.worker_ranges(2)
+    assert ranges[0][0] == 0 and ranges[-1][1] == 219
+    assert ranges[0][1] == ranges[1][0]  # contiguous
+    # slab-aligned: no slab is opened by two workers
+    opened = [set(store.slab_indices_for_rows(*r)) for r in ranges]
+    assert opened[0].isdisjoint(opened[1])
+    assert opened[0] | opened[1] == set(range(5))
+    # more workers than slabs -> empty trailing ranges, never an error
+    many = store.worker_ranges(9)
+    assert len(many) == 9
+    assert sum(1 for lo, hi in many if hi > lo) <= 5
+
+
+def test_cursor_reads_only_own_slabs(tmp_path):
+    """The acceptance pin: a worker's cursor physically cannot open
+    another worker's slabs, and the spy ledger proves which were read."""
+    X = _dense(219)
+    write_shard_store(tmp_path / "st", X, slab_rows=50)
+    store = open_shard_store(tmp_path / "st")
+    lo, hi = store.worker_ranges(2)[0]
+    cur = SlabCursor(store, rows=(lo, hi))
+    for si, _, _ in cur.tasks():
+        cur.read(si)
+    own = set(store.slab_indices_for_rows(lo, hi))
+    assert set(cur.slabs_read) == own and own < set(range(5))
+    other = next(i for i in range(5) if i not in own)
+    with pytest.raises(ValueError, match="own row-range"):
+        cur.read(other)
+    with pytest.raises(ValueError, match="outside store rows"):
+        SlabCursor(store, rows=(0, 10_000))
+
+
+def test_simulated_pod_process_reads_only_its_slabs(tmp_path, mesh,
+                                                    monkeypatch):
+    """A multihost process enumerates only its ADDRESSABLE shards
+    (streaming._shard_slices) — simulate a 2-process pod by restricting
+    the map to the first half of the mesh and pin, via the cursor's
+    read ledger, that only the overlapping slabs were opened."""
+    from cnmf_torch_tpu.parallel import streaming
+    from cnmf_torch_tpu.parallel.streaming import stream_store_sharded
+
+    X = _dense(200, 16)
+    write_shard_store(tmp_path / "st", X, slab_rows=25)  # 8 slabs
+    store = open_shard_store(tmp_path / "st")
+    sharding = NamedSharding(mesh, P("cells", None))
+    orig = streaming._shard_slices
+
+    def first_half(sh, shape):
+        out = sorted(orig(sh, shape), key=lambda t: t[1])
+        return out[:2]  # "this process" addresses devices 0-1 = rows 0:100
+
+    monkeypatch.setattr(streaming, "_shard_slices", first_half)
+    # a single-process jax cannot assemble a global array from half the
+    # shards (on a real pod the other processes contribute theirs) —
+    # capture the local blocks instead of assembling
+    got = {}
+    monkeypatch.setattr(
+        jax, "make_array_from_single_device_arrays",
+        lambda shape, sh, blocks: got.update(blocks=blocks) or blocks)
+    cur = SlabCursor(store)
+    stream_store_sharded(cur, sharding, pad_rows=0)
+    own = set(store.slab_indices_for_rows(0, 100))
+    assert set(cur.slabs_read) == own < set(range(8))
+    # and this process's blocks carry exactly its rows
+    local = np.concatenate([np.asarray(b) for b in got["blocks"]], axis=0)
+    assert np.array_equal(local, X[:100])
+
+
+def test_host_residency_ledger():
+    r = HostResidency()
+    r.charge(100)
+    r.charge(50)
+    r.release(100)
+    r.charge(30)
+    assert r.live == 80 and r.peak == 150
+
+
+# ---------------------------------------------------------------------------
+# staging parity (the bit-identity backbone of the dispatch claim)
+# ---------------------------------------------------------------------------
+
+def test_stream_store_dense_parity_ragged(tmp_path, mesh):
+    from cnmf_torch_tpu.parallel.rowshard import stream_rows_to_mesh
+
+    X = _dense(219)  # ragged final slab AND ragged vs the 4-way mesh
+    write_shard_store(tmp_path / "st", X, slab_rows=50)
+    store = open_shard_store(tmp_path / "st")
+    A, pad_a = stream_rows_to_mesh(store, mesh, "cells")
+    B, pad_b = stream_rows_to_mesh(X, mesh, "cells")
+    assert pad_a == pad_b
+    assert np.array_equal(np.asarray(A), np.asarray(B))
+
+
+def test_stream_store_csr_parity_zero_slab(tmp_path, mesh):
+    from cnmf_torch_tpu.parallel.rowshard import stream_rows_to_mesh
+
+    X = _csr(219)
+    write_shard_store(tmp_path / "st", X, slab_rows=20)
+    store = open_shard_store(tmp_path / "st")
+    # the zero band covers rows 40:60 -> slab 2 is entirely zero rows
+    assert store.slabs[2]["nnz"] == 0
+    A, _ = stream_rows_to_mesh(store, mesh, "cells")
+    B, _ = stream_rows_to_mesh(X, mesh, "cells")
+    assert np.array_equal(np.asarray(A), np.asarray(B))
+
+
+def test_stream_store_ell_parity(tmp_path, mesh):
+    from cnmf_torch_tpu.parallel.rowshard import stream_ell_to_mesh
+
+    X = _csr(219)
+    write_shard_store(tmp_path / "st", X, slab_rows=60)
+    store = open_shard_store(tmp_path / "st")
+    E1, pad1 = stream_ell_to_mesh(store, mesh, "cells")
+    E2, pad2 = stream_ell_to_mesh(X, mesh, "cells")
+    assert pad1 == pad2 and E1.width == E2.width
+    for leaf in ("vals", "cols", "rows_t", "perm_t"):
+        assert np.array_equal(np.asarray(getattr(E1, leaf)),
+                              np.asarray(getattr(E2, leaf)))
+
+
+def test_stream_store_pad_only_shards(tmp_path, mesh):
+    """Fewer data rows than devices: trailing shards are pure mesh
+    padding — all zeros, zero disk reads."""
+    from cnmf_torch_tpu.parallel.rowshard import stream_rows_to_mesh
+
+    X = _dense(2, 9)
+    write_shard_store(tmp_path / "st", X, slab_rows=1)
+    store = open_shard_store(tmp_path / "st")
+    A, pad = stream_rows_to_mesh(store, mesh, "cells")
+    assert pad == 2 and A.shape == (4, 9)
+    got = np.asarray(A)
+    assert np.array_equal(got[:2], X) and not got[2:].any()
+
+
+def test_stage_x_2d_from_store_ragged_and_zero_slab(tmp_path):
+    """Satellite: the 2-D path accepts cursor/store input — parity against
+    the ndarray/CSR path with a ragged final slab and an all-zero-row
+    slab in the store."""
+    from cnmf_torch_tpu.parallel.multihost import stage_x_2d
+
+    devs = np.asarray(jax.devices()[:4]).reshape(2, 2)
+    mesh2 = Mesh(devs, ("replicates", "cells"))
+    X = _csr(219)
+    write_shard_store(tmp_path / "st", X, slab_rows=20)
+    store = open_shard_store(tmp_path / "st")
+    assert store.slabs[2]["nnz"] == 0
+    A = stage_x_2d(store, mesh2)
+    B = stage_x_2d(X, mesh2)
+    assert np.array_equal(np.asarray(A), np.asarray(B))
+    # cursor spelling (what a pod process holds) stages identically
+    C = stage_x_2d(SlabCursor(store), mesh2)
+    assert np.array_equal(np.asarray(C), np.asarray(B))
+
+
+def test_host_residency_bounded_by_budget(tmp_path, mesh, monkeypatch):
+    """The tentpole's allocation-accounting pin: in-flight host slab
+    bytes during store-backed staging never exceed the budget, and stay
+    far below the matrix's own footprint."""
+    from cnmf_torch_tpu.parallel.streaming import (StreamStats,
+                                                   stream_store_sharded)
+
+    X = _dense(512, 64)  # 128 KB matrix
+    write_shard_store(tmp_path / "st", X, slab_rows=32)  # 8 KB slabs
+    store = open_shard_store(tmp_path / "st")
+    budget = 64 << 10
+    monkeypatch.setenv(ss.OOC_BUDGET_ENV, str(budget))
+    stats = StreamStats()
+    sharding = NamedSharding(mesh, P("cells", None))
+    out = stream_store_sharded(SlabCursor(store), sharding, stats=stats)
+    assert np.array_equal(np.asarray(out), X)
+    assert 0 < stats.host_peak_bytes <= budget
+    assert stats.host_peak_bytes < X.nbytes
+    assert stats.disk_nbytes == store.store_bytes
+    assert stats.disk_s > 0 and stats.read_gb_per_s() > 0
+
+
+# ---------------------------------------------------------------------------
+# slab-looped rowshard tier
+# ---------------------------------------------------------------------------
+
+def test_store_dispatch_budget(tmp_path, mesh, monkeypatch):
+    from cnmf_torch_tpu.parallel.rowshard import store_dispatch
+
+    write_shard_store(tmp_path / "st", _dense(512, 64), slab_rows=64)
+    store = open_shard_store(tmp_path / "st")
+    use_ell, slab_loop = store_dispatch(store, mesh, 2.0)
+    assert not use_ell and not slab_loop  # fits the default budget
+    monkeypatch.setenv(ss.OOC_SHARD_BYTES_ENV, "1024")
+    assert store_dispatch(store, mesh, 2.0) == (False, True)
+    # nndsvd init has no slab-looped program: stays resident, loudly
+    with pytest.warns(RuntimeWarning, match="staging resident"):
+        _, slab_loop = store_dispatch(store, mesh, 2.0, init="nndsvd")
+    assert not slab_loop
+
+
+def test_rowshard_store_resident_bit_parity(tmp_path, mesh):
+    from cnmf_torch_tpu.parallel.rowshard import nmf_fit_rowsharded
+
+    X = _dense(256, 32)
+    write_shard_store(tmp_path / "st", X, slab_rows=60)
+    store = open_shard_store(tmp_path / "st")
+    H1, W1, e1 = nmf_fit_rowsharded(X, 5, mesh, seed=3, n_passes=4)
+    H2, W2, e2 = nmf_fit_rowsharded(store, 5, mesh, seed=3, n_passes=4)
+    assert np.array_equal(H1, H2) and np.array_equal(W1, W2) and e1 == e2
+
+
+@pytest.mark.parametrize("beta_loss", ["frobenius", "kullback-leibler"])
+def test_rowshard_slab_loop_solver_tolerance(tmp_path, mesh, monkeypatch,
+                                             beta_loss):
+    from cnmf_torch_tpu.parallel.rowshard import nmf_fit_rowsharded
+
+    X = _dense(256, 32)
+    write_shard_store(tmp_path / "st", X, slab_rows=60)
+    store = open_shard_store(tmp_path / "st")
+    H1, W1, e1 = nmf_fit_rowsharded(X, 5, mesh, beta_loss=beta_loss,
+                                    seed=3, n_passes=6)
+    monkeypatch.setenv(ss.OOC_SHARD_BYTES_ENV, "2048")
+    H2, W2, e2 = nmf_fit_rowsharded(store, 5, mesh, beta_loss=beta_loss,
+                                    seed=3, n_passes=6)
+    assert H2.shape == H1.shape and W2.shape == W1.shape
+    assert np.isfinite(e2) and (H2 >= 0).all() and (W2 >= 0).all()
+    # group-wise H solves make this tier tolerance-equivalent, not
+    # bit-identical: objectives agree to a few percent after 6 passes
+    assert abs(e2 - e1) / max(abs(e1), 1e-9) < 0.1
+
+
+def test_slab_loop_checkpoint_resume_bit_parity(tmp_path, mesh, monkeypatch):
+    """Interrupt the slab-looped tier mid-run and resume: with H inside
+    the checkpoint byte budget the continuation is BIT-identical to the
+    uninterrupted solve (same contract as the resident checkpointed
+    loop)."""
+    from cnmf_torch_tpu.parallel.rowshard import nmf_fit_rowsharded
+    from cnmf_torch_tpu.runtime.checkpoint import PassCheckpointer
+
+    X = _dense(256, 32)
+    write_shard_store(tmp_path / "st", X, slab_rows=60)
+    store = open_shard_store(tmp_path / "st")
+    monkeypatch.setenv(ss.OOC_SHARD_BYTES_ENV, "2048")
+    meta = {"k": 5, "iter": 0, "seed": 3, "attempt": 0,
+            "digest": "store:" + store.store_digest, "beta": 2.0,
+            "params": "t"}
+    kw = dict(seed=3, n_passes=5)
+
+    # spy disk reads: a resume that silently restarted from scratch would
+    # stream every pass's slabs again and still match bit-for-bit (the
+    # solver is deterministic) — the read count is what proves the
+    # continuation actually started from the pass-2 cursor
+    reads = {"n": 0}
+    orig_read = ShardStore.read_slab
+
+    def counting_read(self, i, **kwargs):
+        reads["n"] += 1
+        return orig_read(self, i, **kwargs)
+
+    monkeypatch.setattr(ShardStore, "read_slab", counting_read)
+    full_ck = PassCheckpointer(str(tmp_path / "full.npz"), 1, meta=meta)
+    H1, W1, e1 = nmf_fit_rowsharded(store, 5, mesh, checkpoint=full_ck,
+                                    **kw)
+    full_reads, reads["n"] = reads["n"], 0
+
+    part = PassCheckpointer(str(tmp_path / "part.npz"), 1, meta=meta)
+    nmf_fit_rowsharded(store, 5, mesh, seed=3, n_passes=2,
+                       checkpoint=part)  # "interrupted" after pass 2
+    reads["n"] = 0
+    resumed = PassCheckpointer(str(tmp_path / "part.npz"), 1, meta=meta,
+                               resume=True)
+    H2, W2, e2 = nmf_fit_rowsharded(store, 5, mesh, checkpoint=resumed,
+                                    **kw)
+    assert np.array_equal(H1, H2) and np.array_equal(W1, W2) and e1 == e2
+    # the full solve streams 5 passes' worth of slabs; the resumed one
+    # only passes 3..5 (3/5 of the reads)
+    assert 0 < reads["n"] <= (full_reads * 3) // 5 + 1
+
+
+# ---------------------------------------------------------------------------
+# prepare/factorize lifecycle
+# ---------------------------------------------------------------------------
+
+def _mini_cnmf(tmp_path, name="st"):
+    import pandas as pd
+
+    from cnmf_torch_tpu import cNMF
+    from cnmf_torch_tpu.utils import save_df_to_npz
+
+    rng = np.random.default_rng(3)
+    usage = rng.dirichlet(np.ones(4) * 0.3, size=120)
+    spectra = rng.gamma(0.3, 1.0, size=(4, 90)) * 40.0 / 90
+    counts = rng.poisson(usage @ spectra * 300.0).astype(np.float64)
+    counts[counts.sum(axis=1) == 0, 0] = 1.0
+    df = pd.DataFrame(counts, index=[f"c{i}" for i in range(120)],
+                      columns=[f"g{j}" for j in range(90)])
+    os.makedirs(tmp_path, exist_ok=True)
+    fn = str(tmp_path / "counts.df.npz")
+    save_df_to_npz(df, fn)
+    obj = cNMF(output_dir=str(tmp_path), name=name)
+    return obj, fn
+
+
+def test_prepare_auto_store_threshold(tmp_path, monkeypatch):
+    obj, fn = _mini_cnmf(tmp_path / "a")
+    # default budget (1 GiB) >> matrix: auto mode writes NO store
+    obj.prepare(fn, components=[3], n_iter=2, seed=7,
+                num_highvar_genes=60)
+    assert obj._probe_store() is None
+    assert os.path.exists(obj.paths["normalized_counts"])
+    # budget below the matrix: auto writes the store AND keeps the h5ad
+    monkeypatch.setenv(ss.OOC_BUDGET_ENV, "4096")
+    obj2, fn2 = _mini_cnmf(tmp_path / "b")
+    obj2.prepare(fn2, components=[3], n_iter=2, seed=7,
+                 num_highvar_genes=60)
+    store = obj2._probe_store()
+    assert store is not None
+    assert os.path.exists(obj2.paths["normalized_counts"])
+    # the store holds exactly the h5ad's matrix (f32 both sides)
+    from cnmf_torch_tpu.utils.anndata_lite import read_h5ad
+
+    h5 = read_h5ad(obj2.paths["normalized_counts"])
+    a = h5.X.toarray() if sp.issparse(h5.X) else np.asarray(h5.X)
+    b = store.to_matrix()
+    b = b.toarray() if sp.issparse(b) else b
+    assert np.array_equal(a.astype(np.float32), b)
+
+
+def test_ooc1_skips_h5ad_and_assembles(tmp_path, monkeypatch):
+    monkeypatch.setenv(ss.OOC_ENV, "1")
+    obj, fn = _mini_cnmf(tmp_path)
+    obj.prepare(fn, components=[3], n_iter=2, seed=7, num_highvar_genes=60)
+    assert not os.path.exists(obj.paths["normalized_counts"])
+    store = obj._probe_store()
+    assert store is not None
+    with pytest.warns(RuntimeWarning, match="assembling the full matrix"):
+        nc = obj._read_norm_counts()
+    assert nc.X.shape == store.shape
+    assert list(nc.var.index) == store.var_names()
+
+
+def test_norm_counts_land_f32(tmp_path):
+    """Satellite: the normalized h5ad lands f32 (f64 only ever lives in
+    the moment accumulators), and the values are the f32 rounding of the
+    exact f64 quotients."""
+    obj, fn = _mini_cnmf(tmp_path)
+    obj.prepare(fn, components=[3], n_iter=2, seed=7, num_highvar_genes=60)
+    from cnmf_torch_tpu.utils.anndata_lite import read_h5ad
+
+    X = read_h5ad(obj.paths["normalized_counts"]).X
+    assert X.dtype == np.float32
+
+
+def test_stale_store_swept(tmp_path, monkeypatch):
+    monkeypatch.setenv(ss.OOC_BUDGET_ENV, "4096")
+    obj, fn = _mini_cnmf(tmp_path)
+    obj.prepare(fn, components=[3], n_iter=2, seed=7, num_highvar_genes=60)
+    store = obj._probe_store()
+    assert store is not None and not obj._store_stale(store)
+    # tamper: shrink the manifest's shape -> metadata mismatch vs h5ad
+    man_path = os.path.join(obj.paths["shard_store"], "manifest.json")
+    man = json.loads(open(man_path).read())
+    man["shape"][1] -= 1
+    open(man_path, "w").write(json.dumps(man))
+    store = obj._probe_store()
+    assert obj._store_stale(store)
+    # plus an orphaned atomic temp from a "killed" writer
+    orphan = os.path.join(obj.paths["shard_store"], "slab_9.npz.tmp-123")
+    open(orphan, "w").write("junk")
+    with pytest.warns(RuntimeWarning, match="stale store"):
+        obj._sweep_stale_store(store)
+    assert not os.path.exists(orphan)
+    assert obj._probe_store() is None  # store removed
+
+
+def test_scale_columns_out_dtype_parity():
+    """Satellite: f32 output is the rounding of the exact f64 quotients —
+    identical to casting the legacy f64 result — for dense and CSR."""
+    from cnmf_torch_tpu.ops.stats import scale_columns
+
+    rng = np.random.default_rng(5)
+    Xd = rng.random((50, 17)) * 100
+    ref, std_ref = scale_columns(Xd, ddof=1)
+    got, std = scale_columns(Xd, ddof=1, out_dtype=np.float32)
+    assert got.dtype == np.float32
+    assert np.array_equal(got, ref.astype(np.float32))
+    assert np.array_equal(std, std_ref)
+    Xs = sp.random(60, 17, density=0.3, format="csr", random_state=1)
+    Xs.data *= 50
+    ref_s, _ = scale_columns(Xs, ddof=1)
+    got_s, _ = scale_columns(Xs, ddof=1, out_dtype=np.float32)
+    assert got_s.dtype == np.float32
+    assert np.array_equal(got_s.toarray(),
+                          ref_s.toarray().astype(np.float32))
+
+
+def test_launcher_clean_sweeps_store(tmp_path, monkeypatch):
+    """Satellite: --clean removes shard-store temp orphans (the store
+    itself survives — it is a prepare artifact, reusable on resume)."""
+    monkeypatch.setenv(ss.OOC_BUDGET_ENV, "4096")
+    obj, fn = _mini_cnmf(tmp_path, name="cl")
+    obj.prepare(fn, components=[3], n_iter=2, seed=7, num_highvar_genes=60)
+    store_dir = obj.paths["shard_store"]
+    orphan = os.path.join(store_dir, "slab_00007.npz.tmp-999")
+    open(orphan, "w").write("junk")
+    from cnmf_torch_tpu.launcher import _clean_run_dir
+
+    _clean_run_dir(os.path.join(str(tmp_path), "cl"))
+    assert not os.path.exists(orphan)
+    assert os.path.exists(os.path.join(store_dir, "manifest.json"))
