@@ -67,11 +67,11 @@ _CHIP_PEAKS = {
     "TPU v6 lite": (918e12, 1.64e12),
 }
 
-TIERS = ["north_star", "anchor", "kl", "accel", "mfu", "rowshard",
-         "ingest", "harmony"]
+TIERS = ["north_star", "anchor", "kl", "accel", "sketch", "mfu",
+         "rowshard", "ingest", "harmony"]
 TIER_TIMEOUT_S = {"north_star": 2400, "anchor": 1200, "kl": 1800,
-                  "accel": 1200, "mfu": 900, "rowshard": 1500,
-                  "ingest": 1200, "harmony": 1500}
+                  "accel": 1200, "sketch": 1200, "mfu": 900,
+                  "rowshard": 1500, "ingest": 1200, "harmony": 1500}
 
 
 def synthetic_pbmc_like(n=2700, g=2000, k_true=12, seed=0, scale=400.0):
@@ -688,6 +688,169 @@ def bench_accel():
     return results
 
 
+def bench_sketch():
+    """Sketched solvers (ISSUE 11): measured crossovers for both sketch
+    consumers against their exact twins.
+
+    * ``consensus``: the distance-bearing clustering stage (KNN local
+      density + k-means) on a K=9 x n_iter=100 stacked replicate-spectra
+      fixture — full g-width exact vs random-projected to 256 dims —
+      wall-clock plus the parity the smoke gates (identical outlier set,
+      matching cluster medians).
+    * ``solver``: the sketched KL W update on the 95%-sparse ELL fixture
+      — per-update microbench (exact transpose-gather statistics vs the
+      row-subsampled scatter statistics) and whole-solve us/iter via the
+      N-vs-3N probe, with the final-objective gap at a fixed budget.
+      Where the sketched update does NOT win on this backend, the
+      numbers document the crossover (the scatter path is sized for
+      accelerators; CPU scatters cost ~4x the memcpy they replace).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from cnmf_torch_tpu.ops import kmeans, local_density
+    from cnmf_torch_tpu.ops.nmf import (_apply_rate_sketched, _update_W,
+                                        nmf_fit_batch, random_init)
+    from cnmf_torch_tpu.ops.sketch import project_rows
+    from cnmf_torch_tpu.ops.sparse import (csr_to_ell, ell_device_put,
+                                           ell_kl_w_stats_rows)
+
+    results = {}
+
+    # ---- consensus stage: K=9 x 100 replicates ------------------------
+    K, n_iter, g_sp, dim = 9, 100, 2000, 256
+    R = K * n_iter
+    rng = np.random.default_rng(7)
+    base = rng.gamma(0.3, 1.0, size=(K, g_sp))
+    rows = (base[rng.integers(0, K, size=R)]
+            * rng.uniform(0.8, 1.25, size=(R, 1))
+            + rng.gamma(0.1, 0.05, size=(R, g_sp)))
+    out_idx = rng.choice(R, size=R // 50, replace=False)
+    rows[out_idx] = rng.gamma(0.3, 1.0, size=(len(out_idx), g_sp)) * 4.0
+    l2 = (rows / np.linalg.norm(rows, axis=1, keepdims=True)
+          ).astype(np.float32)
+    n_neighbors = int(0.30 * R / K)
+
+    def exact_stage():
+        dens, _ = local_density(l2, n_neighbors)
+        labels, _, _ = kmeans(l2, K, n_init=10, seed=1)
+        return np.asarray(dens), np.asarray(labels)
+
+    def sketched_stage():
+        proj = project_rows(l2, dim)
+        dens, _ = local_density(proj, n_neighbors)
+        labels, _, _ = kmeans(proj, K, n_init=10, seed=1)
+        return np.asarray(dens), np.asarray(labels)
+
+    def timed(fn, reps=3):
+        fn()  # warm (compile + upload)
+        walls = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fn()
+            walls.append(time.perf_counter() - t0)
+        return sorted(walls)[len(walls) // 2], out
+
+    exact_wall, (dens_e, lab_e) = timed(exact_stage)
+    sk_wall, (dens_s, lab_s) = timed(sketched_stage)
+    thr = 0.5
+
+    def medians(labels, keep):
+        # k-means can leave a cluster empty among the density-kept rows
+        # (collapsed programs); medians over the PRESENT clusters only —
+        # an empty-slice np.median would silently turn the parity figure
+        # into NaN
+        present = [c for c in range(K) if (labels[keep] == c).any()]
+        med = np.stack([np.median(l2[keep][labels[keep] == c], axis=0)
+                        for c in present])
+        return med / np.maximum(
+            np.linalg.norm(med, axis=1, keepdims=True), 1e-12)
+
+    keep_e, keep_s = dens_e < thr, dens_s < thr
+    cos = (medians(lab_e, keep_e) @ medians(lab_s, keep_s).T).max(axis=1)
+    results["consensus"] = {
+        "replicates": R, "spectra_width": g_sp, "sketch_dim": dim,
+        "exact_wall_s": round(exact_wall, 3),
+        "sketch_wall_s": round(sk_wall, 3),
+        "speedup": round(exact_wall / max(sk_wall, 1e-9), 2),
+        "outlier_set_identical": bool((keep_e == keep_s).all()),
+        "outliers": int((~keep_e).sum()),
+        "median_cosine_min": round(float(cos.min()), 5),
+    }
+
+    # ---- solver: sketched W update on the 95%-sparse KL fixture -------
+    if jax.default_backend() == "cpu":
+        n, g, k = 4000, 1000, 9
+        fit_iters = 40
+    else:
+        n, g, k = 10000, 2000, 9
+        fit_iters = 80
+    Xs = synthetic_sparse_pbmc_like(n=n, g=g)
+    sparsity = 1.0 - Xs.nnz / (n * g)
+    E = ell_device_put(csr_to_ell(Xs))
+    m = max(256, n // 8)
+    x_mean = float(Xs.sum() / (n * g))
+    H0, W0 = random_init(jax.random.key(0), n, g, k, jnp.float32(x_mean))
+
+    w_exact = jax.jit(lambda h, w: _update_W(E, h, w, 1.0, 0.0, 0.0))
+
+    @jax.jit
+    def w_sketched(h, w, it):
+        idx = jax.random.randint(
+            jax.random.fold_in(jax.random.key(0), it), (m,), 0, n)
+        numer, denom = ell_kl_w_stats_rows(E, h, w, idx)
+        return _apply_rate_sketched(w, numer, denom, 0.0, 0.0)
+
+    # the warm-then-median timing discipline lives in ONE place
+    # (utils/autotune.py:_time_call) — the autotuner and this tier must
+    # never measure differently
+    from cnmf_torch_tpu.utils.autotune import _time_call
+
+    us_exact = _time_call(w_exact, H0, W0, repeats=7) * 1e6
+    us_sk = _time_call(w_sketched, H0, W0, jnp.int32(1), repeats=7) * 1e6
+
+    # whole-solve us/iter via the N-vs-3N probe (amortizes the fixed
+    # end-of-solve objective recompute out of the per-iteration figure)
+    def solve_wall(n_it, **kw):
+        out = nmf_fit_batch(E, H0, W0, beta=1.0, tol=0.0, max_iter=n_it,
+                            **kw)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        jax.block_until_ready(nmf_fit_batch(
+            E, H0, W0, beta=1.0, tol=0.0, max_iter=n_it, **kw))
+        return time.perf_counter() - t0, float(out[2])
+
+    sk_kw = dict(sketch_dim=m, sketch_exact_every=4)
+    t1_mu, _ = solve_wall(fit_iters)
+    t3_mu, err_mu = solve_wall(3 * fit_iters)
+    t1_sk, _ = solve_wall(fit_iters, **sk_kw)
+    t3_sk, err_sk = solve_wall(3 * fit_iters, **sk_kw)
+    us_it_mu = (t3_mu - t1_mu) / (2 * fit_iters) * 1e6
+    us_it_sk = (t3_sk - t1_sk) / (2 * fit_iters) * 1e6
+    results["solver"] = {
+        "fixture": {"n": n, "g": g, "k": k,
+                    "sparsity": round(float(sparsity), 4),
+                    "ell_width": int(E.width)},
+        "sketch_dim": int(m), "exact_every": 4,
+        "w_update_exact_us": round(us_exact, 1),
+        "w_update_sketched_us": round(us_sk, 1),
+        "w_update_speedup": round(us_exact / max(us_sk, 1e-9), 2),
+        "solve_us_per_iter_mu": round(us_it_mu, 1),
+        "solve_us_per_iter_sketch": round(us_it_sk, 1),
+        "solve_per_iter_speedup": round(us_it_mu / max(us_it_sk, 1e-9),
+                                        2),
+        "final_err_mu": round(err_mu, 2),
+        "final_err_sketch": round(err_sk, 2),
+        "objective_rel_gap": round(abs(err_sk - err_mu) / err_mu, 5),
+        "crossover_note": (
+            "sketched W update slower than exact on this backend at "
+            "this shape (scatter-bound); lane sized for accelerators"
+            if us_sk >= us_exact else ""),
+    }
+    results["telemetry"] = _tier_telemetry()
+    return results
+
+
 def _chip_peaks():
     import jax
 
@@ -1152,7 +1315,8 @@ def main():
         fn = {"north_star": bench_north_star, "anchor": bench_anchor,
               "kl": bench_kl, "accel": bench_accel, "mfu": bench_mfu,
               "rowshard": bench_rowshard, "ingest": bench_ingest,
-              "harmony": bench_harmony}[args.tier]
+              "harmony": bench_harmony,
+              "sketch": bench_sketch}[args.tier]
         result = fn()
         with open(args.out, "w") as f:
             json.dump(result, f)

@@ -41,6 +41,7 @@ from ..ops import (
 )
 from ..ops.nmf import (beta_loss_to_float, fit_h, resolve_online_schedule,
                        run_nmf)
+from ..ops.sketch import project_rows, resolve_consensus_sketch
 from ..parallel import replicate_sweep, worker_filter
 from ..utils.anndata_lite import (AnnDataLite, atomic_artifact, read_h5ad,
                                   write_h5ad)
@@ -978,6 +979,18 @@ class cNMF:
         # CNMF_TPU_SPARSE_BETA=0 forces dense, =1 forces ELL. The dense
         # path remains the default everywhere else.
         beta_val = beta_loss_to_float(_nmf_kwargs["beta_loss"])
+        # measured-rho startup microbench (ISSUE 11 satellite): when the
+        # accel knobs could engage an amu schedule FOR THIS BETA, make
+        # sure this device's measured cost-ratio cache exists before any
+        # recipe resolves — auto_inner_repeats then reads the measured
+        # scale instead of the CPU-measured static clamp. Cached per
+        # device fingerprint (~1 s once); a no-op whenever accel is off,
+        # rho is pinned, the engaged recipe cannot be amu (sketch/dna),
+        # the pod is multi-host, or the cache already exists.
+        # Best-effort by construction (falls back to the static ratio).
+        from ..utils.autotune import maybe_autotune_rho
+
+        maybe_autotune_rho(beta=beta_val)
         use_ell = False
         if (sp.issparse(norm_counts.X) and beta_val in (1.0, 0.0)
                 and _nmf_kwargs.get("init", "random") == "random"
@@ -1135,6 +1148,10 @@ class cNMF:
             n=int(norm_counts.X.shape[0]), g=int(norm_counts.X.shape[1]),
             k=max(by_k) if by_k else None,
             ell_width=X.width if use_ell else None)
+        if packed and recipe.algo == "sketch":
+            # the packed K-sweep compiles the exact mu-family programs;
+            # a sketch-lane factorize dispatches per-K sweeps instead
+            packed = False
         self._events.emit("dispatch", decision="solver_recipe",
                           context=recipe.as_context())
         self._save_factorize_provenance(
@@ -2133,7 +2150,13 @@ class cNMF:
 
         import jax.numpy as jnp
 
-        sig = (R, int(k), n_hv, g_hv, int(n_neighbors), bool(stats_only))
+        # the distance-bearing warms must match the width consensus
+        # will actually dispatch at — under the sketch lane that is the
+        # projection dim, not g_hv (ops/sketch.py)
+        sk = resolve_consensus_sketch(int(R), int(g_hv))
+        feat_w = sk.dim if sk.engaged else g_hv
+        sig = (R, int(k), n_hv, g_hv, int(n_neighbors), bool(stats_only),
+               bool(sk.engaged), int(feat_w))
         if sig in self._warmed:
             if norm_counts is not None:
                 self._stage_dense("norm_counts", norm_counts.X)
@@ -2169,15 +2192,19 @@ class cNMF:
                   chunk_max_iter=cmi, h_tol=0.05, l1_reg_H=l1H,
                   l2_reg_H=0.0, beta=beta)
 
-        ones_Rg = np.ones((R, g_hv), np.float32)
-        jobs = [lambda: kmeans(ones_Rg, int(k), n_init=10, seed=1),
+        ones_Rf = np.ones((R, feat_w), np.float32)
+        jobs = [lambda: kmeans(ones_Rf, int(k), n_init=10, seed=1),
                 lambda: run_fit_h(n_hv, g_hv, int(k))]
+        if sk.engaged:
+            jobs.append(
+                lambda: project_rows(np.ones((R, g_hv), np.float32),
+                                     sk.dim))
         if stats_only:
             jobs.append(lambda: silhouette_score(
-                ones_Rg, np.zeros((R,), np.int32), int(k)))
+                ones_Rf, np.zeros((R,), np.int32), int(k)))
         else:
-            jobs.append(lambda: knn_local_density(ones_Rg, int(n_neighbors)))
-            jobs.append(lambda: kmeans(ones_Rg, int(k), n_init=10, seed=1,
+            jobs.append(lambda: knn_local_density(ones_Rf, int(n_neighbors)))
+            jobs.append(lambda: kmeans(ones_Rf, int(k), n_init=10, seed=1,
                                        mask=np.ones((R,), dtype=bool)))
             try:
                 from ..utils.anndata_lite import peek_h5ad_shape
@@ -2227,7 +2254,10 @@ class cNMF:
         usage-refit at the sweep's shared padded shapes) concurrently —
         the packed analog of :meth:`_warm_consensus_programs`, three
         executables instead of three per K."""
-        sig = ("kpacked", int(R_max), int(K_max), int(n_hv), int(g_hv))
+        sk = resolve_consensus_sketch(int(R_max), int(g_hv))
+        feat_w = int(sk.dim if sk.engaged else g_hv)
+        sig = ("kpacked", int(R_max), int(K_max), int(n_hv), int(g_hv),
+               bool(sk.engaged), feat_w)
         if sig in self._warmed:
             return
         self._warmed.add(sig)
@@ -2241,7 +2271,9 @@ class cNMF:
         csz = int(kw.get("online_chunk_size", 5000))
         l1H = float(kw.get("l1_ratio_H", 0.0))
 
-        ones_Rg = np.ones((int(R_max), int(g_hv)), np.float32)
+        # packed kmeans/silhouette dispatch at the sketched width when
+        # the sketch lane is on (consensus pads the PROJECTED spectra)
+        ones_Rg = np.ones((int(R_max), feat_w), np.float32)
 
         def warm_kmeans():
             kmeans(ones_Rg, int(K_max), n_init=10, seed=1,
@@ -2298,7 +2330,8 @@ class cNMF:
                   build_ref=True, skip_density_and_return_after_stats=False,
                   close_clustergram_fig=False, refit_usage=True,
                   normalize_tpm_spectra=False, norm_counts=None,
-                  ols_batch_size=65536, _packed_dims=None):
+                  ols_batch_size=65536, _packed_dims=None,
+                  _sketch_override=None):
         """Consensus spectra/usages from the merged replicate matrix
         (``cnmf.py:997-1256``): L2-normalize, KNN local-density outlier
         filter (cached), k-means(k, 10 inits, fixed key), cluster medians,
@@ -2345,22 +2378,61 @@ class cNMF:
         l2_spectra = (merged_spectra.T
                       / np.sqrt((merged_spectra ** 2).sum(axis=1))).T
 
+        # sketched consensus (ISSUE 11, ops/sketch.py): the distance-
+        # bearing stages (KNN density filter, k-means, silhouette,
+        # clustergram distances) run on a seeded random projection of
+        # the replicate spectra (~256 dims), turning the O(R^2 * g_hv)
+        # reductions into O(R^2 * dim); cluster MEDIANS (the artifact)
+        # are always recovered from the full-width spectra within the
+        # final clusters, and the refits never see the projection
+        # _sketch_override (k_selection_plot): the SWEEP-level decision,
+        # resolved once from R_max — per-k auto resolution would compare
+        # stats computed in different feature spaces across the Ks of
+        # one selection curve (exact width below the engagement
+        # threshold, projected above), biasing the selected K at the
+        # boundary
+        sk = (_sketch_override if _sketch_override is not None
+              else resolve_consensus_sketch(int(l2_spectra.shape[0]),
+                                            int(l2_spectra.shape[1])))
+        cluster_feats = l2_spectra.values
+        if sk.engaged:
+            with self._timer.stage("consensus.sketch"):
+                cluster_feats = project_rows(l2_spectra.values, sk.dim)
+        self._events.emit(
+            "dispatch", decision="consensus_path",
+            context=dict(
+                sk.as_context(),
+                stage=("k_selection_stats"
+                       if skip_density_and_return_after_stats
+                       else "consensus"),
+                k=int(k), replicates=int(l2_spectra.shape[0]),
+                packed=_packed_dims is not None,
+                distance_width=int(cluster_feats.shape[1]),
+                distance_shape=[int(l2_spectra.shape[0])] * 2))
+
         topics_dist = None
         density_filter = None
         local_density = None
         kmeans_mask = None
         if not skip_density_and_return_after_stats:
-            if os.path.isfile(self.paths["local_density_cache"] % k):
+            if (not sk.engaged
+                    and os.path.isfile(
+                        self.paths["local_density_cache"] % k)):
                 local_density = load_df_from_npz(
                     self.paths["local_density_cache"] % k)
             else:
                 with self._timer.stage("consensus.density"):
-                    dens, topics_dist = knn_local_density(l2_spectra.values,
+                    dens, topics_dist = knn_local_density(cluster_feats,
                                                           n_neighbors)
                 local_density = pd.DataFrame(
                     dens, columns=["local_density"], index=l2_spectra.index)
-                save_df_to_npz(local_density,
-                               self.paths["local_density_cache"] % k)
+                if not sk.engaged:
+                    # sketched densities are JL-tolerance approximations;
+                    # never write them into the exact runs' cache (and
+                    # never read a cached exact pass as "the" sketched
+                    # result — the parity gate compares both lanes)
+                    save_df_to_npz(local_density,
+                                   self.paths["local_density_cache"] % k)
 
             density_filter = local_density.iloc[:, 0] < density_threshold
             n_keep = int(density_filter.sum())
@@ -2396,23 +2468,25 @@ class cNMF:
         with self._timer.stage("consensus.kmeans"):
             if _packed_dims is not None:
                 R_actual = l2_spectra.shape[0]
-                l2_padded = np.zeros((_packed_dims[0], l2_spectra.shape[1]),
-                                     np.float32)
-                l2_padded[:R_actual] = l2_spectra.values
+                l2_padded = np.zeros((_packed_dims[0],
+                                      cluster_feats.shape[1]), np.float32)
+                l2_padded[:R_actual] = cluster_feats
                 labels_padded, _centers, _inertia = kmeans(
                     l2_padded, int(k), n_init=10, seed=1, n_rows=R_actual,
                     k_pad=_packed_dims[1])
                 labels_all = labels_padded[:R_actual]
             else:
-                labels_all, _centers, _inertia = kmeans(l2_spectra.values, k,
+                labels_all, _centers, _inertia = kmeans(cluster_feats, k,
                                                         n_init=10, seed=1,
                                                         mask=kmeans_mask)
         if kmeans_mask is not None:
             l2_spectra = l2_spectra.loc[density_filter, :]
+            cluster_feats = cluster_feats[kmeans_mask]
             labels0 = labels_all[kmeans_mask]
         else:
             if density_filter is not None:
                 l2_spectra = l2_spectra.loc[density_filter, :]
+                cluster_feats = cluster_feats[density_filter.values]
             labels0 = labels_all
         kmeans_cluster_labels = pd.Series(labels0 + 1,
                                           index=l2_spectra.index)
@@ -2436,7 +2510,9 @@ class cNMF:
                     l2_padded, labels_padded, n_rows=l2_spectra.shape[0],
                     k_pad=_packed_dims[1])
             else:
-                silhouette = silhouette_score(l2_spectra.values, labels0, k)
+                # same feature space the clustering ran in (the sketched
+                # stats path is where the quadratic cost lives)
+                silhouette = silhouette_score(cluster_feats, labels0, k)
             tok = self._content_token(norm_counts.X)
             if tok not in self._x_sq_cache:
                 self._x_sq_cache[tok] = _x_squared_sum(norm_counts.X)
@@ -2549,7 +2625,9 @@ class cNMF:
             if topics_dist is None:
                 from ..ops import pairwise_euclidean
 
-                topics_dist = pairwise_euclidean(l2_spectra.values)
+                # sketched runs plot JL-approximate distances (the
+                # clustergram is a visualization; medians stay exact)
+                topics_dist = pairwise_euclidean(cluster_feats)
             else:
                 topics_dist = topics_dist[density_filter.values, :][
                     :, density_filter.values]
@@ -2626,6 +2704,17 @@ class cNMF:
         R_by_k = {int(k): int((run_params.n_components == k).sum()) * int(k)
                   for k in ks_sorted}
         packed_dims = (max(R_by_k.values()), int(max(ks_sorted)))
+        # ONE sweep-level sketch decision (from R_max) for every K's
+        # stats pass — see consensus(_sketch_override=...)
+        sk_sweep = resolve_consensus_sketch(int(packed_dims[0]),
+                                            int(norm_counts.X.shape[1]))
+        self._events.emit(
+            "dispatch", decision="k_selection",
+            context=dict(
+                sk_sweep.as_context(),
+                ks=[int(x) for x in ks_sorted],
+                R_max=int(packed_dims[0]), K_max=int(packed_dims[1]),
+                packed=True))
 
         # the pool threads below must only ever HIT these caches: neither
         # _stage_dense nor the x_sq fingerprint pass is safe/cheap under
@@ -2655,7 +2744,8 @@ class cNMF:
             return self.consensus(
                 int(k), skip_density_and_return_after_stats=True,
                 show_clustering=False, close_clustergram_fig=True,
-                norm_counts=norm_counts, _packed_dims=packed_dims).stats
+                norm_counts=norm_counts, _packed_dims=packed_dims,
+                _sketch_override=sk_sweep).stats
 
         with concurrent.futures.ThreadPoolExecutor(
                 min(4, len(ks_sorted))) as ex:

@@ -15,6 +15,7 @@ from .nmf import (
     run_nmf,
 )
 from .recipe import SolverRecipe, resolve_recipe
+from .sketch import ConsensusSketch, project_rows, resolve_consensus_sketch
 from .ols import ols_all_cols
 from .stats import column_mean_var, normalize_total, row_sums, scale_columns
 
@@ -38,6 +39,9 @@ __all__ = [
     "run_nmf",
     "SolverRecipe",
     "resolve_recipe",
+    "ConsensusSketch",
+    "project_rows",
+    "resolve_consensus_sketch",
     "ols_all_cols",
     "column_mean_var",
     "normalize_total",

@@ -57,6 +57,7 @@ from .sparse import (
     ell_kl_h_newton_stats,
     ell_kl_h_stats,
     ell_kl_w_stats,
+    ell_kl_w_stats_rows,
     ell_row_width,
     ell_w_table,
     ell_wh_at_nz,
@@ -374,6 +375,19 @@ def _apply_rate(M, numer, denom, l1, l2, eps=EPS, gamma: float = 1.0):
     return M * rate
 
 
+def _apply_rate_sketched(W, numer, denom, l1, l2):
+    """MU rate from SUBSAMPLED W statistics (the 'sketch' recipe): an
+    entry whose sampled numerator carries no evidence — no sampled
+    nonzero landed in its column, so ``numer`` is exactly 0 — HOLDS its
+    value instead of multiplying by zero. Exact zeros are absorbing
+    under MU, so one unlucky subsample would otherwise permanently kill
+    a component weight (measured +74% final KL on the sparse fixture
+    before this guard); genuinely dead entries still decay through the
+    interleaved exact updates, whose numerators see every row."""
+    return jnp.where(numer > 0.0,
+                     _apply_rate(W, numer, denom, l1, l2), W)
+
+
 def _update_H(X, H, W, beta: float, l1: float, l2: float,
               bf16_ratio: bool = False, w_table=None):
     if isinstance(X, EllMatrix):
@@ -629,13 +643,14 @@ def _trace_init(err0, with_inner: bool = False,
     jax.jit,
     static_argnames=("beta", "max_iter", "update_W_flag", "l1_H", "l2_H",
                      "l1_W", "l2_W", "telemetry", "inner_repeats",
-                     "kl_newton"),
+                     "kl_newton", "sketch_dim", "sketch_exact_every"),
 )
 def nmf_fit_batch(X, H0, W0, beta: float = 2.0, tol: float = 1e-4,
                   max_iter: int = 200, l1_H: float = 0.0, l2_H: float = 0.0,
                   l1_W: float = 0.0, l2_W: float = 0.0,
                   update_W_flag: bool = True, telemetry: bool = False,
-                  inner_repeats: int = 1, kl_newton: bool = False):
+                  inner_repeats: int = 1, kl_newton: bool = False,
+                  sketch_dim: int = 0, sketch_exact_every: int = 1):
     """Alternating MU until the relative objective decrease over an
     ``EVAL_EVERY``-iteration window falls below ``tol`` (sklearn-style
     criterion) or ``max_iter``. Returns ``(H, W, err)``.
@@ -665,14 +680,38 @@ def nmf_fit_batch(X, H0, W0, beta: float = 2.0, tol: float = 1e-4,
     inputs accelerate the H side and keep the exact MU W step). Measured
     4–6× fewer outer iterations to a fixed KL tolerance on the bench
     fixtures (``bench.py --tier accel``).
+
+    ``sketch_dim``/``sketch_exact_every`` (β=1 only — the 'sketch'
+    recipe, ISSUE 11, arXiv:1604.04026; both STATIC, the default
+    ``(0, 1)`` program is byte-identical to a build without them): the
+    H updates stay exact, while each W update runs from a fresh
+    ``sketch_dim``-row subsample of X (seeded per-iteration threefry
+    stream shared across vmapped replicates so the gather indices — and
+    the X row gather — are batch-invariant), with the EXACT full-data
+    W update at iteration 0 and every ``sketch_exact_every``-th
+    iteration to control subsampling bias. Numerator and denominator
+    come from the same subsample, so the MU rate's n/m scale cancels;
+    the objective evaluations (and the stopping rule) stay exact.
     """
     inner_repeats = int(inner_repeats)
+    sketch_dim = int(sketch_dim)
     if kl_newton and beta != 1.0:
         raise ValueError(
             f"kl_newton is the beta=1 (KL) Newton recipe, got beta={beta}")
     if kl_newton and inner_repeats != 1:
         raise ValueError("kl_newton and inner_repeats>1 are exclusive "
                          "recipes (dna vs amu)")
+    if sketch_dim:
+        if beta != 1.0:
+            raise ValueError(
+                f"sketch_dim is the beta=1 (KL) sketch recipe's knob, "
+                f"got beta={beta}")
+        if kl_newton or inner_repeats != 1:
+            raise ValueError("the sketch recipe is exclusive with the "
+                             "dna/amu recipes")
+        n_total = int(X.vals.shape[0] if isinstance(X, EllMatrix)
+                      else X.shape[0])
+        sketch_dim = min(sketch_dim, n_total)
     err0 = beta_divergence(X, H0, W0, beta=beta)
 
     # accelerated recipes on ELL input share ONE pre-gathered W slab
@@ -720,9 +759,49 @@ def nmf_fit_batch(X, H0, W0, beta: float = 2.0, tol: float = 1e-4,
                                            (H, rel0, jnp.int32(0)))
         return H_new, cnt, None
 
-    def w_step(H, W, table):
+    def w_step(H, W, table, it):
         if not update_W_flag:
             return W, None
+        if sketch_dim:
+            # sketched KL W update (ISSUE 11): lax.cond so only the
+            # engaged branch executes — the exact interleave (iteration
+            # 0, every E-th, AND the iteration feeding each objective
+            # evaluation) anchors the trajectory, the sketched branch
+            # does O(m/n) of the statistics work. Anchoring the eval
+            # iterations matters for the stopping rule: the relative-
+            # decrease window must compare exactly-updated states, or
+            # subsample noise reads as convergence and stops the solve
+            # tens of iterations early (measured on the sparse fixture)
+            def _exact(_):
+                return _update_W(X, H, W, beta, l1_W, l2_W)
+
+            def _sketched(_):
+                idx = jax.random.randint(
+                    jax.random.fold_in(jax.random.key(0), it),
+                    (sketch_dim,), 0, n_total)
+                if isinstance(X, EllMatrix):
+                    numer, denom = ell_kl_w_stats_rows(X, H, W, idx)
+                else:
+                    Xs = jnp.take(X, idx, axis=0)
+                    Hs = jnp.take(H, idx, axis=0)
+                    WHs = jnp.maximum(Hs @ W, EPS)
+                    numer = Hs.T @ (Xs / WHs)
+                    denom = jnp.broadcast_to(Hs.sum(axis=0)[:, None],
+                                             W.shape)
+                # penalties scale with the sampled fraction: the m/n-
+                # scaled statistics against the FULL l1/l2 would over-
+                # regularize by ~n/m (and an l1 larger than a sampled
+                # numerator would kill entries the evidence guard
+                # protects); scaling both by m/n leaves the MU rate an
+                # unbiased estimate of the exact regularized rate
+                sc = sketch_dim / n_total
+                return _apply_rate_sketched(W, numer, denom,
+                                            l1_W * sc, l2_W * sc)
+
+            exact_now = ((it % max(sketch_exact_every, 1) == 0)
+                         | ((it + 1) % EVAL_EVERY == 0))
+            return jax.lax.cond(exact_now, _exact, _sketched,
+                                operand=None), None
         if kl_newton and not isinstance(X, EllMatrix):
             return _dna_w_step(X, H, W, l1_W, l2_W)
         if table is not None:
@@ -748,7 +827,7 @@ def nmf_fit_batch(X, H0, W0, beta: float = 2.0, tol: float = 1e-4,
         table = (ell_w_table(W, X.cols)
                  if accel and isinstance(X, EllMatrix) else None)
         H, inner_n, fb_h = h_step(H, W, table)
-        W, fb_w = w_step(H, W, table)
+        W, fb_w = w_step(H, W, table, it)
         if fb_h is not None and fb_w is not None:
             fb = 0.5 * (fb_h + fb_w)
         else:
@@ -1146,6 +1225,12 @@ def _chunk_h_solve(x, h, W, WWT, beta, l1, l2, max_iter, h_tol,
     fallback lane (:func:`_dna_h_step`) instead of plain MU — fewer
     inner iterations to the same block tolerance. Strict f32 (callers
     force the bf16 ratio chain off for this recipe).
+
+    The 'sketch' recipe (ISSUE 11) deliberately leaves this solver
+    EXACT: every cell's usage block must be solved anyway (H has a row
+    per cell), so the compressible work is the W statistics the
+    callers' W steps compute — see ``nmf_fit_batch``/``nmf_fit_online``
+    and ``parallel/rowshard.py:_rowsharded_pass``.
     """
     if kl_newton and beta == 1.0:
         if isinstance(x, EllMatrix) and w_table is None:
@@ -1195,7 +1280,8 @@ def _chunk_h_solve(x, h, W, WWT, beta, l1, l2, max_iter, h_tol,
     jax.jit,
     static_argnames=("beta", "chunk_max_iter", "n_passes", "l1_H", "l2_H",
                      "l1_W", "l2_W", "h_tol_start", "algo", "bf16_ratio",
-                     "telemetry", "kl_newton"),
+                     "telemetry", "kl_newton", "sketch_dim",
+                     "sketch_exact_every"),
 )
 def nmf_fit_online(Xc, Hc0, W0, beta: float = 2.0, tol: float = 1e-4,
                    h_tol: float = 1e-3, chunk_max_iter: int = 1000,
@@ -1203,7 +1289,8 @@ def nmf_fit_online(Xc, Hc0, W0, beta: float = 2.0, tol: float = 1e-4,
                    l1_W: float = 0.0, l2_W: float = 0.0,
                    h_tol_start: float | None = None, algo: str = "mu",
                    bf16_ratio: bool = False, telemetry: bool = False,
-                   kl_newton: bool = False):
+                   kl_newton: bool = False, sketch_dim: int = 0,
+                   sketch_exact_every: int = 1):
     """Streamed MU over pre-chunked inputs.
 
     ``Xc``: (n_chunks, chunk, genes) row-chunked data (zero-padded rows are
@@ -1239,12 +1326,28 @@ def nmf_fit_online(Xc, Hc0, W0, beta: float = 2.0, tol: float = 1e-4,
     per-chunk usage solves run diagonal-Newton steps with the monotone
     MU fallback lane; the per-chunk W step stays MU. Forces the bf16
     ratio chain off (DNA's curvature is cancellation-sensitive).
+
+    ``sketch_dim``/``sketch_exact_every`` (STATIC; β=1 only — the
+    'sketch' recipe, ISSUE 11): the per-chunk usage solves stay exact,
+    while each chunk's W step runs from a ``sketch_dim``-row subsample
+    of that chunk (per-(pass, chunk) seeded indices); every
+    ``sketch_exact_every``-th PASS (and the first) runs exact chunk W
+    steps. Per-chunk objectives — the pass stopping rule — stay exact.
+    Strict f32 (the bf16 ratio chain is forced off like dna's).
     """
     if kl_newton and beta != 1.0:
         raise ValueError(
             f"kl_newton is the beta=1 (KL) Newton recipe, got beta={beta}")
+    sketch_dim = int(sketch_dim)
+    if sketch_dim:
+        if beta != 1.0:
+            raise ValueError(
+                f"sketch_dim is the beta=1 (KL) sketch recipe's knob, "
+                f"got beta={beta}")
+        if kl_newton:
+            raise ValueError("the sketch recipe is exclusive with dna")
     bf16_ratio = (bool(bf16_ratio) and beta in (1.0, 0.0)
-                  and not kl_newton)
+                  and not kl_newton and not sketch_dim)
     if algo not in ("mu", "halsvar"):
         raise ValueError(f"unknown online algo {algo!r}")
     if algo == "halsvar" and beta != 2.0:
@@ -1342,8 +1445,73 @@ def nmf_fit_online(Xc, Hc0, W0, beta: float = 2.0, tol: float = 1e-4,
                                 gamma=mu_gamma(beta))
                 return (W, err_acc + err_c), h
 
-            (W, err), Hc = jax.lax.scan(scan_chunk, (W, jnp.float32(0.0)),
-                                        (Xc, Hc))
+            if sketch_dim:
+                # sketched KL W steps (ISSUE 11): exact usage solves and
+                # exact per-chunk objectives, W statistics from a fresh
+                # per-(pass, chunk) row subsample of the chunk; every
+                # sketch_exact_every-th PASS runs the exact chunk steps
+                n_chunks_s = (Xc.vals.shape[0] if isinstance(Xc, EllMatrix)
+                              else Xc.shape[0])
+                chunk_rows_s = (Xc.vals.shape[1]
+                                if isinstance(Xc, EllMatrix)
+                                else Xc.shape[1])
+                m_c = min(sketch_dim, chunk_rows_s)
+                exact_pass = (p % max(sketch_exact_every, 1) == 0)
+
+                def w_step_sk(x, h, W, ci, table=None):
+                    def _exact(_):
+                        return _update_W(x, h, W, beta, l1_W, l2_W,
+                                         w_table=table)
+
+                    def _sk(_):
+                        idx = jax.random.randint(
+                            jax.random.fold_in(jax.random.key(1),
+                                               p * n_chunks_s + ci),
+                            (m_c,), 0, chunk_rows_s)
+                        if isinstance(x, EllMatrix):
+                            numer, denom = ell_kl_w_stats_rows(x, h, W, idx)
+                        else:
+                            xs = jnp.take(x, idx, axis=0)
+                            hs = jnp.take(h, idx, axis=0)
+                            WHs = jnp.maximum(hs @ W, EPS)
+                            numer = hs.T @ (xs / WHs)
+                            denom = jnp.broadcast_to(
+                                hs.sum(axis=0)[:, None], W.shape)
+                        sc = m_c / chunk_rows_s
+                        return _apply_rate_sketched(W, numer, denom,
+                                                    l1_W * sc, l2_W * sc)
+
+                    return jax.lax.cond(exact_pass, _exact, _sk,
+                                        operand=None)
+
+                def scan_chunk_sk(carry, xc_hc_i):
+                    W, err_acc = carry
+                    x, h, ci = xc_hc_i
+                    # one pre-gathered W slab table serves the (exact)
+                    # usage solve AND the exact-pass W step, exactly
+                    # like the non-sketch ELL lane's shared table — the
+                    # sketched W branch alone skips it (its sampled-row
+                    # gather is the whole point)
+                    table = (ell_w_table(W, x.cols)
+                             if isinstance(x, EllMatrix) else None)
+                    h = _chunk_h_solve(x, h, W, None, beta, l1_H, l2_H,
+                                       chunk_max_iter, h_tol_p,
+                                       w_table=table)
+                    if isinstance(x, EllMatrix):
+                        err_c = ell_beta_err(x, h, W, beta)
+                    else:
+                        err_c = _beta_div_dense(
+                            x, jnp.maximum(h @ W, EPS), beta)
+                    W = w_step_sk(x, h, W, ci, table)
+                    return (W, err_acc + err_c), h
+
+                (W, err), Hc = jax.lax.scan(
+                    scan_chunk_sk, (W, jnp.float32(0.0)),
+                    (Xc, Hc, jnp.arange(n_chunks_s)))
+            else:
+                (W, err), Hc = jax.lax.scan(scan_chunk,
+                                            (W, jnp.float32(0.0)),
+                                            (Xc, Hc))
         return (Hc, W, err), err
 
     # first pass to establish err0, then scan remaining passes with early
@@ -1365,7 +1533,15 @@ def nmf_fit_online(Xc, Hc0, W0, beta: float = 2.0, tol: float = 1e-4,
             still_coarse = (h_tol_start * 0.5 ** it.astype(jnp.float32)
                             > h_tol)
         progressing = (err_prev - err) / jnp.maximum(err0, EPS) >= tol
-        return (it < n_passes) & (still_coarse | progressing)
+        keep = still_coarse | progressing
+        if sketch_dim:
+            # only an exact-pass state may stop the loop: pass index
+            # it-1 ran exact W steps iff (it-1) % E == 0 — subsample
+            # noise reading as sub-tol progress must not freeze a
+            # sketched W as the result (the rowshard lanes and
+            # nmf_fit_batch's eval-boundary anchor share this contract)
+            keep = keep | ((it - 1) % max(sketch_exact_every, 1) != 0)
+        return (it < n_passes) & keep
 
     def pass_body(carry):
         if telemetry:
@@ -1793,7 +1969,7 @@ def run_nmf(X, n_components: int, init: str = "random",
                 "the hals recipe optimizes the Frobenius objective; use "
                 "algo='mu' recipes for kullback-leibler / itakura-saito")
         algo = "halsvar"
-    if recipe.kl_newton and beta != 1.0:
+    if (recipe.kl_newton or recipe.algo == "sketch") and beta != 1.0:
         raise ValueError(
             f"recipe {recipe.label!r} requires beta=1 (KL), got "
             f"beta_loss={beta_loss!r}")
@@ -1842,7 +2018,9 @@ def run_nmf(X, n_components: int, init: str = "random",
                 max_iter=int(batch_max_iter),
                 l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W,
                 inner_repeats=int(recipe.inner_repeats),
-                kl_newton=bool(recipe.kl_newton))
+                kl_newton=bool(recipe.kl_newton),
+                sketch_dim=int(recipe.sketch_dim),
+                sketch_exact_every=int(recipe.sketch_exact_every))
     elif mode == "online":
         chunk = int(min(online_chunk_size, n))
         Xc, Hc, pad = _chunk_rows(X, H0, chunk)
@@ -1855,7 +2033,9 @@ def run_nmf(X, n_components: int, init: str = "random",
             # sequential rerun reproduces its numerics class and the env
             # opt-out governs both paths
             bf16_ratio=resolve_bf16_ratio(beta, mode),
-            kl_newton=bool(recipe.kl_newton))
+            kl_newton=bool(recipe.kl_newton),
+            sketch_dim=int(recipe.sketch_dim),
+            sketch_exact_every=int(recipe.sketch_exact_every))
         H = Hc.reshape(-1, k)[:n]
     else:
         raise ValueError(f"unknown mode {mode!r}")

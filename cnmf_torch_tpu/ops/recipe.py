@@ -26,7 +26,16 @@ iteration scheme a β-divergence solve runs —
     (``bench.py --tier accel``);
   * ``hals`` — the β=2 hierarchical-ALS family (``algo='halsvar'``),
     previously reachable only through ``run_nmf`` — the recipe selector
-    is now its dispatch site for replicate sweeps too.
+    is now its dispatch site for replicate sweeps too;
+  * ``sketch`` — randomized sketched KL (ISSUE 11, following arXiv
+    1604.04026's randomized-subsampling treatment of large sparse
+    KL-NMF): the H updates stay exact, while each W update runs against
+    a ``sketch_dim``-row random subsample of X (the MU ratio is
+    invariant to the n/m scaling, so the subsampled statistics feed the
+    unchanged update rate), with an EXACT full-data W update interleaved
+    every ``sketch_exact_every`` iterations (and at iteration 0) to
+    control bias. Sublinear W-update work in n; the stopping rule keeps
+    evaluating the exact objective.
 
 Resolution order: explicit caller arguments > env knobs > the auto
 heuristic. Knobs (registered in ``utils/envknobs.py``):
@@ -43,6 +52,16 @@ heuristic. Knobs (registered in ``utils/envknobs.py``):
   * ``CNMF_TPU_KL_NEWTON``: ``1`` (default) lets an *engaged*
     acceleration pick DNA for β=1; ``0`` restricts it to the MU repeat
     schedule.
+  * ``CNMF_TPU_SKETCH``: ``0`` (default) pins exact updates — programs
+    byte-identical to a build without the sketch layer; ``1`` forces
+    the ``sketch`` recipe for β=1 MU solves (and the sketched consensus
+    stage, ``ops/sketch.py``); ``auto`` engages the consensus-side
+    sketch only (tolerance-bounded distances) and leaves the solver
+    lane off.
+  * ``CNMF_TPU_SKETCH_DIM``: sampled rows per sketched W update (unset
+    derives :func:`auto_sketch_rows` from n) — shared with the
+    consensus projection dimension (``ops/sketch.py``).
+  * ``CNMF_TPU_SKETCH_EXACT_EVERY``: exact-pass cadence E (default 4).
 
 The resolved recipe is recorded whole: in the factorize provenance and
 telemetry ``dispatch`` events (``models/cnmf.py``), in every sweep's
@@ -58,11 +77,18 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 __all__ = ["SolverRecipe", "resolve_recipe", "auto_inner_repeats",
-           "ACCEL_ENV", "INNER_REPEATS_ENV", "KL_NEWTON_ENV"]
+           "auto_sketch_rows", "ACCEL_ENV", "INNER_REPEATS_ENV",
+           "KL_NEWTON_ENV", "SKETCH_ENV", "SKETCH_DIM_ENV",
+           "SKETCH_EXACT_EVERY_ENV", "DEFAULT_SKETCH_EXACT_EVERY"]
 
 ACCEL_ENV = "CNMF_TPU_ACCEL"
 INNER_REPEATS_ENV = "CNMF_TPU_INNER_REPEATS"
 KL_NEWTON_ENV = "CNMF_TPU_KL_NEWTON"
+SKETCH_ENV = "CNMF_TPU_SKETCH"
+SKETCH_DIM_ENV = "CNMF_TPU_SKETCH_DIM"
+SKETCH_EXACT_EVERY_ENV = "CNMF_TPU_SKETCH_EXACT_EVERY"
+
+DEFAULT_SKETCH_EXACT_EVERY = 4
 
 _OFF_WORDS = ("", "0", "off", "false", "no")
 _ON_WORDS = ("1", "on", "true", "yes", "force")
@@ -83,41 +109,97 @@ class SolverRecipe:
     inner_repeats: int = 1
     kl_newton: bool = False
     source: str = "default"
+    sketch_dim: int = 0
+    sketch_exact_every: int = 1
 
     def __post_init__(self):
-        if self.algo not in ("mu", "amu", "dna", "hals"):
+        if self.algo not in ("mu", "amu", "dna", "hals", "sketch"):
             raise ValueError(f"unknown recipe algo {self.algo!r}")
         if self.inner_repeats < 1:
             raise ValueError(
                 f"inner_repeats={self.inner_repeats}: must be >= 1")
         if self.kl_newton and self.algo != "dna":
             raise ValueError("kl_newton is the dna recipe's flag")
+        if self.algo == "sketch":
+            if self.sketch_dim < 1:
+                raise ValueError(
+                    "the sketch recipe needs sketch_dim >= 1 sampled rows")
+            if self.sketch_exact_every < 1:
+                raise ValueError(
+                    f"sketch_exact_every={self.sketch_exact_every}: "
+                    "must be >= 1")
+            if self.inner_repeats != 1 or self.kl_newton:
+                raise ValueError(
+                    "the sketch recipe is exclusive with amu/dna fields")
+        elif self.sketch_dim:
+            raise ValueError("sketch_dim is the sketch recipe's field")
 
     @property
     def label(self) -> str:
         """Short human/telemetry label: ``mu``, ``amu(rho=3)``, ``dna``,
-        ``hals``."""
+        ``hals``, ``sketch(m=512,E=4)``."""
         if self.algo == "amu":
             return f"amu(rho={self.inner_repeats})"
+        if self.algo == "sketch":
+            return (f"sketch(m={self.sketch_dim},"
+                    f"E={self.sketch_exact_every})")
         return self.algo
 
     @property
     def is_identity(self) -> bool:
         """True when the recipe compiles the exact seed (plain-MU/HALS)
-        programs — no inner repeats, no Newton lane."""
-        return self.inner_repeats == 1 and not self.kl_newton
+        programs — no inner repeats, no Newton lane, no sketched
+        updates."""
+        return (self.inner_repeats == 1 and not self.kl_newton
+                and self.algo != "sketch")
 
     def signature(self) -> str:
         """Stable string for the checkpoint identity ``params`` field —
-        two runs whose signatures differ must not splice trajectories."""
-        return (f"algo={self.algo},rho={int(self.inner_repeats)},"
-                f"newton={int(self.kl_newton)}")
+        two runs whose signatures differ must not splice trajectories.
+        Sketch fields append only when the sketch lane is engaged, so
+        pre-sketch checkpoints keep their identity."""
+        sig = (f"algo={self.algo},rho={int(self.inner_repeats)},"
+               f"newton={int(self.kl_newton)}")
+        if self.algo == "sketch":
+            sig += (f",skdim={int(self.sketch_dim)},"
+                    f"skE={int(self.sketch_exact_every)}")
+        return sig
 
     def as_context(self) -> dict:
         """The telemetry ``dispatch`` event context."""
         return {"recipe": self.label, "algo": self.algo,
                 "inner_repeats": int(self.inner_repeats),
-                "kl_newton": bool(self.kl_newton), "source": self.source}
+                "kl_newton": bool(self.kl_newton), "source": self.source,
+                "sketch_dim": int(self.sketch_dim),
+                "sketch_exact_every": int(self.sketch_exact_every)}
+
+
+def auto_sketch_rows(n: int | None) -> int:
+    """Default sampled-row count for the sketched W update: n/8 clamped
+    to [256, n] — small enough that the subsampled statistics pass is
+    sublinear, large enough that the MU ratio's sampled numerator/
+    denominator stay low-variance at single-cell sparsity (the exact
+    interleave controls the residual bias either way). ``n`` unknown at
+    the resolution site -> 2048 (run_nmf resolves before staging)."""
+    if not n:
+        return 2048
+    return int(max(min(256, n), min(n, n // 8)))
+
+
+def _measured_rho_scale(beta: float, ell: bool):
+    """Measured correction to the static amu cost ratio, cached per
+    device fingerprint by ``utils/autotune.py`` (ISSUE 11 satellite:
+    the [2, 8] clamp and the flop-count ratios were CPU-measured
+    constants). Returns ``None`` — static fallback — whenever no cache
+    exists or the jax-side reader is unavailable; this module stays
+    stdlib-only at import time (the import below is lazy and only runs
+    while a rho is actually being derived, i.e. with jax importable)."""
+    try:
+        from ..utils.autotune import cached_rho_scale
+
+        return cached_rho_scale(beta, ell=ell)
+    except Exception:
+        return None
 
 
 def auto_inner_repeats(beta: float, n: int | None = None,
@@ -157,7 +239,16 @@ def auto_inner_repeats(beta: float, n: int | None = None,
         else:
             h_rep = 2 * n * g * k
             w_upd = 2 * n * g * k
-        return int(max(2, min(8, 1 + round(w_upd / max(h_rep, 1)))))
+        ratio = w_upd / max(h_rep, 1)
+        scale = _measured_rho_scale(beta, ell)
+        if scale is not None:
+            # measured lane: the cached per-device scale corrects the
+            # static flop ratio for the real kernel walls (gathers,
+            # fusion, memory format), and the clamp widens to [2, 12] —
+            # a device whose W update is genuinely 10x its H repeat may
+            # schedule more repeats than the CPU-measured cap allowed
+            return int(max(2, min(12, 1 + round(ratio * scale))))
+        return int(max(2, min(8, 1 + round(ratio))))
     # shape-free fallbacks of the same ratios (the width cancels in the
     # ELL ratio, so flag-only resolution lands the same schedule)
     if beta == 2.0:
@@ -171,17 +262,28 @@ def resolve_recipe(beta: float, mode: str, *, algo: str = "mu",
                    ell_width: int | None = None,
                    accel: str | None = None,
                    inner_repeats: int | None = None,
-                   kl_newton: bool | None = None) -> SolverRecipe:
+                   kl_newton: bool | None = None,
+                   sketch: str | None = None,
+                   sketch_dim: int | None = None,
+                   sketch_exact_every: int | None = None) -> SolverRecipe:
     """Resolve the solver recipe for one (β, mode) solve.
 
     ``mode``: ``batch`` | ``online`` | ``rowshard``. ``algo`` is the
     ledger/caller algorithm choice (``mu`` or nmf-torch's ``halsvar``,
     which maps to the ``hals`` recipe outright). Explicit ``accel`` /
-    ``inner_repeats`` / ``kl_newton`` arguments win over the env knobs.
+    ``inner_repeats`` / ``kl_newton`` / ``sketch*`` arguments win over
+    the env knobs.
 
     Capability map (acceleration engages only where the scheme is
     defined; everything else resolves to plain ``mu``):
 
+      * ``sketch`` — β=1 anywhere a W update runs (batch, online,
+        rowshard: the scheme subsamples the W-update statistics, which
+        every lane computes). Wins over the accel lanes when both are
+        forced (the recipes are exclusive — one iteration scheme per
+        solve); ``CNMF_TPU_SKETCH=auto`` leaves the solver lane off
+        (the auto word engages the tolerance-bounded consensus sketch
+        only, ``ops/sketch.py``);
       * ``dna`` — β=1 anywhere ``_chunk_h_solve``/``nmf_fit_batch``
         run (batch, online, rowshard);
       * ``amu`` — batch solves (the online/rowshard pass loops ALREADY
@@ -195,6 +297,41 @@ def resolve_recipe(beta: float, mode: str, *, algo: str = "mu",
         raise ValueError(f"unknown solver algo {algo!r}")
 
     from ..utils.envknobs import env_flag, env_int, env_str
+
+    # -- sketch lane (ISSUE 11) -------------------------------------------
+    if sketch is None:
+        sk_raw, sk_src = env_str(SKETCH_ENV, "0"), "env"
+    else:
+        sk_raw, sk_src = str(sketch), "caller"
+    sk_raw = sk_raw.strip().lower()
+    if sk_raw not in _OFF_WORDS + _ON_WORDS + ("auto",):
+        raise ValueError(
+            f"{SKETCH_ENV}={sk_raw!r}: expected 0, 1, or auto")
+    # precedence: explicit caller arguments > env knobs (module
+    # contract). An ENV-sourced sketch word must not override a caller
+    # who explicitly pinned the accel family's fields; a CALLER-passed
+    # ``sketch`` still wins outright.
+    caller_pinned_accel = (accel is not None or inner_repeats is not None
+                           or kl_newton is not None)
+    if (sk_raw in _ON_WORDS and beta == 1.0
+            and not (sketch is None and caller_pinned_accel)):
+        m = sketch_dim
+        if m is None:
+            # the documented default is the string 'auto' (README knob
+            # table): accept it (and '') as the unset sentinel, like
+            # CNMF_TPU_INNER_REPEATS; anything else must parse as an int
+            raw_dim = env_str(SKETCH_DIM_ENV, "auto").strip().lower()
+            m = 0 if raw_dim in ("", "auto")                 else (env_int(SKETCH_DIM_ENV, 0, lo=0) or 0)
+        if not m:
+            m = auto_sketch_rows(n)
+        if n:
+            m = min(int(m), int(n))
+        E = sketch_exact_every
+        if E is None:
+            E = env_int(SKETCH_EXACT_EVERY_ENV,
+                        DEFAULT_SKETCH_EXACT_EVERY, lo=1)
+        return SolverRecipe("sketch", 1, False, sk_src,
+                            sketch_dim=int(m), sketch_exact_every=int(E))
 
     if accel is None:
         accel_raw, source = env_str(ACCEL_ENV, "0"), "env"

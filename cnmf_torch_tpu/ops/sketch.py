@@ -1,0 +1,124 @@
+"""Randomized sketching kernels (ISSUE 11) — the jax half of the sketch
+layer whose knob/recipe resolution lives in ``ops/recipe.py``.
+
+Two consumers:
+
+  * **Sketched KL-NMF** (the ``sketch`` solver recipe): the W-update row
+    subsample itself is traced inline in ``ops/nmf.py`` /
+    ``parallel/rowshard.py`` (a two-line fold_in + randint per update);
+    this module only owns the shared resolution/doc surface.
+  * **Sketched consensus** (this module): the replicate-spectra
+    clustering stage is O((K·n_iter)²·g_hv) in distance computations —
+    the pairwise-distance/KNN-density outlier filter, k-means, and
+    silhouette all reduce over the full g_hv-wide spectra. A seeded
+    Gaussian random projection to ``dim`` (~256) columns preserves all
+    pairwise euclidean distances to Johnson–Lindenstrauss tolerance
+    (entries N(0, 1/dim), so E‖Px‖² = ‖x‖²), after which those stages
+    cost O(R²·dim). Cluster MEDIANS are always recovered from the
+    original full-width spectra within the final clusters — only the
+    geometry that picks the clusters is compressed, never the artifact.
+
+Resolution (``resolve_consensus_sketch``): ``CNMF_TPU_SKETCH`` ``0`` off
+/ ``1`` forced / ``auto`` engages when the replicate stack is tall
+enough that the projection pays for itself (R >= 4x dim) and the
+spectra are wider than the target dim. The decision is recorded in the
+``consensus_path`` dispatch telemetry event (``models/cnmf.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .recipe import SKETCH_DIM_ENV, SKETCH_ENV
+
+__all__ = ["ConsensusSketch", "resolve_consensus_sketch", "project_rows",
+           "DEFAULT_CONSENSUS_DIM", "CONSENSUS_AUTO_MIN_RATIO"]
+
+# default JL projection width for consensus spectra: 256 dims keeps the
+# distance distortion well under the local-density threshold margins at
+# fixture and production replicate counts (ROADMAP item 4's "~256")
+DEFAULT_CONSENSUS_DIM = 256
+
+# the auto lane engages only when R >= ratio * dim: below that the R x R
+# distance pass is cheap enough that the projection matmul dominates
+CONSENSUS_AUTO_MIN_RATIO = 4
+
+
+@dataclass(frozen=True)
+class ConsensusSketch:
+    """One resolved consensus-sketch decision.
+
+    ``engaged``: project before the distance/density/k-means stage.
+    ``dim``: projection width (meaningful when engaged). ``source``:
+    who decided (``off`` / ``env`` / ``auto``) for the dispatch event.
+    """
+
+    engaged: bool
+    dim: int
+    source: str
+
+    def as_context(self) -> dict:
+        return {"sketch": bool(self.engaged),
+                "sketch_dim": int(self.dim) if self.engaged else 0,
+                "sketch_source": self.source}
+
+
+def resolve_consensus_sketch(n_rows: int, n_cols: int) -> ConsensusSketch:
+    """Resolve the consensus-stage sketch from the shared knobs.
+
+    ``n_rows``: stacked replicate-spectra count (K·n_iter). ``n_cols``:
+    spectra width (HVG count). Never engages when the projection would
+    not shrink the distance reductions (``dim >= n_cols``)."""
+    from ..utils.envknobs import env_int, env_str
+
+    raw = env_str(SKETCH_ENV, "0").strip().lower()
+    if raw in ("", "0", "off", "false", "no"):
+        return ConsensusSketch(False, 0, "off")
+    # 'auto'/'' are the dim knob's unset sentinel (its documented
+    # default cell), mirroring resolve_recipe's parse
+    raw_dim = env_str(SKETCH_DIM_ENV, "auto").strip().lower()
+    dim = (0 if raw_dim in ("", "auto")
+           else (env_int(SKETCH_DIM_ENV, 0, lo=0) or 0))
+    dim = int(dim or DEFAULT_CONSENSUS_DIM)
+    if dim >= n_cols and DEFAULT_CONSENSUS_DIM < n_cols:
+        # the knob is shared with the solver lane's sampled-ROW count: a
+        # solver-sized pin (e.g. n/8 = 2048 against 2000-wide spectra)
+        # must not silently disable a forced consensus sketch — fall
+        # back to the JL default width, which still projects down
+        dim = DEFAULT_CONSENSUS_DIM
+    if raw == "auto":
+        engaged = (n_rows >= CONSENSUS_AUTO_MIN_RATIO * dim
+                   and n_cols > dim)
+        return ConsensusSketch(engaged, dim if engaged else 0, "auto")
+    if raw in ("1", "on", "true", "yes", "force"):
+        if n_cols <= dim:
+            # projecting UP never pays; forced mode degrades cleanly to
+            # the exact stage instead of inflating the distance width
+            return ConsensusSketch(False, 0, "env")
+        return ConsensusSketch(True, dim, "env")
+    raise ValueError(f"{SKETCH_ENV}={raw!r}: expected 0, 1, or auto")
+
+
+@functools.partial(jax.jit, static_argnames=("dim", "seed"))
+def _project_rows_jit(A, dim: int, seed: int):
+    g = A.shape[1]
+    P = jax.random.normal(jax.random.key(seed), (g, dim),
+                          jnp.float32) * (1.0 / np.sqrt(dim))
+    return jnp.matmul(A, P, precision=jax.lax.Precision.HIGHEST)
+
+
+def project_rows(A, dim: int, seed: int = 0) -> np.ndarray:
+    """Seeded Gaussian JL projection of the rows of ``A`` to ``dim``
+    columns (entries N(0, 1/dim): squared distances are preserved in
+    expectation, concentrated to ~(1 ± sqrt(8 ln R / dim))). The fixed
+    default seed keeps repeated consensus runs deterministic, mirroring
+    k-means' fixed ``random_state=1``. Returns a host f32 array."""
+    A = jnp.asarray(np.asarray(A), jnp.float32)
+    if dim >= A.shape[1]:
+        return np.asarray(A)
+    return np.asarray(_project_rows_jit(A, int(dim), int(seed)))

@@ -512,6 +512,34 @@ def ell_kl_w_stats(x: EllMatrix, H, W, bf16_ratio: bool = False,
     return numer, denom
 
 
+def ell_kl_w_stats_rows(x: EllMatrix, H, W, idx):
+    """Sketched KL W-update statistics from a ROW SUBSAMPLE (ISSUE 11,
+    the ``sketch`` recipe): numerator ``H[idx].T @ (X[idx]/WH[idx])``
+    supported on the sampled rows' nonzeros only, scatter-accumulated
+    per stored coordinate — the transpose index set enumerates ALL rows'
+    nonzeros and cannot serve a traced subset, so the sketched lane pays
+    one (m·w, k)-vector scatter-add instead; sublinear in n, which is
+    the point. Denominator: the sampled rows' column sums (numerator and
+    denominator MUST come from the same subsample — the MU rate is the
+    ratio, so the common n/m scale cancels exactly). Padding entries
+    carry value 0 => ratio 0 => exact +0.0 into column 0. f32.
+
+    ``H`` is the FULL usage matrix; ``idx`` a traced (m,) row index
+    vector (sampling with replacement is fine — a duplicated row just
+    doubles its weight in both statistics)."""
+    vals = jnp.take(x.vals, idx, axis=0)                 # (m, w)
+    cols = jnp.take(x.cols, idx, axis=0)                 # (m, w)
+    H_s = jnp.take(H, idx, axis=0)                       # (m, k)
+    k = H.shape[-1]
+    wh = _wh_at_nz(cols, H_s, W)
+    ratio = vals / jnp.maximum(wh, jnp.asarray(EPS, wh.dtype))
+    contrib = (H_s[:, None, :] * ratio[..., None]).astype(jnp.float32)
+    numer_t = jnp.zeros((x.g, k), jnp.float32).at[cols.reshape(-1)].add(
+        contrib.reshape(-1, k))
+    denom = jnp.broadcast_to(H_s.sum(axis=0)[:, None], W.shape)
+    return numer_t.T, denom
+
+
 def _wh_dense(H, W, bf16: bool):
     if bf16:
         wh = jnp.matmul(H.astype(jnp.bfloat16), W.astype(jnp.bfloat16),

@@ -325,7 +325,12 @@ def warm_sweep_programs(n: int, g: int, k_to_count: dict,
             int(online_chunk_max_iter), int(n_passes), int(batch_max_iter),
             l1_H, l2_H, l1_W, l2_W, mesh, bool(return_usages),
             h_tol_start=h_tol_start,
-            bf16_ratio=resolve_bf16_ratio(beta, mode),
+            # dna/sketch recipes run strict f32 inside the solver; resolve
+            # the chain off so the warm key matches the dispatch key and
+            # the bf16 announcement never fires for an f32 recipe
+            bf16_ratio=(False if (per_k_recipe[k].kl_newton
+                                  or per_k_recipe[k].algo == "sketch")
+                        else resolve_bf16_ratio(beta, mode)),
             telemetry=telem, **_recipe_statics(per_k_recipe[k]))
         if ell_dims is not None:
             w_e, wt_e = int(ell_dims[0]), int(ell_dims[1])
@@ -379,9 +384,13 @@ def _recipe_statics(recipe: SolverRecipe) -> dict:
     (pinned by tests/test_accel.py)."""
     if recipe.algo == "mu" and recipe.is_identity:
         return {}
-    return {"algo": "hals" if recipe.algo == "hals" else "mu",
-            "inner_repeats": int(recipe.inner_repeats),
-            "kl_newton": bool(recipe.kl_newton)}
+    out = {"algo": "hals" if recipe.algo == "hals" else "mu",
+           "inner_repeats": int(recipe.inner_repeats),
+           "kl_newton": bool(recipe.kl_newton)}
+    if recipe.algo == "sketch":
+        out["sketch_dim"] = int(recipe.sketch_dim)
+        out["sketch_exact_every"] = int(recipe.sketch_exact_every)
+    return out
 
 
 def _stacked_inits(X, k: int, seeds, init: str, n_rows: int | None = None):
@@ -448,7 +457,8 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
                    packed: bool = False, h_tol_start: float | None = None,
                    bf16_ratio: bool = False, telemetry: bool = False,
                    algo: str = "mu", inner_repeats: int = 1,
-                   kl_newton: bool = False):
+                   kl_newton: bool = False, sketch_dim: int = 0,
+                   sketch_exact_every: int = 1):
     """Build (once per static configuration) the jitted sweep executable
     ``(X (n,g), seeds (R,)) -> (usages | (0,), spectra (R,k,g), errs (R,))``.
 
@@ -504,6 +514,11 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
         raise ValueError(
             f"the dna recipe requires beta=1 (KL); this sweep has "
             f"beta={beta}")
+    if sketch_dim and beta != 1.0:
+        # same loudness contract for the sketch lane (ISSUE 11)
+        raise ValueError(
+            f"the sketch recipe requires beta=1 (KL); this sweep has "
+            f"beta={beta}")
 
     stacked_solver = (mode == "batch" and beta == 2.0
                       and bundle_width(k) > 1 and algo == "mu"
@@ -520,13 +535,19 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
                     telemetry=telemetry)
         else:
             def solve(X, h0, w0):
+                kw = ({"sketch_dim": sketch_dim,
+                       "sketch_exact_every": sketch_exact_every}
+                      if sketch_dim else {})
                 return nmf_fit_batch(
                     X, h0, w0, beta=beta, tol=tol, max_iter=batch_max_iter,
                     l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W,
                     telemetry=telemetry, inner_repeats=inner_repeats,
-                    kl_newton=kl_newton)
+                    kl_newton=kl_newton, **kw)
     elif mode == "online":
         def solve(X, h0, w0):
+            kw = ({"sketch_dim": sketch_dim,
+                   "sketch_exact_every": sketch_exact_every}
+                  if sketch_dim else {})
             Xc, Hc, _ = _chunk_rows(X, h0, chunk)
             out = nmf_fit_online(
                 Xc, Hc, w0, beta=beta, tol=tol, h_tol=h_tol,
@@ -535,7 +556,7 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
                 h_tol_start=h_tol_start, bf16_ratio=bf16_ratio,
                 telemetry=telemetry,
                 algo=("halsvar" if algo == "hals" else "mu"),
-                kl_newton=kl_newton)
+                kl_newton=kl_newton, **kw)
             Hc, W, err = out[:3]
             H_flat = Hc.reshape(-1, k)[:n]
             return (H_flat, W, err, out[3]) if telemetry else \
@@ -549,6 +570,10 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
         if algo != "mu":
             raise ValueError("packed K-sweeps run the mu-family recipes "
                              "only; use per-K programs for hals")
+        if sketch_dim:
+            raise ValueError("packed K-sweeps run the exact mu-family "
+                             "programs; the sketch recipe dispatches "
+                             "per-K (models/cnmf.py forces packed off)")
 
         def sweep(X, seeds, k_actual):
             # batched padded random_init: all replicates of a slice share
@@ -678,6 +703,10 @@ def replicate_sweep_packed(X, ks, seeds, beta_loss="frobenius",
     if recipe.algo == "hals":
         raise ValueError("packed K-sweeps run the mu-family recipes only; "
                          "use per-K replicate_sweep calls for hals")
+    if recipe.algo == "sketch":
+        raise ValueError("packed K-sweeps run the exact mu-family "
+                         "programs; use per-K replicate_sweep calls for "
+                         "the sketch recipe")
     online_h_tol, n_passes, h_tol_start = resolve_online_schedule(
         beta, online_h_tol, n_passes)
     ks = [int(v) for v in ks]
@@ -729,7 +758,9 @@ def replicate_sweep_packed(X, ks, seeds, beta_loss="frobenius",
                 int(online_chunk_max_iter), int(n_passes),
                 int(batch_max_iter), l1_H, l2_H, l1_W, l2_W, mesh,
                 bool(return_usages), packed=True, h_tol_start=h_tol_start,
-                bf16_ratio=resolve_bf16_ratio(beta, mode),
+                bf16_ratio=(False if (recipe.kl_newton
+                                      or recipe.algo == "sketch")
+                            else resolve_bf16_ratio(beta, mode)),
                 telemetry=want_telem, **_recipe_statics(recipe))
             out = prog(X, np.asarray(sl_s, np.uint32), np.int32(kv))
             H, W, err = out[:3]
@@ -901,6 +932,9 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
     if recipe.algo == "hals" and beta != 2.0:
         raise ValueError("the hals recipe optimizes the Frobenius "
                          "objective; this sweep has beta=%g" % beta)
+    if recipe.algo == "sketch" and beta != 1.0:
+        raise ValueError("the sketch recipe requires beta=1 (KL); this "
+                         "sweep has beta=%g" % beta)
 
     n_dev = 1 if mesh is None else math.prod(mesh.devices.shape)
     replicates_per_batch, slices = _slice_specs(
@@ -934,7 +968,9 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
             int(online_chunk_max_iter), int(n_passes), int(batch_max_iter),
             l1_H, l2_H, l1_W, l2_W, mesh, bool(return_usages),
             h_tol_start=h_tol_start,
-            bf16_ratio=resolve_bf16_ratio(beta, mode),
+            bf16_ratio=(False if (recipe.kl_newton
+                                  or recipe.algo == "sketch")
+                        else resolve_bf16_ratio(beta, mode)),
             telemetry=want_telem, **_recipe_statics(recipe))
         # async dispatch: every slice is enqueued before any result is read
         out = prog(X, np.asarray(sl, dtype=np.uint32))

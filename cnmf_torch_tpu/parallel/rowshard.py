@@ -51,12 +51,14 @@ from ..ops.nmf import (
     random_init,
     split_regularization,
 )
+from ..ops.nmf import _apply_rate_sketched
 from ..ops.sparse import (
     EllMatrix,
     csr_to_ell,
     ell_beta_err,
     ell_is_w_stats,
     ell_kl_w_numer,
+    ell_kl_w_stats_rows,
     ell_row_width,
     resolve_sparse_beta,
 )
@@ -325,7 +327,8 @@ def prepare_rowsharded(X, mesh: Mesh, stats: StreamStats | None = None,
 
 
 def _rowsharded_pass(X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
-                     l1_H, l2_H, l1_W, l2_W, kl_newton: bool = False):
+                     l1_H, l2_H, l1_W, l2_W, kl_newton: bool = False,
+                     sketch=None, pass_idx=0):
     """One block-coordinate pass on this shard's rows + the global W update.
 
     Runs identically on every device; `psum` makes the W statistics global,
@@ -335,6 +338,19 @@ def _rowsharded_pass(X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
     Diagonalized-Newton KL recipe (``ops/nmf.py:_chunk_h_solve``); the
     psum'd W statistics and the pass structure are unchanged, so ICI
     bytes per pass are identical.
+
+    ``sketch`` (static ``(sketch_dim, exact_every)`` or None; β=1 only —
+    the 'sketch' recipe, ISSUE 11): the per-shard usage solve and the
+    psum'd objective stay exact, while the W statistics come from a
+    per-shard ``sketch_dim``-row subsample of the LOCAL shard (the
+    replicated per-``pass_idx`` key draws the same local indices on
+    every shard — different physical rows, since shards hold different
+    rows); every ``exact_every``-th pass (and pass 0) runs the exact
+    statistics. The psum'd objects stay the same k x g / k-sized
+    arrays, so ICI bytes per pass are unchanged — only the local
+    statistics FLOPs shrink. Zero-evidence W entries hold their value
+    (``ops/nmf.py:_apply_rate_sketched``). ``pass_idx`` is a traced
+    scalar so the per-pass program is compiled once.
 
     Returns ``(H_local, W, err, A, B)``. For beta=2, ``(A, B)`` are the
     pass's psum'd sufficient statistics (``H^T X``, ``H^T H``) — already
@@ -352,6 +368,58 @@ def _rowsharded_pass(X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
         A = jax.lax.psum(H_local.T @ X_local, axis)
         B = jax.lax.psum(H_local.T @ H_local, axis)
         W = _solve_w_from_stats(W, A, B, l1_W, l2_W, chunk_max_iter, h_tol)
+    elif beta == 1.0 and sketch is not None:
+        # sketched KL W statistics (ISSUE 11): local cond picks exact vs
+        # subsampled stats BEFORE the psum, so the collectives are
+        # branch-free and uniform across shards
+        sketch_dim, exact_every = sketch[0], max(sketch[1], 1)
+        n_loc = (X_local.vals.shape[0] if isinstance(X_local, EllMatrix)
+                 else X_local.shape[0])
+        m = min(sketch_dim, n_loc)
+
+        def _stats_exact(_):
+            if isinstance(X_local, EllMatrix):
+                numer = ell_kl_w_numer(X_local, H_local, W)
+            else:
+                numer = H_local.T @ (
+                    X_local / jnp.maximum(H_local @ W, EPS))
+            return numer, H_local.sum(axis=0)
+
+        def _stats_sketched(_):
+            idx = jax.random.randint(
+                jax.random.fold_in(jax.random.key(2), pass_idx),
+                (m,), 0, n_loc)
+            hs = jnp.take(H_local, idx, axis=0)
+            if isinstance(X_local, EllMatrix):
+                numer, _ = ell_kl_w_stats_rows(X_local, H_local, W, idx)
+            else:
+                xs = jnp.take(X_local, idx, axis=0)
+                numer = hs.T @ (xs / jnp.maximum(hs @ W, EPS))
+            return numer, hs.sum(axis=0)
+
+        exact_now = pass_idx % exact_every == 0
+        numer_l, hsum_l = jax.lax.cond(
+            exact_now, _stats_exact, _stats_sketched, operand=None)
+        numer = jax.lax.psum(numer_l, axis)
+        denom = jnp.broadcast_to(
+            jax.lax.psum(hsum_l, axis)[:, None], W.shape)
+        # exact passes apply the plain MU rate — zero numerators DECAY,
+        # matching the batch lane's exact branch; only sketched passes
+        # hold zero-evidence entries (a subsample that saw no nonzero in
+        # a column is absence of evidence, an exact pass is evidence of
+        # absence). Sketched passes scale the penalties by the sampled
+        # fraction so the m/n-scaled statistics see m/n-scaled l1/l2
+        # (full penalties would over-regularize by ~n/m)
+        sc = m / float(n_loc)
+        W_new = jnp.where(exact_now,
+                          _apply_rate(W, numer, denom, l1_W, l2_W),
+                          _apply_rate(W, numer, denom,
+                                      l1_W * sc, l2_W * sc))
+        W = jnp.where(exact_now | (numer > 0.0), W_new, W)
+        if isinstance(X_local, EllMatrix):
+            err = jax.lax.psum(ell_beta_err(X_local, H_local, W, beta),
+                               axis)
+            return H_local, W, err, A, B
     elif isinstance(X_local, EllMatrix):
         # ELL shard (stream_ell_to_mesh): nonzero-only W statistics; the
         # psum'd objects stay the same k x g / k-sized arrays as the dense
@@ -388,7 +456,7 @@ def _rowsharded_pass(X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
 def _rowsharded_solve_local(X_local, H_local, W, axis, beta, tol, h_tol,
                             n_passes, chunk_max_iter, l1_H, l2_H, l1_W, l2_W,
                             telemetry: bool = False,
-                            kl_newton: bool = False):
+                            kl_newton: bool = False, sketch=None):
     """Per-device block-coordinate solve loop (runs inside ``shard_map``):
     passes of :func:`_rowsharded_pass` until the psum'd objective's relative
     improvement drops below ``tol`` or ``n_passes`` is reached. Shared by the
@@ -408,7 +476,8 @@ def _rowsharded_solve_local(X_local, H_local, W, axis, beta, tol, h_tol,
             H_local, W, err_prev, err, it = carry
         H_local, W, err_new, _, _ = _rowsharded_pass(
             X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
-            l1_H, l2_H, l1_W, l2_W, kl_newton=kl_newton)
+            l1_H, l2_H, l1_W, l2_W, kl_newton=kl_newton, sketch=sketch,
+            pass_idx=it)
         if telemetry:
             # pass it+1's objective lands at 0-based slot it (slot 0 holds
             # the first pass's err0 from the init below)
@@ -420,11 +489,20 @@ def _rowsharded_solve_local(X_local, H_local, W, axis, beta, tol, h_tol,
     def cond(carry):
         err_prev, err, it = carry[2], carry[3], carry[4]
         rel = (err_prev - err) / jnp.maximum(err_prev, EPS)
-        return (it < n_passes) & ((it < 2) | (rel >= tol))
+        keep = (it < 2) | (rel >= tol)
+        if sketch is not None:
+            # the convergence test may only STOP on an exact-pass state
+            # (pass index it-1 exact): a sketched pass whose subsample
+            # noise reads as a sub-tol improvement must not freeze a
+            # sketched W as the result — the same anchoring contract as
+            # nmf_fit_batch's eval-boundary exact updates
+            keep = keep | ((it - 1) % max(sketch[1], 1) != 0)
+        return (it < n_passes) & keep
 
     H_local, W, err0, _, _ = _rowsharded_pass(
         X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
-        l1_H, l2_H, l1_W, l2_W, kl_newton=kl_newton)
+        l1_H, l2_H, l1_W, l2_W, kl_newton=kl_newton, sketch=sketch,
+        pass_idx=jnp.int32(0))
     init = (H_local, W, err0 * (1.0 + 2.0 * tol) + 1.0, err0, jnp.int32(1))
     if telemetry:
         init = init + (jnp.full((TRACE_LEN,), jnp.nan,
@@ -441,10 +519,12 @@ def _rowsharded_solve_local(X_local, H_local, W, axis, beta, tol, h_tol,
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "axis", "beta", "chunk_max_iter",
-                     "l1_H", "l2_H", "l1_W", "l2_W", "kl_newton"),
+                     "l1_H", "l2_H", "l1_W", "l2_W", "kl_newton",
+                     "sketch"),
 )
 def _rowshard_pass_jit(X, H, W, mesh, axis, beta, h_tol, chunk_max_iter,
-                       l1_H, l2_H, l1_W, l2_W, kl_newton: bool = False):
+                       l1_H, l2_H, l1_W, l2_W, kl_newton: bool = False,
+                       sketch=None, pass_idx=0):
     """ONE block-coordinate pass as its own dispatch — the unit of the
     checkpointed host-driven loop (``_fit_rowsharded_checkpointed``). The
     per-device program is exactly the ``_rowsharded_pass`` body the fused
@@ -457,17 +537,19 @@ def _rowshard_pass_jit(X, H, W, mesh, axis, beta, h_tol, chunk_max_iter,
 
     @functools.partial(
         shard_map, mesh=mesh,
-        in_specs=(P(axis, None), P(axis, None), P()), out_specs=out_specs,
+        in_specs=(P(axis, None), P(axis, None), P(), P()),
+        out_specs=out_specs,
     )
-    def run(X_local, H_local, W):
+    def run(X_local, H_local, W, pass_idx_r):
         H_local, W, err, A, B = _rowsharded_pass(
             X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
-            l1_H, l2_H, l1_W, l2_W, kl_newton=kl_newton)
+            l1_H, l2_H, l1_W, l2_W, kl_newton=kl_newton, sketch=sketch,
+            pass_idx=pass_idx_r)
         if with_stats:
             return H_local, W, err[None], A, B
         return H_local, W, err[None]
 
-    out = run(X, H, W)
+    out = run(X, H, W, jnp.asarray(pass_idx, jnp.int32))
     if with_stats:
         H, W, err, A, B = out
         return H, W, err[0], A, B
@@ -479,7 +561,7 @@ def _fit_rowsharded_checkpointed(Xd, H0, W0, mesh, axis, beta, tol, h_tol,
                                  n_passes, chunk_max_iter,
                                  l1_H, l2_H, l1_W, l2_W, ckpt,
                                  heartbeat=None, n_orig=None,
-                                 kl_newton: bool = False):
+                                 kl_newton: bool = False, sketch=None):
     """Host-driven pass loop with mid-run checkpoints — the checkpointed
     twin of :func:`_fit_rowsharded_jit`'s fused while_loop (same per-pass
     program, same f32 convergence test, same stopping rule; the loop
@@ -514,10 +596,16 @@ def _fit_rowsharded_checkpointed(Xd, H0, W0, mesh, axis, beta, tol, h_tol,
     h_tol_j = jnp.float32(h_tol)
     f32 = np.float32
 
-    def one_pass(H, W):
+    def one_pass(H, W, pass_idx):
+        # pass_idx is traced, so every pass reuses ONE compiled program;
+        # it feeds the sketch recipe's exact-interleave cadence and its
+        # per-pass subsample stream (ignored when sketch is None). A
+        # resumed run passes the restored cursor, so the cadence is
+        # continuation-invariant.
         return _rowshard_pass_jit(
             Xd, H, W, mesh, axis, beta, h_tol_j, int(chunk_max_iter),
-            l1_H, l2_H, l1_W, l2_W, kl_newton=kl_newton)
+            l1_H, l2_H, l1_W, l2_W, kl_newton=kl_newton, sketch=sketch,
+            pass_idx=pass_idx)
 
     trace = np.full((TRACE_LEN,), np.nan, np.float32)
     A = B = None
@@ -551,7 +639,7 @@ def _fit_rowsharded_checkpointed(Xd, H0, W0, mesh, axis, beta, tol, h_tol,
         A, B = state["A"], state["B"]
     else:
         resumed_without_h = False
-        H, W, err0, A, B = one_pass(H0, W0)
+        H, W, err0, A, B = one_pass(H0, W0, 0)
         ran_pass = True
         err = f32(err0)
         # same f32 arithmetic as the fused loop's init, so the resumed
@@ -592,11 +680,14 @@ def _fit_rowsharded_checkpointed(Xd, H0, W0, mesh, axis, beta, tol, h_tol,
             return False
         if it < 2:
             return True
+        if sketch is not None and (it - 1) % max(sketch[1], 1) != 0:
+            # only exact-pass states may stop (see _rowsharded_solve_local)
+            return True
         rel = (f32(err_prev) - f32(err)) / max(f32(err_prev), f32(EPS))
         return bool(rel >= f32(tol))
 
     while active():
-        H, W, err_new, A, B = one_pass(H, W)
+        H, W, err_new, A, B = one_pass(H, W, it)
         ran_pass = True
         err_prev, err = err, f32(err_new)
         it += 1
@@ -671,6 +762,19 @@ def _ooc_shard_budget_bytes() -> int:
     return _staged_refit_budget_bytes()
 
 
+def _per_shard_sketch(recipe, mesh):
+    """The recipe's GLOBAL sketch_dim as a per-shard static ``(rows,
+    exact_every)`` tuple for this mesh (min 1 row per shard) — the ONE
+    accounting shared by the resident and out-of-core tiers, so the two
+    never sample different row budgets for the same recipe. ``None``
+    for non-sketch recipes."""
+    if recipe.algo != "sketch":
+        return None
+    n_shards = int(np.prod(mesh.devices.shape))
+    return (max(1, -(-int(recipe.sketch_dim) // n_shards)),
+            int(recipe.sketch_exact_every))
+
+
 def _nmf_fit_rowsharded_ooc_entry(store, k, mesh, axis, beta, *, seed, tol,
                                   h_tol, n_passes, chunk_max_iter, alpha_W,
                                   l1_ratio_W, alpha_H, l1_ratio_H,
@@ -688,10 +792,11 @@ def _nmf_fit_rowsharded_ooc_entry(store, k, mesh, axis, beta, *, seed, tol,
 
         recipe = resolve_recipe(beta, "rowshard", ell=False, n=int(n_orig),
                                 g=int(g), k=int(k))
-    if recipe.kl_newton and beta != 1.0:
+    if (recipe.kl_newton or recipe.algo == "sketch") and beta != 1.0:
         raise ValueError(
             f"recipe {recipe.label!r} requires beta=1 (KL), got "
             f"beta={beta}")
+    sketch = _per_shard_sketch(recipe, mesh)
     ckpt = (checkpoint if checkpoint is not None
             and getattr(checkpoint, "every", 0) > 0 else None)
     stats = StreamStats()
@@ -700,7 +805,7 @@ def _nmf_fit_rowsharded_ooc_entry(store, k, mesh, axis, beta, *, seed, tol,
         float(h_tol), int(n_passes), int(chunk_max_iter), l1_H, l2_H,
         l1_W, l2_W, _ooc_shard_budget_bytes(), ckpt=ckpt,
         heartbeat=heartbeat, kl_newton=bool(recipe.kl_newton),
-        events=events, stats=stats)
+        sketch=sketch, events=events, stats=stats)
     if events is not None:
         try:
             events.emit_stream("rowshard_ooc_passes", stats)
@@ -724,10 +829,11 @@ def _nmf_fit_rowsharded_ooc_entry(store, k, mesh, axis, beta, *, seed, tol,
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "axis", "beta", "chunk_max_iter",
-                     "l1_H", "l2_H", "kl_newton"),
+                     "l1_H", "l2_H", "kl_newton", "sketch"),
 )
 def _ooc_group_pass_jit(Xg, Hg, W, A, B, err_acc, mesh, axis, beta, h_tol,
-                        chunk_max_iter, l1_H, l2_H, kl_newton: bool = False):
+                        chunk_max_iter, l1_H, l2_H, kl_newton: bool = False,
+                        sketch=None, pass_idx=0, group_idx=0):
     """One GROUP's contribution to a slab-looped out-of-core pass
     (ISSUE 10): solve this group's usage block with W frozen, then fold
     its psum'd statistics into the carried accumulators — strictly
@@ -744,10 +850,13 @@ def _ooc_group_pass_jit(Xg, Hg, W, A, B, err_acc, mesh, axis, beta, h_tol,
 
     @functools.partial(
         shard_map, mesh=mesh,
-        in_specs=(P(axis, None), P(axis, None), P(), P(), P(), P()),
+        in_specs=(P(axis, None), P(axis, None), P(), P(), P(), P(), P()),
         out_specs=(P(axis, None), P(), P(), P()),
     )
-    def run(x, h, W, A, B, err_acc):
+    def run(x, h, W, A, B, err_acc, cursor_r):
+        # cursor_r: replicated (pass_idx, flat_step) — the pass index
+        # drives the sketch recipe's exact-interleave cadence, the flat
+        # step seeds a fresh subsample per (pass, group)
         WWT = W @ W.T if with_stats else None
         h = _chunk_h_solve(x, h, W, WWT, beta, l1_H, l2_H, chunk_max_iter,
                            h_tol, kl_newton=kl_newton)
@@ -758,7 +867,37 @@ def _ooc_group_pass_jit(Xg, Hg, W, A, B, err_acc, mesh, axis, beta, h_tol,
                 _beta_div_dense(x, h @ W, beta), axis)[None]
             return h, A, B, err
         WH = jnp.maximum(h @ W, EPS)
-        if beta == 1.0:
+        if beta == 1.0 and sketch is not None:
+            # sketched slab-loop W statistics (ISSUE 11): WH is needed
+            # for the exact per-group objective anyway, so the sketched
+            # branch only gathers sampled rows of the ratio and shrinks
+            # the k x g numerator contraction from the group's rows to
+            # sketch_dim of them; every exact_every-th PASS stays exact
+            # sketch is a STATIC (dim, every) tuple of Python ints
+            # (jit static_argnames) — no conversion, so the lint's
+            # traced-concretization rule sees none either
+            sk_dim, sk_every = sketch[0], max(sketch[1], 1)
+            m = min(sk_dim, h.shape[0])
+            n_loc = h.shape[0]
+            ratio = x / WH
+
+            def _stats_exact(_):
+                return h.T @ ratio, h.sum(axis=0)
+
+            def _stats_sk(_):
+                idx = jax.random.randint(
+                    jax.random.fold_in(jax.random.key(3), cursor_r[1]),
+                    (m,), 0, n_loc)
+                hs = jnp.take(h, idx, axis=0)
+                return hs.T @ jnp.take(ratio, idx, axis=0), hs.sum(axis=0)
+
+            numer_l, hsum_l = jax.lax.cond(
+                cursor_r[0] % sk_every == 0, _stats_exact, _stats_sk,
+                operand=None)
+            numer = jax.lax.psum(numer_l, axis)
+            denom = jnp.broadcast_to(
+                jax.lax.psum(hsum_l, axis)[:, None], W.shape)
+        elif beta == 1.0:
             numer = jax.lax.psum(h.T @ (x / WH), axis)
             denom = jnp.broadcast_to(
                 jax.lax.psum(h.sum(axis=0), axis)[:, None], W.shape)
@@ -769,7 +908,9 @@ def _ooc_group_pass_jit(Xg, Hg, W, A, B, err_acc, mesh, axis, beta, h_tol,
             _beta_div_dense(x, WH, beta), axis)[None]
         return h, numer, denom, err
 
-    return run(Xg, Hg, W, A, B, err_acc)
+    return run(Xg, Hg, W, A, B, err_acc,
+               jnp.stack([jnp.asarray(pass_idx, jnp.int32),
+                          jnp.asarray(group_idx, jnp.int32)]))
 
 
 # l1_W/l2_W are static: _apply_rate branches on their truthiness in
@@ -781,7 +922,7 @@ _solve_w_from_stats_jit = jax.jit(
 def _fit_rowsharded_ooc(store, k, mesh, axis, beta, seed, tol, h_tol,
                         n_passes, chunk_max_iter, l1_H, l2_H, l1_W, l2_W,
                         shard_budget, ckpt=None, heartbeat=None,
-                        kl_newton: bool = False, events=None,
+                        kl_newton: bool = False, sketch=None, events=None,
                         stats: StreamStats | None = None):
     """Slab-looped out-of-core rowsharded solve: X NEVER becomes resident
     — each pass streams slab GROUPS (per-device resident bytes bounded by
@@ -853,19 +994,35 @@ def _fit_rowsharded_ooc(store, k, mesh, axis, beta, seed, tol, h_tol,
                             rep_sh)
     zero_err = jax.device_put(jnp.zeros((1,), jnp.float32), rep_sh)
 
-    def one_pass(W):
+    def one_pass(W, pass_i=0):
         A, B, err_acc = zero_A, zero_B, zero_err
         for gi in range(n_groups):
             Xg = stage_group(gi)
             Hg, A, B, err_acc = _ooc_group_pass_jit(
                 Xg, H_groups[gi], W, A, B, err_acc, mesh, axis, beta,
                 h_tol_j, int(chunk_max_iter), l1_H, l2_H,
-                kl_newton=kl_newton)
+                kl_newton=kl_newton, sketch=sketch, pass_idx=pass_i,
+                group_idx=pass_i * n_groups + gi)
             if beta != 2.0:
                 # online flavor: one MU W step per group from its own
                 # statistics (cross-group accumulation would mix
                 # inconsistent (h, W) pairs — nmf_fit_online's contract)
-                W = _apply_rate(W, A, B, l1_W, l2_W, gamma=mu_gamma(beta))
+                if (sketch is not None and beta == 1.0
+                        and pass_i % max(sketch[1], 1) != 0):
+                    # sketched-pass statistics: zero-evidence entries
+                    # hold (ops/nmf.py:_apply_rate_sketched;
+                    # gamma(beta=1)=1); exact passes take the plain rate
+                    # below so genuinely dead entries still decay,
+                    # matching the batch lane. Penalties scale with the
+                    # sampled fraction of the group (m per shard of
+                    # group_rows/n_shards rows), like every sketched lane
+                    sc = min(1.0, sketch[0] * n_dev
+                             / max(group_rows, 1))
+                    W = _apply_rate_sketched(W, A, B,
+                                             l1_W * sc, l2_W * sc)
+                else:
+                    W = _apply_rate(W, A, B, l1_W, l2_W,
+                                    gamma=mu_gamma(beta))
                 A, B = zero_A, zero_B
             jax.block_until_ready(Hg)
             _delete_group(Xg)
@@ -898,7 +1055,7 @@ def _fit_rowsharded_ooc(store, k, mesh, axis, beta, seed, tol, h_tol,
         trace[:n_tr] = state["trace"][:n_tr]
         A, B = state["A"], state["B"]
     else:
-        W, err0, A, B = one_pass(W)
+        W, err0, A, B = one_pass(W, 0)
         ran_pass = True
         err = f32(err0)
         err_prev = f32(err * f32(1.0 + 2.0 * tol) + f32(1.0))
@@ -934,11 +1091,14 @@ def _fit_rowsharded_ooc(store, k, mesh, axis, beta, seed, tol, h_tol,
             return False
         if it < 2:
             return True
+        if sketch is not None and (it - 1) % max(sketch[1], 1) != 0:
+            # only exact-pass states may stop (see _rowsharded_solve_local)
+            return True
         rel = (f32(err_prev) - f32(err)) / max(f32(err_prev), f32(EPS))
         return bool(rel >= f32(tol))
 
     while active():
-        W, err_new, A, B = one_pass(W)
+        W, err_new, A, B = one_pass(W, it)
         ran_pass = True
         err_prev, err = err, f32(err_new)
         it += 1
@@ -965,11 +1125,12 @@ def _delete_group(Xg):
     jax.jit,
     static_argnames=("mesh", "axis", "beta", "n_passes", "chunk_max_iter",
                      "l1_H", "l2_H", "l1_W", "l2_W", "telemetry",
-                     "kl_newton"),
+                     "kl_newton", "sketch"),
 )
 def _fit_rowsharded_jit(X, H0, W0, mesh, axis, beta, tol, h_tol, n_passes,
                         chunk_max_iter, l1_H, l2_H, l1_W, l2_W,
-                        telemetry: bool = False, kl_newton: bool = False):
+                        telemetry: bool = False, kl_newton: bool = False,
+                        sketch=None):
     out_specs = ((P(axis, None), P(), P()) if not telemetry
                  else (P(axis, None), P(), P(), P(), P(), P()))
 
@@ -982,7 +1143,7 @@ def _fit_rowsharded_jit(X, H0, W0, mesh, axis, beta, tol, h_tol, n_passes,
         out = _rowsharded_solve_local(
             X_local, H_local, W, axis, beta, tol, h_tol, n_passes,
             chunk_max_iter, l1_H, l2_H, l1_W, l2_W, telemetry=telemetry,
-            kl_newton=kl_newton)
+            kl_newton=kl_newton, sketch=sketch)
         if telemetry:
             H_local, W, err, trace, passes, nonfin = out
             return (H_local, W, err[None], trace, passes[None],
@@ -1153,15 +1314,19 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
                                 ell_width=(Xd.width
                                            if isinstance(Xd, EllMatrix)
                                            else None))
-    if recipe.kl_newton and beta != 1.0:
-        # same contract as run_nmf/nmf_fit_batch: a caller-pinned dna
-        # recipe on a non-KL solve must fail loudly — silently running
-        # plain MU would leave telemetry and the checkpoint-identity
-        # signature describing math that never ran
+    if (recipe.kl_newton or recipe.algo == "sketch") and beta != 1.0:
+        # same contract as run_nmf/nmf_fit_batch: a caller-pinned dna or
+        # sketch recipe on a non-KL solve must fail loudly — silently
+        # running plain MU would leave telemetry and the checkpoint-
+        # identity signature describing math that never ran
         raise ValueError(
             f"recipe {recipe.label!r} requires beta=1 (KL), got "
             f"beta={beta}")
     kl_newton = bool(recipe.kl_newton)
+    # the recipe's sketch_dim counts GLOBAL sampled rows per W update;
+    # each shard samples its share so a d-device mesh still touches
+    # ~sketch_dim rows total (min 1 per shard), instead of d times that
+    sketch = _per_shard_sketch(recipe, mesh)
 
     want_telem = False
     if telemetry_sink is not None:
@@ -1173,7 +1338,7 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
             Xd, H0, W0, mesh, axis, beta, float(tol), float(h_tol),
             int(n_passes), int(chunk_max_iter), l1_H, l2_H, l1_W, l2_W,
             checkpoint, heartbeat=heartbeat, n_orig=n_orig,
-            kl_newton=kl_newton)
+            kl_newton=kl_newton, sketch=sketch)
         if want_telem:
             telemetry_sink({
                 "k": int(k), "beta": float(beta), "mode": "rowshard",
@@ -1187,7 +1352,7 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
     out = _fit_rowsharded_jit(
         Xd, H0, W0, mesh, axis, beta, jnp.float32(tol), jnp.float32(h_tol),
         int(n_passes), int(chunk_max_iter), l1_H, l2_H, l1_W, l2_W,
-        telemetry=want_telem, kl_newton=kl_newton)
+        telemetry=want_telem, kl_newton=kl_newton, sketch=sketch)
     H, W, err = out[:3]
     if want_telem:
         trace, passes, nonfin = out[3:]

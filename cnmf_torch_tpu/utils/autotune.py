@@ -1,0 +1,229 @@
+"""Measured solver-cost ratios: a startup microbench cached per device
+fingerprint (ISSUE 11 satellite, feeding ROADMAP item 5's autotuner).
+
+The accelerated-MU schedule (``ops/recipe.py:auto_inner_repeats``) derives
+ρ — H sub-iterations per W update — from STATIC flop-count ratios whose
+clamp was measured once on CPU. Real kernels diverge from flop counts
+(gather-bound ELL passes, fusion, memory formats differ per backend), so
+this module times one H-repeat against one W-update per lane on the LIVE
+device at a probe shape, stores ``measured_ratio / static_ratio`` per
+lane, and ``auto_inner_repeats`` multiplies its static ratio by that
+scale (falling back to the static schedule whenever no cache exists).
+
+The cache is one JSON per device fingerprint under the system temp dir
+(atomic replace; survives processes, not reboots on tmpfs — the bench is
+~1 s, so a cold cache is cheap). ``models/cnmf.py:factorize`` calls
+:func:`maybe_autotune_rho` once up front when the accel knobs could
+engage an amu recipe; everything here is best-effort — any failure
+resolves to the static schedule, never an error.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+
+__all__ = ["device_fingerprint", "cache_path", "measure_rho_scales",
+           "maybe_autotune_rho", "cached_rho_scale"]
+
+_PROBE_N, _PROBE_G, _PROBE_K = 2048, 512, 10
+_PROBE_DENSITY = 0.05
+
+_memo: dict = {}
+_memo_lock = threading.Lock()
+
+
+def device_fingerprint() -> str:
+    """Backend + device kind + count — the identity a measured ratio is
+    valid for (a resumed run on different hardware re-measures)."""
+    import jax
+
+    d = jax.devices()[0]
+    kind = str(getattr(d, "device_kind", "unknown")).replace(" ", "_")
+    return f"{jax.default_backend()}-{kind}-x{len(jax.devices())}"
+
+
+def cache_path(cache_dir: str | None = None) -> str:
+    base = cache_dir or os.path.join(tempfile.gettempdir(),
+                                     "cnmf_tpu_autotune")
+    return os.path.join(base, f"rho_{device_fingerprint()}.json")
+
+
+def _time_call(fn, *args, repeats: int = 5) -> float:
+    """Median wall of ``fn(*args)`` with block_until_ready, after one
+    warm-up dispatch (compile + upload excluded from the measurement)."""
+    import jax
+
+    jax.block_until_ready(fn(*args))
+    walls = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        walls.append(time.perf_counter() - t0)
+    walls.sort()
+    return walls[len(walls) // 2]
+
+
+def measure_rho_scales() -> dict:
+    """Run the microbench: per lane, the measured W-update/H-repeat wall
+    ratio divided by the static flop ratio ``auto_inner_repeats`` would
+    use at the probe shape. Returns the cache payload."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import scipy.sparse as sp
+
+    from ..ops.nmf import _apply_rate, _update_H, _update_W
+    from ..ops.sparse import csr_to_ell, ell_device_put, ell_w_table
+
+    n, g, k = _PROBE_N, _PROBE_G, _PROBE_K
+    rng = np.random.default_rng(0)
+    H = jnp.asarray(rng.uniform(0.1, 1.0, (n, k)).astype(np.float32))
+    W = jnp.asarray(rng.uniform(0.1, 1.0, (k, g)).astype(np.float32))
+    Xd = jnp.asarray(rng.gamma(1.0, 1.0, (n, g)).astype(np.float32))
+
+    scales: dict = {}
+
+    # beta=2: H repeat = rate against hoisted XW^T/WW^T (k-sized);
+    # W update = the full statistics step
+    numer0 = Xd @ W.T
+    WWT = W @ W.T
+    h_rep_b2 = jax.jit(lambda h: _apply_rate(h, numer0, h @ WWT, 0.0, 0.0))
+    w_upd_b2 = jax.jit(lambda h, w: _update_W(Xd, h, w, 2.0, 0.0, 0.0))
+    static_b2 = (2.0 * n * g * k) / max(n * k * k, 1)
+    meas_b2 = (_time_call(w_upd_b2, H, W)
+               / max(_time_call(h_rep_b2, H), 1e-9))
+    scales["b2"] = meas_b2 / static_b2
+
+    # dense beta=1: repeat and W update are the same full-pass class
+    h_rep_kl = jax.jit(lambda h: _update_H(Xd, h, W, 1.0, 0.0, 0.0))
+    w_upd_kl = jax.jit(lambda h, w: _update_W(Xd, h, w, 1.0, 0.0, 0.0))
+    scales["dense"] = (_time_call(w_upd_kl, H, W)
+                       / max(_time_call(h_rep_kl, H), 1e-9)) / 1.0
+
+    # ELL beta=1: repeat reads the pre-gathered slab table; the W update
+    # rebuilds tables and walks the transpose index set
+    mask = rng.uniform(size=(n, g)) < _PROBE_DENSITY
+    Xs = sp.csr_matrix(np.where(mask, np.asarray(Xd), 0.0))
+    E = ell_device_put(csr_to_ell(Xs))
+    w_ell = E.width
+    table = ell_w_table(W, E.cols)
+    h_rep_ell = jax.jit(
+        lambda h: _update_H(E, h, W, 1.0, 0.0, 0.0, w_table=table))
+    w_upd_ell = jax.jit(lambda h, w: _update_W(E, h, w, 1.0, 0.0, 0.0))
+    static_ell = (n * w_ell * (4 * k + 2)) / max(n * w_ell * (2 * k + 2), 1)
+    scales["ell"] = (_time_call(w_upd_ell, H, W)
+                     / max(_time_call(h_rep_ell, H), 1e-9)) / static_ell
+
+    return {"fingerprint": device_fingerprint(),
+            "probe": {"n": n, "g": g, "k": k,
+                      "density": _PROBE_DENSITY, "ell_width": int(w_ell)},
+            "scales": {lane: round(float(v), 4)
+                       for lane, v in scales.items()},
+            "measured_at": time.time()}
+
+
+def _load(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+        if payload.get("fingerprint") != device_fingerprint():
+            return None
+        return payload
+    except Exception:
+        return None
+
+
+def maybe_autotune_rho(cache_dir: str | None = None,
+                       force: bool = False,
+                       beta: float | None = None) -> dict | None:
+    """Ensure the measured-ρ cache for this device exists and is loaded
+    into the in-process memo. Measures (and atomically writes the JSON)
+    only when no valid cache is present, and only when the accel knobs
+    could actually engage an amu schedule — ``CNMF_TPU_ACCEL`` off or an
+    explicit ``CNMF_TPU_INNER_REPEATS`` pin means the measurement would
+    never be read, so the bench is skipped. Best-effort: returns the
+    payload or ``None``; never raises.
+
+    Determinism: the measured ρ is a jit static and part of the
+    checkpoint identity signature, so it must agree wherever programs
+    must agree. On MULTI-HOST pods the lane is disabled outright
+    (``jax.process_count() > 1`` → static schedule): per-host timing
+    jitter could resolve different ρ on different hosts and compile
+    mismatched SPMD programs. Single-host, a lost cache re-measures and
+    may land a different ρ — the checkpoint identity then RESTARTS the
+    replicate (the documented recipe-change contract, never a splice);
+    pin ``CNMF_TPU_INNER_REPEATS`` for resume-stable long runs."""
+    try:
+        from .envknobs import env_str
+
+        if not force:
+            accel = env_str("CNMF_TPU_ACCEL", "0").strip().lower()
+            rho_pin = env_str("CNMF_TPU_INNER_REPEATS", "").strip().lower()
+            if accel in ("", "0", "off", "false", "no") or \
+                    rho_pin not in ("", "auto"):
+                return None
+            # amu-reachability (``beta`` known): a run whose engaged
+            # recipe can only be sketch (CNMF_TPU_SKETCH forces the
+            # solver lane for beta=1) or dna (KL_NEWTON on steers an
+            # engaged beta=1 acceleration to Newton) never consults
+            # auto_inner_repeats — skip the bench instead of paying a
+            # ~1 s startup it cannot read
+            if beta is not None and float(beta) == 1.0:
+                from .envknobs import env_flag
+
+                sk = env_str("CNMF_TPU_SKETCH", "0").strip().lower()
+                if sk in ("1", "on", "true", "yes", "force") or \
+                        env_flag("CNMF_TPU_KL_NEWTON", True):
+                    return None
+            import jax
+
+            if jax.process_count() > 1:
+                return None
+        path = cache_path(cache_dir)
+        payload = None if force else _load(path)
+        if payload is None:
+            payload = measure_rho_scales()
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            from .anndata_lite import atomic_artifact
+
+            with atomic_artifact(path) as tmp:
+                with open(tmp, "w") as f:
+                    json.dump(payload, f)
+        with _memo_lock:
+            _memo[path] = payload
+        return payload
+    except Exception:
+        return None
+
+
+def cached_rho_scale(beta: float, ell: bool = False,
+                     cache_dir: str | None = None) -> float | None:
+    """Read-only lane lookup for ``auto_inner_repeats``: the measured
+    scale for this (β, encoding) lane, or ``None`` (static fallback)
+    when no cache has been written for this device. Never measures.
+    Multi-host pods always get ``None`` — a cache written by an earlier
+    single-host run on one machine must not steer ρ differently across
+    hosts compiling one SPMD program (see :func:`maybe_autotune_rho`)."""
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            return None
+        path = cache_path(cache_dir)
+        with _memo_lock:
+            payload = _memo.get(path)
+        if payload is None:
+            payload = _load(path)
+            if payload is None:
+                return None
+            with _memo_lock:
+                _memo[path] = payload
+        lane = "b2" if float(beta) == 2.0 else ("ell" if ell else "dense")
+        val = payload.get("scales", {}).get(lane)
+        return float(val) if val is not None else None
+    except Exception:
+        return None

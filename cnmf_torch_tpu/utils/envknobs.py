@@ -78,13 +78,33 @@ REGISTRY: dict[str, Knob] = _knobs(
          "provenance, and the checkpoint identity"),
     Knob("CNMF_TPU_INNER_REPEATS", "int", "auto",
          "accelerated-MU ρ (H sub-iterations per W update, arXiv "
-         "1107.5194); unset derives ρ from the static H-repeat vs "
-         "W-update cost ratio (n/g/k/ELL width), clamped to [2, 8]"),
+         "1107.5194); unset derives ρ from the H-repeat vs W-update "
+         "cost ratio (n/g/k/ELL width) — corrected by the per-device "
+         "measured-ratio cache when the startup microbench has run "
+         "(`utils/autotune.py`, clamp [2, 12]), else the static flop "
+         "ratio clamped to [2, 8]"),
     Knob("CNMF_TPU_KL_NEWTON", "flag", "`1`",
          "when acceleration is engaged, β=1 solves take the Diagonalized "
          "Newton recipe (arXiv 1301.3389: diagonal-Hessian steps + "
          "per-lane monotone MU fallback); `0` restricts engaged "
          "acceleration to the MU repeat schedule"),
+    Knob("CNMF_TPU_SKETCH", "str", "`0`",
+         "randomized sketching (ISSUE 11, arXiv 1604.04026): `0` pins "
+         "exact updates (programs byte-identical to a build without the "
+         "sketch layer) and full-width consensus distances; `1` forces "
+         "the sketched-KL solver recipe (`sketch` lane: exact H updates, "
+         "row-subsampled W updates with exact interleaves) AND the "
+         "random-projected consensus/k-selection distance stage; `auto` "
+         "engages the consensus-side sketch on large replicate stacks "
+         "(R >= 4x the projection dim) and leaves the solver lane off"),
+    Knob("CNMF_TPU_SKETCH_DIM", "int", "auto",
+         "sketch size: rows sampled per sketched W update (auto derives "
+         "n/8 clamped to [256, n]) and the consensus random-projection "
+         "dimension (auto = 256, clamped below the spectra width)"),
+    Knob("CNMF_TPU_SKETCH_EXACT_EVERY", "int", "`4`",
+         "bias control for the sketched W update: iteration 0 and every "
+         "E-th outer iteration/pass run the exact full-data update; `1` "
+         "makes every update exact (the sketch lane's identity schedule)"),
     Knob("CNMF_TPU_BF16_RATIO", "flag", "`1`",
          "bf16 X/WH/ratio intermediates for online KL/IS (1.78–2.09× on "
          "v5e); `0` restores strict f32 (announced once per process when "

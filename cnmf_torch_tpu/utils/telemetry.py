@@ -492,6 +492,22 @@ def summarize_events(events: list[dict]) -> dict:
         {k: e[k] for k in ("decision", "context") if k in e}
         for e in events if e["t"] == "dispatch"]
 
+    # consensus/k-selection dispatch lane (ISSUE 11): which geometry the
+    # clustering stages ran on — sketched (random-projected) vs exact —
+    # with the replicate counts and distance-matrix shapes that justify
+    # it, so the sketched lane is auditable like factorize's
+    cons_rows = []
+    for e in events:
+        if e["t"] != "dispatch" or e.get("decision") not in (
+                "consensus_path", "k_selection"):
+            continue
+        ctx = e.get("context") or {}
+        if not isinstance(ctx, dict):
+            continue
+        cons_rows.append(dict(ctx, decision=e.get("decision")))
+    if cons_rows:
+        summary["consensus"] = cons_rows
+
     stages: dict = {}
     for e in events:
         if e["t"] != "stage":
@@ -739,10 +755,34 @@ def render_report(run_dir: str) -> str:
         lines.append("Dispatch decisions")
         lines.append("-" * 18)
         for d in summary["dispatch"]:
+            if d.get("decision") in ("consensus_path", "k_selection"):
+                continue  # rendered in their own section below
             ctx = d.get("context", {})
             ctx_str = "  ".join(f"{k}={v}" for k, v in ctx.items()) \
                 if isinstance(ctx, dict) else str(ctx)
             lines.append(f"  {d.get('decision')}: {ctx_str}")
+
+    if summary.get("consensus"):
+        lines.append("")
+        lines.append("Consensus / k-selection dispatch")
+        lines.append("-" * 32)
+        for c in summary["consensus"]:
+            if c.get("decision") == "k_selection":
+                lines.append(
+                    f"  k_selection: Ks={c.get('ks')}  "
+                    f"R_max={c.get('R_max')}  packed={c.get('packed')}  "
+                    f"sketch={'on dim=%s' % c.get('sketch_dim') if c.get('sketch') else 'off'}"
+                    f" ({c.get('sketch_source')})")
+            else:
+                shape = c.get("distance_shape") or ["?", "?"]
+                lines.append(
+                    f"  {c.get('stage', 'consensus'):<18s} K={c.get('k')}"
+                    f"  replicates={c.get('replicates')}"
+                    f"  dist={shape[0]}x{shape[-1]}"
+                    f" @ width {c.get('distance_width')}"
+                    f"  sketch={'on dim=%s' % c.get('sketch_dim') if c.get('sketch') else 'off'}"
+                    f" ({c.get('sketch_source')})"
+                    f"{'  packed' if c.get('packed') else ''}")
 
     lines.append("")
     lines.append("Stage waterfall")

@@ -1,0 +1,168 @@
+"""Tier-1 sketch parity smoke (ISSUE 11, wired in verify_tier1.sh).
+
+Two lanes, both asserted against their exact twins within declared
+tolerances, with schema-valid sketch-carrying telemetry:
+
+  * **solver**: a mini batch-KL replicate sweep under the ``sketch``
+    recipe (row-subsampled W updates, exact interleaves) must land
+    within a small relative band of plain MU on BOTH the dense and the
+    ELL encodings, and the sketch-off programs must lower byte-identical
+    to the defaults;
+  * **consensus**: the KNN-density outlier filter + k-means cluster
+    medians computed on random-projected replicate spectra must
+    reproduce the exact stage's outlier set bit-for-bit at the default
+    threshold and its cluster medians to high cosine, on a synthetic
+    replicate-spectra stack.
+
+Exit 0 on success; any assertion or schema failure exits nonzero and
+fails the gate.
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["CNMF_TPU_TELEMETRY"] = "1"
+
+import numpy as np  # noqa: E402
+import scipy.sparse as sp  # noqa: E402
+
+
+def kl_fixture(n=400, g=80, k=4, seed=3, scale=1.2):
+    rng = np.random.default_rng(seed)
+    usage = rng.dirichlet(np.ones(k) * 0.2, size=n)
+    spectra = rng.gamma(0.25, 1.0, size=(k, g)) * 40.0 / g
+    X = rng.poisson(usage @ spectra * scale).astype(np.float32)
+    X[X.sum(axis=1) == 0, 0] = 1.0
+    return X
+
+
+def spectra_stack(R=240, g=600, k=4, seed=5):
+    """Synthetic merged-replicate L2 spectra: k planted programs plus
+    noise, with a few far-outlier rows the density filter must catch."""
+    rng = np.random.default_rng(seed)
+    base = rng.gamma(0.3, 1.0, size=(k, g))
+    rows = base[rng.integers(0, k, size=R)] * \
+        rng.uniform(0.8, 1.25, size=(R, 1))
+    rows += rng.gamma(0.1, 0.05, size=(R, g))
+    out_idx = rng.choice(R, size=6, replace=False)
+    rows[out_idx] = rng.gamma(0.3, 1.0, size=(6, g)) * 4.0
+    l2 = rows / np.linalg.norm(rows, axis=1, keepdims=True)
+    return l2.astype(np.float32)
+
+
+def main() -> int:
+    import jax.numpy as jnp
+
+    from cnmf_torch_tpu.ops import kmeans, local_density
+    from cnmf_torch_tpu.ops.nmf import nmf_fit_batch
+    from cnmf_torch_tpu.ops.recipe import SolverRecipe
+    from cnmf_torch_tpu.ops.sketch import project_rows
+    from cnmf_torch_tpu.ops.sparse import csr_to_ell, ell_device_put
+    from cnmf_torch_tpu.parallel import replicate_sweep
+    from cnmf_torch_tpu.utils.telemetry import (EventLog, read_events,
+                                                summarize_events,
+                                                validate_events_file)
+
+    tmp = tempfile.mkdtemp(prefix="sketch_smoke_")
+    log = EventLog(os.path.join(tmp, "smoke.events.jsonl"))
+
+    # ---- solver lane --------------------------------------------------
+    X = kl_fixture()
+    recipe = SolverRecipe("sketch", sketch_dim=120, sketch_exact_every=4,
+                          source="caller")
+    TOL = 6e-2
+    errs = {}
+    for label, rec in (("mu", None), ("sketch", recipe)):
+        _, _, e = replicate_sweep(X, [1, 2, 3], 4,
+                                  beta_loss="kullback-leibler",
+                                  mode="batch", recipe=rec)
+        assert np.isfinite(e).all(), (label, e)
+        errs[label] = np.asarray(e, np.float64)
+        if rec is not None:
+            log.emit("dispatch", decision="solver_recipe",
+                     context=rec.as_context())
+    rel = np.abs(errs["sketch"] - errs["mu"]) / errs["mu"]
+    assert (rel < TOL).all(), ("dense", rel)
+    print(f"[sketch-smoke] solver dense: rel objective gap "
+          f"{rel.max():.3%} (< {TOL:.0%})")
+
+    E = ell_device_put(csr_to_ell(sp.csr_matrix(X)))
+    Hk = jnp.asarray(np.random.default_rng(0).uniform(
+        size=(X.shape[0], 4)).astype(np.float32))
+    Wk = jnp.asarray(np.random.default_rng(1).uniform(
+        size=(4, X.shape[1])).astype(np.float32))
+    _, _, e_mu = nmf_fit_batch(E, Hk, Wk, beta=1.0, max_iter=120)
+    _, _, e_sk = nmf_fit_batch(E, Hk, Wk, beta=1.0, max_iter=120,
+                               sketch_dim=120, sketch_exact_every=4)
+    rel_e = abs(float(e_sk) - float(e_mu)) / float(e_mu)
+    assert rel_e < TOL, ("ell", float(e_mu), float(e_sk))
+    print(f"[sketch-smoke] solver ELL:   rel objective gap "
+          f"{rel_e:.3%} (< {TOL:.0%})")
+
+    # sketch-off byte identity (the recipe layer's core contract)
+    base = nmf_fit_batch.lower(jnp.asarray(X), Hk, Wk, beta=1.0,
+                               max_iter=40).as_text()
+    ident = nmf_fit_batch.lower(jnp.asarray(X), Hk, Wk, beta=1.0,
+                                max_iter=40, sketch_dim=0,
+                                sketch_exact_every=1).as_text()
+    assert base == ident, "sketch-off lowering differs from defaults"
+    print("[sketch-smoke] sketch-off lowering byte-identical to defaults")
+
+    # ---- consensus lane ----------------------------------------------
+    l2 = spectra_stack()
+    R, k, thr, dim = l2.shape[0], 4, 0.5, 128
+    n_neighbors = int(0.30 * R / k)
+
+    dens_exact, _ = local_density(l2, n_neighbors)
+    proj = project_rows(l2, dim)
+    dens_sk, _ = local_density(proj, n_neighbors)
+    keep_exact = dens_exact < thr
+    keep_sk = dens_sk < thr
+    assert (keep_exact == keep_sk).all(), \
+        (int((keep_exact != keep_sk).sum()), "outlier sets differ")
+    assert 0 < (~keep_exact).sum() < R, "fixture grew no outliers"
+
+    def medians(feats, keep):
+        labels, _, _ = kmeans(feats[keep], k, n_init=10, seed=1)
+        present = [c for c in range(k) if (labels == c).any()]
+        med = np.stack([np.median(l2[keep][labels == c], axis=0)
+                        for c in present])
+        return med / np.maximum(
+            np.linalg.norm(med, axis=1, keepdims=True), 1e-12)
+
+    med_exact = medians(l2, keep_exact)
+    med_sk = medians(proj, keep_sk)
+    C = med_exact @ med_sk.T
+    assert med_sk.shape == med_exact.shape
+    best = C.max(axis=1)
+    assert (best > 0.995).all(), best
+    log.emit("dispatch", decision="consensus_path",
+             context={"stage": "consensus", "k": k, "replicates": int(R),
+                      "packed": False, "sketch": True, "sketch_dim": dim,
+                      "sketch_source": "env", "distance_width": dim,
+                      "distance_shape": [int(R), int(R)]})
+    print(f"[sketch-smoke] consensus: outlier set identical "
+          f"({int((~keep_exact).sum())} outliers), median cosine "
+          f"{best.min():.4f} (> 0.995)")
+
+    # ---- telemetry surface -------------------------------------------
+    n_events = validate_events_file(log.path)
+    summary = summarize_events(read_events(log.path))
+    cons = summary.get("consensus") or []
+    assert any(c.get("sketch") and c.get("sketch_dim") == dim
+               for c in cons), cons
+    disp = [d for d in summary["dispatch"]
+            if d.get("decision") == "solver_recipe"]
+    assert any("sketch(" in (d["context"].get("recipe") or "")
+               for d in disp), disp
+    print(f"[sketch-smoke] OK: {n_events} schema-valid events, "
+          f"sketch lanes visible in dispatch + consensus summaries")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -104,4 +104,21 @@ if [ "$rc" -eq 0 ]; then
     exit 1
   fi
 fi
+
+# sketch parity smoke: the sketched-KL solver lane (dense + ELL) must
+# match plain MU within its declared band with sketch-off programs
+# lowering byte-identical to the defaults, and the sketched consensus
+# stage (random-projected density filter + k-means) must reproduce the
+# exact outlier set and cluster medians; emitted events carrying the
+# sketch context must validate against the schema (scripts/sketch_smoke.py)
+if [ "$rc" -eq 0 ]; then
+  echo "[tier1] sketch parity smoke (sketched KL W updates + sketched consensus) ..."
+  if timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      python scripts/sketch_smoke.py; then
+    echo SKETCH_SMOKE=ok
+  else
+    echo SKETCH_SMOKE=fail
+    exit 1
+  fi
+fi
 exit $rc

@@ -390,3 +390,89 @@ class TestRecipeDispatch:
         recs = replicate_records(pay)
         assert all("inner_iters" in r and "dna_fallback" in r for r in recs)
         assert all(0.0 <= r["dna_fallback"] <= 1.0 for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# accelerated-trajectory parity suite (ISSUE 11 satellite — PR 8 follow-on)
+# ---------------------------------------------------------------------------
+
+class TestAccelTrajectoryParity:
+    """The tolerance-band suite PR 8 left open: mu vs amu/dna final
+    objectives AND consensus-level agreement of the spectra their sweeps
+    produce, across the bench fixture classes. This is the evidence that
+    would justify flipping ``CNMF_TPU_ACCEL`` to ``auto`` by default —
+    the bands hold (see the assertions), but the default STAYS ``'0'``:
+    the byte-identity contract (default programs identical to a
+    pre-recipe build) is what pins this reproduction against the
+    reference's golden artifacts and the sklearn/nmf-torch oracles, and
+    an `auto` default would silently change every default KL trajectory
+    those goldens were regenerated under. The flip is deferred to the
+    declarative-planner item (ROADMAP 5), where per-run plans are
+    recorded whole; users opt in today with CNMF_TPU_ACCEL=auto, covered
+    by these bands. Rationale also in README "Solver recipes"."""
+
+    OBJ_TOL = 2e-2
+
+    def _sweep_spectra(self, X, recipe, k=4, seeds=(1, 2, 3, 4, 5, 6)):
+        from cnmf_torch_tpu.parallel import replicate_sweep
+
+        spectra, _, errs = replicate_sweep(
+            X, list(seeds), k, beta_loss="kullback-leibler", mode="batch",
+            recipe=recipe)
+        return np.asarray(spectra), np.asarray(errs, np.float64)
+
+    def test_final_objective_bands_dense_and_ell(self):
+        X = _counts(300, 80, 4, 21)
+        _, errs_mu = self._sweep_spectra(X, SolverRecipe())
+        for rec in (SolverRecipe("amu", 3, False, "caller"),
+                    SolverRecipe("dna", 1, True, "caller")):
+            _, errs = self._sweep_spectra(X, rec)
+            rel = np.abs(errs - errs_mu) / errs_mu
+            assert (rel < self.OBJ_TOL).all(), (rec.label, rel)
+        Xs = _sparse_counts(300, 80, 4, 22)
+        from cnmf_torch_tpu.ops.sparse import csr_to_ell, ell_device_put
+
+        E = ell_device_put(csr_to_ell(Xs))
+        _, errs_mu = self._sweep_spectra(E, SolverRecipe())
+        _, errs_dna = self._sweep_spectra(
+            E, SolverRecipe("dna", 1, True, "caller"))
+        rel = np.abs(errs_dna - errs_mu) / errs_mu
+        assert (rel < self.OBJ_TOL).all(), rel
+
+    def test_consensus_spectra_band_mu_vs_dna(self):
+        """The consensus-level contract: clustering each recipe's
+        replicate spectra stack yields matching cluster medians (greedy
+        cosine matching > 0.98) — the artifact consensus actually
+        publishes, not just the scalar objectives."""
+        from cnmf_torch_tpu.ops import kmeans
+
+        X = _counts(300, 80, 4, 23)
+        k = 4
+
+        def medians(recipe):
+            spectra, _ = self._sweep_spectra(X, recipe, k=k)
+            flat = spectra.reshape(-1, spectra.shape[-1])
+            l2 = flat / np.maximum(
+                np.linalg.norm(flat, axis=1, keepdims=True), 1e-12)
+            labels, _, _ = kmeans(l2, k, n_init=10, seed=1)
+            med = np.stack([np.median(l2[labels == c], axis=0)
+                            for c in range(k)])
+            return med / np.maximum(
+                np.linalg.norm(med, axis=1, keepdims=True), 1e-12)
+
+        med_mu = medians(SolverRecipe())
+        med_dna = medians(SolverRecipe("dna", 1, True, "caller"))
+        C = med_mu @ med_dna.T
+        best = C.max(axis=1)
+        assert (best > 0.98).all(), best
+
+    def test_default_accel_remains_identity(self, monkeypatch):
+        """The documented outcome of this suite: bands hold, default
+        stays '0' (byte-identity with the golden/oracle-pinned
+        programs). README's Solver recipes section records the why."""
+        monkeypatch.delenv("CNMF_TPU_ACCEL", raising=False)
+        rec = resolve_recipe(1.0, "batch")
+        assert rec.is_identity and rec.source == "default"
+        readme = open(os.path.join(os.path.dirname(__file__), os.pardir,
+                                   "README.md")).read()
+        assert "CNMF_TPU_ACCEL" in readme
