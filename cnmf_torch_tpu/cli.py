@@ -1,6 +1,8 @@
 """Command-line interface: the reference's five subcommands, plus
-``run_parallel`` (the launcher) and ``report`` (render a run's telemetry —
-see ``utils/telemetry.py``).
+``run_parallel`` (the launcher), ``report`` (render a run's telemetry —
+see ``utils/telemetry.py``), ``lint`` (static analysis), and ``serve``
+(the warm projection daemon over a run's consensus reference —
+``cnmf_torch_tpu/serving/``).
 
 Flag-compatible with the reference CLI (``/root/reference/src/cnmf/cnmf.py:
 1387-1470``): ``prepare | factorize | combine | consensus |
@@ -36,11 +38,13 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "command", type=str,
         choices=["prepare", "factorize", "combine", "consensus",
-                 "k_selection_plot", "run_parallel", "report", "lint"])
+                 "k_selection_plot", "run_parallel", "report", "lint",
+                 "serve"])
     parser.add_argument(
         "run_dir", type=str, nargs="?", default=None,
-        help="[report] Run directory ([output-dir]/[name]) whose telemetry "
-             "to render; defaults to --output-dir/--name")
+        help="[report|serve] Run directory ([output-dir]/[name]) whose "
+             "telemetry to render / whose consensus reference to serve; "
+             "defaults to --output-dir/--name")
     parser.add_argument("--name", type=str, nargs="?", default="cNMF",
                         help="[all] Name for analysis. All output will be "
                              "placed in [output-dir]/[name]/...")
@@ -151,10 +155,16 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--clean", action="store_true", default=False,
                         help="[run_parallel] Delete per-replicate spectra "
                              "files after combine")
-    parser.add_argument("--local-density-threshold", type=float, default=0.5,
+    # default None is the "not given" sentinel: consensus resolves it to
+    # the reference's 0.5, while serve uses an explicit value to pick
+    # among several consensus artifacts (a hardcoded 0.5 would silently
+    # filter out a run's only artifact at another threshold)
+    parser.add_argument("--local-density-threshold", type=float,
+                        default=None,
                         help="[consensus] Threshold for the local density "
-                             "filtering. This string must convert to a float "
-                             ">0 and <=2")
+                             "filtering, >0 and <=2 (default 0.5); "
+                             "[serve] pick the consensus artifact at this "
+                             "density threshold")
     parser.add_argument("--local-neighborhood-size", type=float, default=0.30,
                         help="[consensus] Fraction of the number of "
                              "replicates to use as nearest neighbors for "
@@ -163,6 +173,18 @@ def build_parser() -> argparse.ArgumentParser:
                         action="store_true",
                         help="[consensus] Produce a clustergram figure "
                              "summarizing the spectra clustering")
+    parser.add_argument("--socket", type=str, default=None,
+                        help="[serve] Unix-socket path for the projection "
+                             "daemon (default: "
+                             "<run_dir>/cnmf_tmp/<name>.serve.sock)")
+    parser.add_argument("--port", type=int, default=None,
+                        help="[serve] Serve HTTP on 127.0.0.1:PORT instead "
+                             "of the unix socket")
+    parser.add_argument("--spectra", type=str, default=None,
+                        help="[serve] Explicit reference spectra: a "
+                             "consensus .df.npz artifact or a ShardStore "
+                             "directory (overrides -k/--local-density-"
+                             "threshold selection)")
     # BooleanOptionalAction repairs the reference's dead flag (store_true
     # with default=True can never be disabled, cnmf.py:1437): here
     # --no-build-reference actually turns starCAT output off
@@ -199,13 +221,13 @@ def main(argv=None):
                      "[paths ...] [--format text|json] [--baseline FILE] "
                      "[--write-baseline] [--knob-table]")
 
-    if args.command != "report" and args.run_dir is not None:
-        # the optional positional exists for `report` only; for every
-        # other subcommand a stray positional (e.g. `consensus 9` meaning
-        # `-k 9`) must fail fast, not be silently swallowed
+    if args.command not in ("report", "serve") and args.run_dir is not None:
+        # the optional positional exists for `report`/`serve` only; for
+        # every other subcommand a stray positional (e.g. `consensus 9`
+        # meaning `-k 9`) must fail fast, not be silently swallowed
         parser.error(f"unrecognized argument: {args.run_dir!r} "
                      f"(a positional run directory applies to 'report' "
-                     f"only)")
+                     f"and 'serve' only)")
 
     if args.command == "report":
         # pure host-side rendering of a run's telemetry (events JSONL from
@@ -244,6 +266,32 @@ def main(argv=None):
     from .utils.compile_cache import enable_persistent_compilation_cache
 
     enable_persistent_compilation_cache()
+
+    if args.command == "serve":
+        # the warm serving tier (ISSUE 12): load + stage the run's
+        # consensus reference spectra, warm the bucketed program cache,
+        # and serve projection requests until SIGINT/SIGTERM. Reference
+        # selection reuses -k and --local-density-threshold (only when
+        # explicitly given — the dt default must not filter out a run's
+        # single consensus artifact at another threshold).
+        from .serving import ReferenceError, serve_forever
+
+        run_dir = args.run_dir or os.path.join(args.output_dir, args.name)
+        if not os.path.isdir(run_dir):
+            parser.error(f"serve: run directory not found: {run_dir}")
+        if args.socket is not None and args.port is not None:
+            parser.error("serve: pass --socket or --port, not both")
+        dt = args.local_density_threshold
+        k = args.components[0] if args.components else None
+        try:
+            raise SystemExit(serve_forever(
+                run_dir, k=k, density_threshold=dt,
+                spectra_path=args.spectra,
+                socket_path=args.socket, port=args.port))
+        except ReferenceError as exc:
+            # a missing/ambiguous reference is a usage problem, not a
+            # daemon crash — fail with the one-line diagnosis
+            parser.error(f"serve: {exc}")
 
     if args.command == "run_parallel":
         from .launcher import run_pipeline
@@ -334,9 +382,11 @@ def main(argv=None):
             ks = sorted(set(run_params.n_components))
         else:
             ks = args.components
+        dt = (0.5 if args.local_density_threshold is None
+              else args.local_density_threshold)
         for k in ks:
             cnmf_obj.consensus(
-                int(k), args.local_density_threshold,
+                int(k), dt,
                 args.local_neighborhood_size, args.show_clustering,
                 args.build_reference, close_clustergram_fig=True)
 

@@ -576,8 +576,7 @@ class cNMF:
         """Re-probe iter_spectra files to refresh the completed column
         (``cnmf.py:780-795``). Must not run while factorize workers are
         active (undocumented reference invariant, SURVEY.md §5.2)."""
-        with open(self.paths["nmf_run_parameters"]) as f:
-            _nmf_kwargs = yaml.load(f, Loader=yaml.FullLoader)
+        _nmf_kwargs = self._solver_params()
         replicate_params = load_df_from_npz(
             self.paths["nmf_replicate_parameters"])
         for i in replicate_params.index:
@@ -696,8 +695,7 @@ class cNMF:
             # no valid store AND no h5ad: _read_norm_counts raises the
             # torn-store diagnosis (or the classic h5ad error)
             norm_counts = self._read_norm_counts()
-        with open(self.paths["nmf_run_parameters"]) as f:
-            _nmf_kwargs = yaml.load(f, Loader=yaml.FullLoader)
+        _nmf_kwargs = self._solver_params()
 
         my_tasks = list(worker_filter(range(len(run_params)), worker_i,
                                       total_workers))
@@ -2051,6 +2049,14 @@ class cNMF:
     # refits
     # ------------------------------------------------------------------
 
+    def _solver_params(self) -> dict:
+        """The run's persisted solver-parameter YAML — the ONE parse
+        shared by the refits and the warmers (the serving tier reads the
+        same file through ``serving/reference.py``, which is what makes
+        its batched dispatch parameter-identical to these refits)."""
+        with open(self.paths["nmf_run_parameters"]) as f:
+            return yaml.load(f, Loader=yaml.FullLoader)
+
     def refit_usage(self, X, spectra, usage=None, k_pad=None):
         """Fixed-spectra usage refit via the jitted MU H-solver
         (``cnmf.py:923-976`` -> :func:`cnmf_torch_tpu.ops.nmf.fit_h`).
@@ -2071,9 +2077,14 @@ class cNMF:
         (:func:`~cnmf_torch_tpu.parallel.fit_h_rowsharded`): X streams
         host->HBM shard-wise with no host dense copy — the reference's
         ``X.toarray()`` at this boundary (cnmf.py:329-330) is the wall for
-        atlas-scale consensus."""
-        with open(self.paths["nmf_run_parameters"]) as f:
-            kwargs = yaml.load(f, Loader=yaml.FullLoader)
+        atlas-scale consensus.
+
+        ``usage``: a previous usage matrix for the same (X, spectra)
+        pair warm-starts the solve as ``H_init`` (clamped at zero) —
+        repeat projections then converge in a fraction of the inner
+        iterations (the serving tier's per-tenant warm-start cache,
+        ``serving/batcher.py``, rides exactly this hook)."""
+        kwargs = self._solver_params()
         beta = beta_loss_to_float(kwargs["beta_loss"])
         if isinstance(X, pd.DataFrame):
             X = X.values
@@ -2113,13 +2124,23 @@ class cNMF:
         happen — its row chunks become (chunk x n_cells) dense buffers — so
         the W-subproblem is solved directly from k-sized sufficient
         statistics / streamed row blocks
-        (:func:`~cnmf_torch_tpu.parallel.rowshard.refit_w_rowsharded`)."""
+        (:func:`~cnmf_torch_tpu.parallel.rowshard.refit_w_rowsharded`).
+
+        The transpose is routed into the staged dispatch (ISSUE 12
+        satellite — this call used to hand ``fit_h`` a transposed host
+        view whose staging materialized a full transposed copy next to
+        X, doubling peak host memory; the sparse dense-fallback was
+        worse, densifying the (genes x cells) transpose ON HOST): a
+        device-resident X transposes on device; a host sparse X either
+        keeps the nonzero-only ELL path (one index-sized CSC->CSR
+        conversion) or stages slab-wise through the streaming engine and
+        transposes on device — the host never holds a dense copy; a host
+        dense X pays at most the ONE explicit contiguous copy."""
         if X.shape[0] >= self.rowshard_threshold:
             from ..parallel import default_mesh
             from ..parallel.rowshard import refit_w_rowsharded
 
-            with open(self.paths["nmf_run_parameters"]) as f:
-                kwargs = yaml.load(f, Loader=yaml.FullLoader)
+            kwargs = self._solver_params()
             return refit_w_rowsharded(
                 X, np.asarray(usage),
                 beta=beta_loss_to_float(kwargs["beta_loss"]),
@@ -2129,7 +2150,45 @@ class cNMF:
                 # row-shard the beta != 2 staged refit over all chips (the
                 # beta=2 path is k-sized statistics; mesh is unused there)
                 mesh=default_mesh(axis_name="cells"))
-        return self.refit_usage(X.T, np.asarray(usage).T).T
+        import jax
+
+        if isinstance(X, jax.Array):
+            Xt = X.T  # device transpose: no host copy at all
+        elif sp.issparse(X):
+            from ..ops.sparse import ell_row_width, resolve_sparse_beta
+
+            beta = beta_loss_to_float(self._solver_params()["beta_loss"])
+            Xt = None
+            if float(beta) in (1.0, 0.0):
+                # only the KL/IS lanes can take the ELL path, and the
+                # decision needs just density + transposed row width —
+                # both readable from the FREE CSC view (ell_row_width
+                # counts via getnnz, no conversion). The O(nnz)
+                # transposed CSR is built only when ELL actually wins.
+                Xt_view = X.T
+                n_t, g_t = Xt_view.shape
+                if resolve_sparse_beta(
+                        beta, density=X.nnz / max(n_t * g_t, 1),
+                        width=ell_row_width(Xt_view), g=g_t):
+                    # fit_h keeps this on the nonzero-only ELL kernels —
+                    # same dispatch decision it would have made on the
+                    # transposed view, minus the view's conversion
+                    # ambiguity
+                    Xt = Xt_view.tocsr()
+            if Xt is None:
+                # dense fallback: stage the row-major original slab-wise
+                # (the full dense matrix never exists on host) and
+                # transpose on device
+                from ..parallel.streaming import (StreamStats,
+                                                  stream_to_device)
+
+                stats = StreamStats()
+                Xt = stream_to_device(X, stats=stats,
+                                      events=self._events).T
+                stats.record_to(self._timer, "refit_spectra.stage")
+        else:
+            Xt = np.ascontiguousarray(np.asarray(X).T)
+        return self.refit_usage(Xt, np.asarray(usage).T).T
 
     def _warm_consensus_programs(self, R, k, n_hv, g_hv, n_neighbors,
                                  stats_only, norm_counts=None):
@@ -2146,8 +2205,6 @@ class cNMF:
         distinct shape-set warms once per process; failures only cost the
         warm. Ones as dummy data keep the MU/k-means while_loops at their
         early exits."""
-        import concurrent.futures
-
         import jax.numpy as jnp
 
         # the distance-bearing warms must match the width consensus
@@ -2163,8 +2220,7 @@ class cNMF:
             return
         self._warmed.add(sig)
 
-        with open(self.paths["nmf_run_parameters"]) as f:
-            kw = yaml.load(f, Loader=yaml.FullLoader)
+        kw = self._solver_params()
         beta = beta_loss_to_float(kw["beta_loss"])
         cmi = int(kw.get("online_chunk_max_iter", _DEFAULT_CHUNK_MAX_ITER))
         csz = int(kw.get("online_chunk_size", 5000))
@@ -2240,16 +2296,11 @@ class cNMF:
             jobs.append(lambda: self._stage_dense("norm_counts",
                                                   norm_counts.X))
 
-        def run_one(job):
-            try:
-                job()
-            except Exception:
-                pass
+        from ..parallel.replicates import run_warm_jobs
 
-        with concurrent.futures.ThreadPoolExecutor(min(8, len(jobs))) as ex:
-            list(ex.map(run_one, jobs))
+        run_warm_jobs(jobs)
 
-    def _warm_kselection_packed(self, R_max, K_max, n_hv, g_hv, cf):
+    def _warm_kselection_packed(self, R_max, K_max, n_hv, g_hv):
         """Warm the packed K-selection program set (kmeans / silhouette /
         usage-refit at the sweep's shared padded shapes) concurrently —
         the packed analog of :meth:`_warm_consensus_programs`, three
@@ -2264,8 +2315,7 @@ class cNMF:
 
         import jax.numpy as jnp
 
-        with open(self.paths["nmf_run_parameters"]) as f:
-            kw = yaml.load(f, Loader=yaml.FullLoader)
+        kw = self._solver_params()
         beta = beta_loss_to_float(kw["beta_loss"])
         cmi = int(kw.get("online_chunk_max_iter", _DEFAULT_CHUNK_MAX_ITER))
         csz = int(kw.get("online_chunk_size", 5000))
@@ -2311,14 +2361,9 @@ class cNMF:
             # production peak HBM stays bounded on large in-core datasets
             jobs.append(warm_refit)
 
-        def run_one(job):
-            try:
-                job()
-            except Exception:
-                pass
+        from ..parallel.replicates import run_warm_jobs
 
-        with cf.ThreadPoolExecutor(len(jobs)) as ex:
-            list(ex.map(run_one, jobs))
+        run_warm_jobs(jobs)
 
     # ------------------------------------------------------------------
     # consensus
@@ -2731,7 +2776,7 @@ class cNMF:
             # trip on a tunneled chip regardless of compile caching
             self._warm_kselection_packed(
                 packed_dims[0], packed_dims[1], norm_counts.X.shape[0],
-                norm_counts.X.shape[1], concurrent.futures)
+                norm_counts.X.shape[1])
 
         # the 9 Ks' stats passes are independent (shared state — the staged
         # norm_counts, the x_sq fingerprint, the packed executables — is

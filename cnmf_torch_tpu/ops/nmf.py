@@ -389,7 +389,7 @@ def _apply_rate_sketched(W, numer, denom, l1, l2):
 
 
 def _update_H(X, H, W, beta: float, l1: float, l2: float,
-              bf16_ratio: bool = False, w_table=None):
+              bf16_ratio: bool = False, w_table=None, w_colsum=None):
     if isinstance(X, EllMatrix):
         # sparsity-aware path (ops/sparse.py): nonzero-only numerator
         # statistics from the fixed-width ELL encoding; the bf16 ratio
@@ -426,10 +426,16 @@ def _update_H(X, H, W, beta: float, l1: float, l2: float,
         # fusion of the batched (vmapped) form already matches a
         # hand-fused Pallas one-pass kernel (ratio+both matmuls in VMEM
         # tiles) — the kernel won 3x single-replicate but 0x under vmap,
-        # so the plain jnp form stays (bench.py mfu tier tracks this)
+        # so the plain jnp form stays (bench.py mfu tier tracks this).
+        # ``w_colsum``: the serving tier's resident loop-invariant KL
+        # denominator (ISSUE 12) — W is fixed across every request, so
+        # the daemon computes the sum once at reference staging (the
+        # same reduce op this line runs: results are bit-equal)
         R = X / jnp.maximum(H @ W, EPS)
         numer = R @ W.T
-        denom = jnp.broadcast_to(W.sum(axis=1)[None, :], H.shape)
+        denom = jnp.broadcast_to(
+            (W.sum(axis=1) if w_colsum is None else w_colsum)[None, :],
+            H.shape)
     elif beta == 0.0 and bf16_ratio:
         # same memory-format relief as the beta=1 branch; the bf16
         # reciprocal chain measured 2.09x with <=0.0008% objective
@@ -1205,7 +1211,8 @@ def _chunk_h_hals_solve(x, h, W, WWT, l1, l2, max_iter, h_tol):
 
 def _chunk_h_solve(x, h, W, WWT, beta, l1, l2, max_iter, h_tol,
                    bf16_ratio: bool = False, w_table=None,
-                   kl_newton: bool = False):
+                   kl_newton: bool = False, return_resid: bool = False,
+                   w_colsum=None):
     """Inner MU loop on one chunk's usage block with W fixed.
 
     Semantics of ``fit_H_online``'s per-chunk loop (cnmf.py:350-381):
@@ -1256,7 +1263,7 @@ def _chunk_h_solve(x, h, W, WWT, beta, l1, l2, max_iter, h_tol,
 
         def step(h):
             return _update_H(x_cast, h, W, beta, l1, l2, bf16_ratio=bf16,
-                             w_table=w_table)
+                             w_table=w_table, w_colsum=w_colsum)
 
     def body(carry):
         h, _, it = carry
@@ -1272,7 +1279,13 @@ def _chunk_h_solve(x, h, W, WWT, beta, l1, l2, max_iter, h_tol,
     # varying-manual-axes type matches the loop body's under shard_map,
     # where h is device-varying; XLA folds the dead dependence otherwise
     rel0 = jnp.inf + 0.0 * jnp.sum(h)
-    h, _, _ = jax.lax.while_loop(cond, body, (h, rel0, jnp.int32(0)))
+    h, rel, _ = jax.lax.while_loop(cond, body, (h, rel0, jnp.int32(0)))
+    if return_resid:
+        # the last relative-change residual doubles as a per-chunk health
+        # signal (ISSUE 12 serving): a nonfinite chunk stops its loop on
+        # the first NaN comparison, leaving rel nonfinite — graded on host
+        # by ops.nmf.lane_health with zero extra device ops
+        return h, rel
     return h
 
 
@@ -1611,6 +1624,23 @@ def _fit_h_chunked(Xc, Hc0, W, beta: float, chunk_max_iter: int, h_tol: float,
     return Hc
 
 
+def fit_h_default_init(n: int, k: int, key=None):
+    """The usage-refit's default H init: ``uniform(key, (n, k))`` with the
+    fixed key 0 when ``key`` is None — split out of :func:`fit_h` so the
+    serving tier's per-request lane builder (``serving/batcher.py``) draws
+    the EXACT init a solo ``refit_usage`` dispatch would, instead of a
+    hand-copied expression that could drift.
+
+    Under the partitionable threefry (package default,
+    ``utils/jax_compat.py``) the draw is a row-major counter stream, so
+    for fixed ``k`` the first ``m`` rows of an ``(n, k)`` draw equal the
+    ``(m, k)`` draw bit-exactly — the prefix property the serving tier's
+    row-padded lanes rely on for bit-identity with solo dispatch."""
+    if key is None:
+        key = jax.random.key(0)
+    return jax.random.uniform(key, (n, k), dtype=jnp.float32)
+
+
 def _chunk_rows(X, H, chunk_size):
     """Zero-pad rows to a multiple of chunk_size and reshape to chunks.
     ``X`` may be dense or an :class:`EllMatrix` (both ELL buffers chunk
@@ -1736,7 +1766,7 @@ def fit_h(X, W, H_init=None, chunk_size: int = 5000, chunk_max_iter: int = 200,
                                                               0))),
                           0.0)
         else:
-            H = jax.random.uniform(key, (n, k), dtype=jnp.float32)
+            H = fit_h_default_init(n, k, key)
     else:
         H = jnp.maximum(jnp.asarray(np.asarray(H_init), dtype=jnp.float32), 0.0)
         if k_solve != H.shape[1]:

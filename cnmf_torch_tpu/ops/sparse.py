@@ -159,7 +159,14 @@ def ell_row_width(X) -> int:
     """The fixed row-ELL width a matrix will encode at: max row nnz,
     padded to a lane-friendly multiple (dense inputs count nonzeros)."""
     if sp.issparse(X):
-        nnz_per_row = np.diff(X.tocsr().indptr)
+        if sp.isspmatrix_csr(X):
+            nnz_per_row = np.diff(X.indptr)
+        else:
+            # getnnz(axis=1) counts without materializing a CSR copy —
+            # callers probing a transposed view (refit_spectra's ELL
+            # decision on X.T) must not pay an O(nnz) conversion just
+            # to be told the answer is "dense"
+            nnz_per_row = np.asarray(X.getnnz(axis=1)).reshape(-1)
     else:
         nnz_per_row = np.count_nonzero(np.asarray(X), axis=1)
     return _pad_width(int(nnz_per_row.max()) if nnz_per_row.size else 1)

@@ -276,8 +276,6 @@ def warm_sweep_programs(n: int, g: int, k_to_count: dict,
     different recipe would put the compile wall back on the first sweep;
     ``None`` resolves it exactly as :func:`replicate_sweep` does.
     """
-    import concurrent.futures
-
     beta = beta_loss_to_float(beta_loss)
     # default resolution mirrors replicate_sweep's PER-K resolution (the
     # auto amu rho is k-dependent for beta=2): one recipe per K, or the
@@ -355,11 +353,46 @@ def warm_sweep_programs(n: int, g: int, k_to_count: dict,
         ss = jax.ShapeDtypeStruct((r_pad,), jnp.uint32)
         prog.lower(xs, ss).compile()
 
-    workers = max_workers or min(8, len(specs))
-    with concurrent.futures.ThreadPoolExecutor(workers) as ex:
-        # list() propagates the first compile error instead of hiding it
-        list(ex.map(compile_one, sorted(specs)))
+    # swallow=False propagates the first compile error instead of hiding
+    # it: a warm failure here means the real sweep would fail identically
+    run_warm_jobs([functools.partial(compile_one, s)
+                   for s in sorted(specs)],
+                  max_workers=max_workers or min(8, len(specs)),
+                  swallow=False)
     return len(specs)
+
+
+def run_warm_jobs(jobs, max_workers: int = 8, swallow: bool = True):
+    """Run program-warming callables CONCURRENTLY in a thread pool — the
+    ONE warm executor shared by the AOT sweep warmer above, the model's
+    consensus/K-selection warmers (``models/cnmf.py``), and the serving
+    tier's bucket warmup (``serving/batcher.py``). XLA compiles release
+    the GIL, and on a tunneled device each executable's first dispatch
+    pays its own upload round trip, so overlapping them turns a serial
+    warm wall into roughly the longest single job.
+
+    ``swallow=True`` (the consensus warmers' stance) makes a failed warm
+    cost only its own warm; ``swallow=False`` (the AOT warmers' stance)
+    propagates the first failure — use it when a warm failure means the
+    real dispatch would fail identically."""
+    import concurrent.futures
+
+    jobs = list(jobs)
+    if not jobs:
+        return 0
+
+    def run_one(job):
+        try:
+            job()
+        except Exception:
+            if not swallow:
+                raise
+
+    with concurrent.futures.ThreadPoolExecutor(
+            min(max_workers, len(jobs))) as ex:
+        # list() propagates the first error when swallow=False
+        list(ex.map(run_one, jobs))
+    return len(jobs)
 
 
 def _slice_telemetry(tm: SolverTelemetry, r: int) -> SolverTelemetry:

@@ -1,0 +1,46 @@
+"""Warm serving tier (ISSUE 12): a persistent multi-tenant projection
+daemon over published reference spectra.
+
+The heavy-traffic scenario is not one lab running ``factorize`` once —
+it is many users projecting their cells onto a published reference
+(``fit_h`` refit) and expecting usage matrices back in milliseconds.
+This package assembles the existing ingredients into that service:
+
+  * ``reference.py`` — the reference spectra loaded once, device-
+    resident with precomputed loop-invariant W products;
+  * ``batcher.py`` — admission queue + micro-batching dispatcher:
+    concurrent requests coalesce into ONE vmapped, shape-bucketed
+    ``fit_h`` dispatch, bit-identical per request to solo
+    ``refit_usage`` dispatch, with per-lane health grading and tenant
+    quarantine;
+  * ``daemon.py`` — stdlib HTTP/JSON front end (unix socket or
+    127.0.0.1 TCP) + client, behind ``cnmf-tpu serve <run_dir>``.
+
+Knobs: ``CNMF_TPU_SERVE_BATCH`` / ``_LINGER_MS`` / ``_BUCKETS`` /
+``_TIMEOUT_S`` / ``_WARM_START`` (see the README knob table).
+Telemetry: ``serve_request`` / ``serve_batch`` events, rendered by
+``cnmf-tpu report``; sustained-load numbers via ``bench.py --tier
+serve``.
+"""
+
+from .batcher import (PoisonError, ProjectionService, QuarantinedError,
+                      ServeError, ShedError)
+from .daemon import ServeClient, ServeDaemon, default_socket_path, serve_forever
+from .reference import (ReferenceError, ResidentReference, find_references,
+                        load_reference)
+
+__all__ = [
+    "ServeError",
+    "ShedError",
+    "PoisonError",
+    "QuarantinedError",
+    "ProjectionService",
+    "ServeClient",
+    "ServeDaemon",
+    "default_socket_path",
+    "serve_forever",
+    "ReferenceError",
+    "ResidentReference",
+    "find_references",
+    "load_reference",
+]

@@ -1,0 +1,784 @@
+"""Cross-request micro-batching for the projection daemon (ISSUE 12).
+
+Every projection request is a fixed-W usage refit — row-separable work:
+``fit_h`` solves row chunks INDEPENDENTLY (``ops/nmf.py:_fit_h_chunked``
+scans ``_chunk_h_solve`` with no cross-chunk carry). That independence is
+what makes cross-request batching exact: each request becomes one or
+more *lanes* (its solo chunk partition, ``chunk = min(online_chunk_size,
+n)``), lanes zero-pad to a bucketed row count, and the whole batch runs
+as ONE vmapped ``_chunk_h_solve`` dispatch against the resident
+reference. Padding is benign by the same exact-zero-absorption argument
+the packed K-selection relies on (zero X rows with zero H rows stay
+exactly zero under every beta's MU step and contribute exact ``+0.0`` to
+the chunk's convergence norm), and the H init is the solo draw's prefix
+(:func:`~cnmf_torch_tpu.ops.nmf.fit_h_default_init`) — so every lane of
+a batch is BIT-IDENTICAL to its solo ``refit_usage`` dispatch, pinned by
+``tests/test_serving.py`` and the tier-1 serve smoke.
+
+Layers:
+
+  * :class:`MicroBatcher` — bounded admission queue + single dispatcher
+    thread. The first queued request opens a batch; the dispatcher
+    lingers up to ``CNMF_TPU_SERVE_LINGER_MS`` collecting batchmates (at
+    most ``CNMF_TPU_SERVE_BATCH`` lanes), then launches. Requests older
+    than ``CNMF_TPU_SERVE_TIMEOUT_S`` shed with a clear error (the
+    launcher-supervision timeout adapted to request admission), and a
+    full queue sheds immediately instead of building unbounded backlog.
+  * :class:`ProjectionService` — the daemon core: resident reference,
+    AOT-warmed program cache keyed by padded ``(lane_count, rows)``
+    buckets, per-(tenant, matrix) usage warm starts, per-lane health
+    grading (:func:`~cnmf_torch_tpu.ops.nmf.lane_health` — the PR-4
+    grading) with tenant quarantine so one poison input cannot sink its
+    batchmates or the daemon, and ``serve_request``/``serve_batch``
+    telemetry.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import queue
+import threading
+import time
+
+import numpy as np
+
+from ..utils.envknobs import env_flag, env_float, env_int, env_str
+
+__all__ = [
+    "ServeError",
+    "ShedError",
+    "PoisonError",
+    "QuarantinedError",
+    "resolve_buckets",
+    "bucket_for",
+    "lane_count",
+    "ProjectionService",
+]
+
+# poison strikes before a tenant is quarantined (admission-rejected):
+# mirrors the factorize retry budget's "repeated unhealthiness is a
+# property of the input, not the run" stance (runtime/resilience.py)
+POISON_QUARANTINE_STRIKES = 3
+
+# bounded reservoir of per-request total latencies for stats()
+_LATENCY_SAMPLES = 4096
+
+# warm-start cache entries kept (LRU): one usage matrix per (tenant,
+# matrix fingerprint) — bounds daemon host memory against tenant growth
+_WARM_CACHE_ENTRIES = 256
+
+
+class ServeError(RuntimeError):
+    """Base class for request-level serve failures (maps to a clear
+    client-visible error, never a daemon crash)."""
+
+    status = "error"
+
+
+class ShedError(ServeError):
+    """Admission shed: bounded queue full or deadline exceeded."""
+
+    status = "shed"
+
+
+class PoisonError(ServeError):
+    """The request's lane graded unhealthy (nonfinite input or result).
+    Batchmates are unaffected — lanes are independent."""
+
+    status = "poison"
+
+
+class QuarantinedError(ServeError):
+    """Tenant exceeded the poison-strike budget; admission rejects."""
+
+    status = "quarantined"
+
+
+# ---------------------------------------------------------------------------
+# shape buckets
+# ---------------------------------------------------------------------------
+
+def resolve_buckets(chunk_size: int, spec: str | None = None) -> tuple:
+    """The padded-rows bucket schedule: parsed ``CNMF_TPU_SERVE_BUCKETS``
+    entries below the run's chunk size, with the chunk size itself as the
+    top bucket (a lane is never taller than one solo chunk)."""
+    if spec is None:
+        spec = env_str("CNMF_TPU_SERVE_BUCKETS", "64,256,1024")
+    chunk_size = int(chunk_size)
+    out = {chunk_size}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            b = int(part)
+        except ValueError:
+            raise ValueError(
+                f"CNMF_TPU_SERVE_BUCKETS={spec!r}: expected "
+                f"comma-separated integers")
+        if b < 1:
+            raise ValueError(
+                f"CNMF_TPU_SERVE_BUCKETS={spec!r}: buckets must be >= 1")
+        if b < chunk_size:
+            out.add(b)
+    return tuple(sorted(out))
+
+
+def bucket_for(n: int, buckets: tuple) -> int:
+    """Smallest bucket >= n (buckets sorted ascending; the top bucket is
+    the chunk size, and lanes never exceed it)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+def lane_buckets(max_batch: int) -> tuple:
+    """Power-of-two lane-count buckets up to (and including) the batch
+    cap — the program cache's batch-axis schedule."""
+    out = []
+    b = 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(int(max_batch))
+    return tuple(sorted(set(out)))
+
+
+def lane_count(n: int, chunk_size: int) -> int:
+    """How many lanes (solo chunks) a request of ``n`` rows occupies."""
+    chunk = min(int(chunk_size), int(n))
+    return max(1, -(-int(n) // chunk))
+
+
+# ---------------------------------------------------------------------------
+# the batched device program
+# ---------------------------------------------------------------------------
+
+def _make_batched_project():
+    import jax
+
+    from ..ops.nmf import _chunk_h_solve
+
+    @functools.partial(jax.jit,
+                       static_argnames=("beta", "max_iter", "l1", "l2"))
+    def _batched_project(Xb, Hb, W, WWT, w_colsum, h_tol, *, beta,
+                         max_iter, l1, l2):
+        """One vmapped usage solve over request lanes: each lane is the
+        exact solo per-chunk program (``_chunk_h_solve`` with the same
+        statics ``_fit_h_chunked`` uses), so lane results are
+        bit-identical to solo dispatch; ``return_resid`` adds the
+        per-lane convergence residual the host-side health grading
+        reads (zero extra device ops on the H values). ``WWT`` (beta=2)
+        / ``w_colsum`` (beta=1) are the reference's resident
+        loop-invariant products — computed once per daemon by the same
+        device ops the solo program derives them with (bit-equal)."""
+
+        def lane(x, h):
+            return _chunk_h_solve(x, h, W, WWT, beta, l1, l2, max_iter,
+                                  h_tol, w_colsum=w_colsum,
+                                  return_resid=True)
+
+        return jax.vmap(lane)(Xb, Hb)
+
+    return _batched_project
+
+
+_batched_project = None
+_batched_project_lock = threading.Lock()
+
+
+def batched_project():
+    """The lazily-built jitted batch program (module-level so every
+    service instance shares ONE jit cache; jax imports stay off the
+    module import path for jax-free consumers of the error types)."""
+    global _batched_project
+    with _batched_project_lock:
+        if _batched_project is None:
+            _batched_project = _make_batched_project()
+        return _batched_project
+
+
+# ---------------------------------------------------------------------------
+# requests
+# ---------------------------------------------------------------------------
+
+_SENTINEL = object()
+_req_ids = itertools.count(1)
+
+
+class _Request:
+    __slots__ = ("rid", "tenant", "X", "n", "h_init", "warm",
+                 "t_enqueue", "event", "_rlock", "result", "error",
+                 "meta")
+
+    def __init__(self, tenant: str, X: np.ndarray, h_init, warm: bool):
+        self.rid = next(_req_ids)
+        self.tenant = tenant
+        self.X = X
+        self.n = int(X.shape[0])
+        self.h_init = h_init
+        self.warm = warm
+        self.t_enqueue = time.perf_counter()
+        self.event = threading.Event()
+        self._rlock = threading.Lock()
+        self.result = None
+        self.error = None
+        self.meta: dict = {}
+
+    def reply(self, result=None, error=None, **meta):
+        # first reply wins: the dispatcher and the shutdown drain can
+        # race on a request caught mid-close — the loser must not
+        # overwrite a delivered result
+        with self._rlock:
+            if self.event.is_set():
+                return
+            self.result = result
+            self.error = error
+            self.meta.update(meta)
+            self.event.set()
+
+    def wait(self, timeout=None):
+        if not self.event.wait(timeout):
+            raise ShedError(
+                f"request {self.rid}: no reply within {timeout} s (daemon "
+                f"overloaded or gone)")
+        if self.error is not None:
+            raise self.error
+        return self.result, self.meta
+
+
+# ---------------------------------------------------------------------------
+# the service
+# ---------------------------------------------------------------------------
+
+class ProjectionService:
+    """Resident-reference projection with cross-request batching.
+
+    Construct with a staged (or stageable)
+    :class:`~cnmf_torch_tpu.serving.reference.ResidentReference`, call
+    :meth:`start` (which stages the reference, AOT-warms the bucketed
+    program cache, and starts the dispatcher), then :meth:`project`
+    from any number of threads. :meth:`close` drains and stops.
+    """
+
+    def __init__(self, reference, *, max_batch: int | None = None,
+                 linger_ms: float | None = None,
+                 timeout_s: float | None = None,
+                 buckets: str | None = None,
+                 warm_start: bool | None = None,
+                 events=None, liveness=None):
+        self.reference = reference
+        self.max_batch = (env_int("CNMF_TPU_SERVE_BATCH", 8, lo=1)
+                          if max_batch is None else int(max_batch))
+        linger = (env_float("CNMF_TPU_SERVE_LINGER_MS", 2.0, lo=0.0)
+                  if linger_ms is None else float(linger_ms))
+        self.linger_s = linger / 1000.0
+        self.timeout_s = (env_float("CNMF_TPU_SERVE_TIMEOUT_S", 30.0,
+                                    lo=0.0)
+                          if timeout_s is None else float(timeout_s))
+        self.warm_start = (env_flag("CNMF_TPU_SERVE_WARM_START", True)
+                           if warm_start is None else bool(warm_start))
+        self.buckets = resolve_buckets(reference.chunk_size, buckets)
+        self.b_buckets = lane_buckets(self.max_batch)
+        self.events = events
+        self.liveness = liveness
+        # bounded admission queue: beyond ~4 batches of backlog the
+        # daemon sheds instead of queueing into timeout territory
+        self._q: queue.Queue = queue.Queue(maxsize=4 * self.max_batch)
+        self._thread: threading.Thread | None = None
+        self._running = False
+        self._lock = threading.Lock()
+        # program cache bookkeeping: (b_pad, n_pad) -> warmed at startup?
+        self._programs: dict = {}
+        self._warmup_done = False
+        # default-init cache: ONE grow-only uniform draw whose row prefix
+        # serves every request size (fit_h_default_init's prefix
+        # property) — avoids a device draw + fetch per request
+        self._init_cache: np.ndarray | None = None
+        # warm starts: (tenant, n) -> last healthy usage matrix (LRU)
+        self._warm_cache: dict = {}
+        # tenant poison strikes / quarantine
+        self._strikes: dict = {}
+        self._quarantined: set = set()
+        # counters
+        self._stats = {
+            "requests": 0, "ok": 0, "shed": 0, "poison": 0,
+            "quarantined": 0, "error": 0, "batches": 0,
+            "multi_request_batches": 0, "lanes_total": 0,
+            "max_lanes": 0, "warm_started": 0,
+            "cold_dispatches_after_warmup": 0,
+        }
+        self._latencies: list = []
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self, warmup: bool = True):
+        """Stage the reference device-resident, AOT-warm the program
+        buckets, and start the dispatcher thread. Idempotent."""
+        with self._lock:
+            if self._running:
+                return self
+            # the lane builder's default-init prefix slicing
+            # (_default_init) is only bit-compatible with solo
+            # fit_h inits under the partitionable threefry (the
+            # fit_h(k_pad=...) contract) — an explicit legacy-threefry
+            # pin must refuse loudly, never serve silently-divergent
+            # projections
+            from ..utils.jax_compat import assert_threefry_partitionable
+
+            assert_threefry_partitionable("cnmf-tpu serve")
+            self.reference.stage(events=self.events)
+            self._running = True
+        if warmup:
+            self.warmup()
+        t = threading.Thread(target=self._dispatch_loop,
+                             name="cnmf-serve-dispatch", daemon=True)
+        self._thread = t
+        t.start()
+        return self
+
+    def close(self):
+        """Stop the dispatcher; queued requests get a clear shed error."""
+        with self._lock:
+            if not self._running:
+                return
+            self._running = False
+        self._q.put(_SENTINEL)
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if req is not _SENTINEL:
+                req.reply(error=ShedError("daemon shutting down"))
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- warmup --------------------------------------------------------
+
+    def warmup(self):
+        """AOT-compile every (lane-count, rows) bucket program
+        CONCURRENTLY (the replicate-sweep warmer's approach,
+        ``parallel/replicates.py:warm_sweep_programs``: compiles release
+        the GIL and populate the same jit cache the dispatch hits), then
+        execute the budget-sized ones once on zeros so first dispatch
+        pays no executable-upload cost either. After this returns, a
+        steady-traffic daemon compiles nothing — cold dispatches are
+        counted and reported by :meth:`stats`."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..parallel.replicates import run_warm_jobs
+
+        ref = self.reference
+        prog = batched_project()
+        g, k = ref.n_genes, ref.k
+        budget = env_int("CNMF_TPU_WARM_DUMMY_BUDGET_BYTES", 2 << 30,
+                         lo=0)
+
+        def warm_one(spec):
+            b_pad, n_pad = spec
+            xs = jax.ShapeDtypeStruct((b_pad, n_pad, g), jnp.float32)
+            hs = jax.ShapeDtypeStruct((b_pad, n_pad, k), jnp.float32)
+            ws = jax.ShapeDtypeStruct((k, g), jnp.float32)
+            wwts = (jax.ShapeDtypeStruct((k, k), jnp.float32)
+                    if ref.WWT is not None else None)
+            cols = (jax.ShapeDtypeStruct((k,), jnp.float32)
+                    if ref.w_colsum is not None else None)
+            ts = jax.ShapeDtypeStruct((), jnp.float32)
+            prog.lower(xs, hs, ws, wwts, cols, ts, beta=ref.beta,
+                       max_iter=ref.chunk_max_iter, l1=ref.l1_H,
+                       l2=0.0).compile()
+            if b_pad * n_pad * g * 4 <= budget:
+                # one real dispatch so the first request pays warm
+                # dispatch cost, not executable upload (the consensus
+                # warmers' lesson: AOT compile alone does not move the
+                # program to a tunneled device)
+                Xb = jnp.zeros((b_pad, n_pad, g), jnp.float32)
+                Hb = jnp.zeros((b_pad, n_pad, k), jnp.float32)
+                jax.block_until_ready(prog(
+                    Xb, Hb, ref.Wd, ref.WWT, ref.w_colsum,
+                    ref.h_tol_dev, beta=ref.beta,
+                    max_iter=ref.chunk_max_iter, l1=ref.l1_H, l2=0.0))
+            self._programs[spec] = True
+
+        specs = [(b, n) for b in self.b_buckets for n in self.buckets]
+        run_warm_jobs([functools.partial(warm_one, s) for s in specs],
+                      swallow=False)
+        self._warmup_done = True
+        return len(specs)
+
+    # -- admission -----------------------------------------------------
+
+    def submit(self, X, tenant: str = "default") -> _Request:
+        """Validate + enqueue one projection request; returns the pending
+        handle (``.wait()`` for the result). Raises ``ServeError``
+        subclasses on admission failure."""
+        tenant = str(tenant)
+        if not self._running:
+            raise ShedError("daemon not running")
+        if tenant in self._quarantined:
+            self._count("quarantined")
+            self._emit_request(tenant, getattr(X, "shape", (0,))[0],
+                              "quarantined")
+            raise QuarantinedError(
+                f"tenant {tenant!r} is quarantined after "
+                f"{POISON_QUARANTINE_STRIKES} poison inputs; restart the "
+                f"daemon (or fix the inputs) to clear it")
+        X = np.ascontiguousarray(np.asarray(X), dtype=np.float32)
+        if X.ndim != 2 or X.shape[0] < 1:
+            raise self._reject(tenant, 0, ServeError(
+                f"request must be a (cells, genes) matrix, got shape "
+                f"{X.shape}"))
+        if X.shape[1] != self.reference.n_genes:
+            raise self._reject(tenant, X.shape[0], ServeError(
+                f"request has {X.shape[1]} genes; the resident reference "
+                f"expects {self.reference.n_genes} (its gene order — see "
+                f"/healthz)"))
+        # cap request size at one full batch of lanes: every dispatch
+        # then stays inside the AOT-warmed (lanes, rows) bucket schedule
+        # — an unbounded request would compile a fresh program shape on
+        # the hot path and grow the program cache for the daemon's
+        # lifetime
+        max_cells = self.reference.chunk_size * self.max_batch
+        if X.shape[0] > max_cells:
+            raise self._reject(tenant, X.shape[0], ServeError(
+                f"request has {X.shape[0]} cells; this daemon accepts at "
+                f"most {max_cells} per request (chunk "
+                f"{self.reference.chunk_size} x CNMF_TPU_SERVE_BATCH="
+                f"{self.max_batch} lanes) — split the matrix into row "
+                f"blocks and project them separately (results are "
+                f"row-independent)"))
+        h_init, warm = self._warm_init_for(tenant, X)
+        req = _Request(tenant, X, h_init, warm)
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            self._count("shed")
+            self._emit_request(tenant, X.shape[0], "shed")
+            raise ShedError(
+                f"admission queue full ({self._q.maxsize} requests in "
+                f"flight); retry with backoff")
+        if not self._running:
+            # close() raced us: the dispatcher may already have drained
+            # the queue, so nobody would ever reply — shed immediately.
+            # First-reply-wins makes this a no-op if the dispatcher DID
+            # handle the request before exiting; wait() then surfaces
+            # whichever reply won.
+            req.reply(error=ShedError("daemon shutting down"))
+        return req
+
+    def _reject(self, tenant: str, n_cells, error: ServeError):
+        """Account an admission rejection (counter + telemetry) and hand
+        the error back for raising — rejected traffic must be as visible
+        to the operator as served traffic."""
+        self._count(error.status)
+        self._emit_request(tenant, n_cells, error.status)
+        return error
+
+    def project(self, X, tenant: str = "default", timeout: float | None
+                = None) -> tuple[np.ndarray, dict]:
+        """Blocking projection: returns ``(usage (n, k), meta)``."""
+        req = self.submit(X, tenant=tenant)
+        wait = timeout
+        if wait is None:
+            wait = (self.timeout_s + 120.0) if self.timeout_s else None
+        return req.wait(wait)
+
+    # -- dispatcher ----------------------------------------------------
+
+    def _dispatch_loop(self):
+        carry = None
+        while True:
+            if carry is not None:
+                req, carry = carry, None
+            else:
+                req = self._q.get()
+            if req is _SENTINEL:
+                break
+            if self._expired(req):
+                continue
+            batch = [req]
+            lanes = lane_count(req.n, self.reference.chunk_size)
+            deadline = time.perf_counter() + self.linger_s
+            while lanes < self.max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is _SENTINEL:
+                    carry = _SENTINEL
+                    break
+                if self._expired(nxt):
+                    continue
+                n_lanes = lane_count(nxt.n, self.reference.chunk_size)
+                if lanes + n_lanes > self.max_batch:
+                    carry = nxt
+                    break
+                batch.append(nxt)
+                lanes += n_lanes
+            try:
+                self._dispatch(batch)
+            except Exception as exc:  # pragma: no cover - defensive
+                for r in batch:
+                    if not r.event.is_set():
+                        r.reply(error=ServeError(
+                            f"batch dispatch failed: {exc}"))
+            if carry is _SENTINEL:
+                break
+
+    def _expired(self, req) -> bool:
+        if not self.timeout_s:
+            return False
+        waited = time.perf_counter() - req.t_enqueue
+        if waited <= self.timeout_s:
+            return False
+        self._count("shed")
+        self._emit_request(req.tenant, req.n, "shed",
+                           wait_ms=round(waited * 1e3, 3))
+        req.reply(error=ShedError(
+            f"request {req.rid}: shed after waiting "
+            f"{waited:.2f} s (> CNMF_TPU_SERVE_TIMEOUT_S="
+            f"{self.timeout_s:g}); the daemon is overloaded"))
+        return True
+
+    # -- batched solve -------------------------------------------------
+
+    def _default_init(self, n: int) -> np.ndarray:
+        """Rows ``[0:n]`` of the solo default H init (grow-only cache:
+        the partitionable-threefry prefix property makes one large draw's
+        prefix bit-equal to every smaller draw)."""
+        with self._lock:
+            cached = self._init_cache
+        if cached is None or cached.shape[0] < n:
+            from ..ops.nmf import fit_h_default_init
+
+            size = max(int(n), self.buckets[-1])
+            fresh = np.asarray(fit_h_default_init(size, self.reference.k))
+            with self._lock:
+                if (self._init_cache is None
+                        or self._init_cache.shape[0] < size):
+                    self._init_cache = fresh
+                cached = self._init_cache
+        return cached[:n]
+
+    @staticmethod
+    def _x_token(X: np.ndarray) -> tuple:
+        """Cheap content fingerprint (shape + f64 sum + strided sample —
+        the residency cache's approach in ``models/cnmf.py``): warm
+        starts must only fire for a REPEAT of the same matrix. A
+        different matrix of the same shape must never inherit a previous
+        solve's exact-zero entries — zeros are absorbing under MU, so a
+        shape-keyed warm start could silently pin genuinely-active
+        components to zero rather than merely converge faster."""
+        buf = X.ravel()
+        step = max(1, buf.size // 64)
+        return (X.shape, float(buf.sum(dtype=np.float64)),
+                buf[::step][:64].tobytes())
+
+    def _warm_init_for(self, tenant: str, X: np.ndarray):
+        """The (h_init, warm?) pair for a request: the tenant's previous
+        healthy usage for this exact matrix when warm starts are on,
+        else None (solo default init)."""
+        if not self.warm_start:
+            return None, False
+        with self._lock:
+            H = self._warm_cache.get((tenant, self._x_token(X)))
+        if H is None:
+            return None, False
+        return H, True
+
+    def _dispatch(self, batch: list):
+        t0 = time.perf_counter()
+        ref = self.reference
+        chunk_size = ref.chunk_size
+        g, k = ref.n_genes, ref.k
+
+        # lane plan: (request, row_lo, row_hi) in request coordinates —
+        # the solo chunk partition, so each lane is exactly one chunk of
+        # the request's own fit_h dispatch
+        lanes = []
+        for req in batch:
+            chunk = min(chunk_size, req.n)
+            for lo in range(0, req.n, chunk):
+                lanes.append((req, lo, min(lo + chunk, req.n)))
+        n_pad = bucket_for(max(hi - lo for _, lo, hi in lanes),
+                           self.buckets)
+        # admission caps a request at chunk_size * max_batch cells, so a
+        # batch's lane count always fits the warmed bucket schedule
+        b_pad = bucket_for(len(lanes), self.b_buckets)
+
+        Xb = np.zeros((b_pad, n_pad, g), np.float32)
+        Hb = np.zeros((b_pad, n_pad, k), np.float32)
+        inits: dict = {}
+        for i, (req, lo, hi) in enumerate(lanes):
+            Xb[i, :hi - lo] = req.X[lo:hi]
+            H0 = inits.get(req.rid)
+            if H0 is None:
+                if req.h_init is not None:
+                    # the solo comparator is fit_h(H_init=prev), which
+                    # clamps at zero — mirror it exactly
+                    H0 = np.maximum(
+                        np.asarray(req.h_init, np.float32), 0.0)
+                else:
+                    H0 = self._default_init(req.n)
+                inits[req.rid] = H0
+            Hb[i, :hi - lo] = H0[lo:hi]
+
+        key = (int(b_pad), int(n_pad))
+        cache_hit = bool(self._programs.get(key))
+        if not cache_hit:
+            self._programs[key] = True
+            if self._warmup_done:
+                self._count("cold_dispatches_after_warmup")
+
+        import jax
+
+        t_solve = time.perf_counter()
+        Xd = jax.device_put(Xb)
+        Hd = jax.device_put(Hb)
+        out_h, out_rel = batched_project()(
+            Xd, Hd, ref.Wd, ref.WWT, ref.w_colsum, ref.h_tol_dev,
+            beta=ref.beta, max_iter=ref.chunk_max_iter, l1=ref.l1_H,
+            l2=0.0)
+        H_all = np.asarray(jax.device_get(out_h))
+        rel_all = np.asarray(jax.device_get(out_rel))
+        solve_ms = (time.perf_counter() - t_solve) * 1e3
+
+        # PR-4 health grading, per lane: a nonfinite residual or factor
+        # block marks ONLY its own lane — batchmates are independent
+        from ..ops.nmf import lane_health
+
+        health = lane_health(rel_all, spectra=H_all)
+
+        with self._lock:
+            self._stats["batches"] += 1
+            self._stats["lanes_total"] += len(lanes)
+            self._stats["max_lanes"] = max(self._stats["max_lanes"],
+                                           len(lanes))
+            if len(batch) > 1:
+                self._stats["multi_request_batches"] += 1
+        if self.events is not None:
+            self.events.emit(
+                "serve_batch", lanes=len(lanes), requests=len(batch),
+                bucket=[int(b_pad), int(n_pad)],
+                solve_ms=round(solve_ms, 3), cache_hit=cache_hit,
+                queue_depth=self._q.qsize())
+        if self.liveness is not None:
+            try:
+                self.liveness(phase="serve", cursor=self._stats["batches"])
+            except Exception:
+                pass
+
+        # deterministic unpadding: each request's usage is the ordered
+        # concatenation of its lanes' real rows
+        by_req: dict = {}
+        for i, (req, lo, hi) in enumerate(lanes):
+            ok, rows = by_req.get(req.rid, (True, []))
+            by_req[req.rid] = (ok and bool(health[i]),
+                               rows + [H_all[i, :hi - lo]])
+        for req in batch:
+            healthy, rows = by_req[req.rid]
+            wait_ms = round((t_solve - req.t_enqueue) * 1e3, 3)
+            if healthy:
+                H = np.concatenate(rows, axis=0)
+                if self.warm_start:
+                    self._store_warm(req.tenant, self._x_token(req.X), H)
+                self._count("ok")
+                if req.warm:
+                    self._count("warm_started")
+                total = round(
+                    (time.perf_counter() - req.t_enqueue) * 1e3, 3)
+                with self._lock:
+                    self._latencies.append(total)
+                    if len(self._latencies) > _LATENCY_SAMPLES:
+                        del self._latencies[:len(self._latencies) // 2]
+                self._emit_request(
+                    req.tenant, req.n, "ok", wait_ms=wait_ms,
+                    solve_ms=round(solve_ms, 3), total_ms=total,
+                    batch_lanes=len(lanes), batch_requests=len(batch),
+                    warm_start=req.warm)
+                req.reply(result=H, batch_lanes=len(lanes),
+                          batch_requests=len(batch), warm_start=req.warm,
+                          wait_ms=wait_ms, solve_ms=round(solve_ms, 3))
+            else:
+                strikes = self._strike(req.tenant)
+                self._count("poison")
+                self._emit_request(
+                    req.tenant, req.n, "poison", wait_ms=wait_ms,
+                    solve_ms=round(solve_ms, 3),
+                    batch_lanes=len(lanes), batch_requests=len(batch))
+                if self.events is not None:
+                    self.events.emit(
+                        "fault", kind="serve_poison",
+                        context={"tenant": req.tenant, "n_cells": req.n,
+                                 "strikes": strikes,
+                                 "quarantined":
+                                     req.tenant in self._quarantined})
+                req.reply(error=PoisonError(
+                    f"request {req.rid} (tenant {req.tenant!r}): "
+                    f"projection graded unhealthy (nonfinite input or "
+                    f"usage); strike {strikes}/"
+                    f"{POISON_QUARANTINE_STRIKES}"))
+
+    def _store_warm(self, tenant: str, token: tuple, H: np.ndarray):
+        with self._lock:
+            cache = self._warm_cache
+            cache.pop((tenant, token), None)
+            cache[(tenant, token)] = H
+            while len(cache) > _WARM_CACHE_ENTRIES:
+                cache.pop(next(iter(cache)))
+
+    def _strike(self, tenant: str) -> int:
+        with self._lock:
+            strikes = self._strikes.get(tenant, 0) + 1
+            self._strikes[tenant] = strikes
+            if strikes >= POISON_QUARANTINE_STRIKES:
+                self._quarantined.add(tenant)
+            return strikes
+
+    # -- accounting ----------------------------------------------------
+
+    def _count(self, key: str):
+        with self._lock:
+            self._stats["requests"] += key in (
+                "ok", "shed", "poison", "quarantined", "error")
+            self._stats[key] = self._stats.get(key, 0) + 1
+
+    def _emit_request(self, tenant: str, n_cells, status: str, **fields):
+        if self.events is not None:
+            self.events.emit("serve_request", tenant=str(tenant),
+                             n_cells=int(n_cells), status=status,
+                             **fields)
+
+    def stats(self) -> dict:
+        from ..utils.profiling import latency_summary
+
+        with self._lock:
+            out = dict(self._stats)
+            lat = list(self._latencies)
+            out["quarantined_tenants"] = sorted(self._quarantined)
+            out["programs_warmed"] = sum(
+                1 for v in self._programs.values() if v)
+        out["batched_fraction"] = (
+            round(out["multi_request_batches"] / out["batches"], 3)
+            if out["batches"] else 0.0)
+        out["mean_lanes"] = (round(out["lanes_total"] / out["batches"], 2)
+                             if out["batches"] else 0.0)
+        out["latency_ms"] = latency_summary(lat)
+        out["reference"] = self.reference.describe()
+        out["buckets"] = list(self.buckets)
+        out["lane_buckets"] = list(self.b_buckets)
+        return out

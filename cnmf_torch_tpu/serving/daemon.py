@@ -1,0 +1,383 @@
+"""The projection daemon's protocol front end (ISSUE 12).
+
+Stdlib-only HTTP/JSON over either a 127.0.0.1 TCP port or (the default)
+a unix domain socket next to the run's artifacts — no new dependencies,
+no open network surface unless asked for. One daemon serves one resident
+reference; concurrent client connections are handled by a threading
+server whose request threads all feed the ONE micro-batching dispatcher
+(``batcher.py``) — which is exactly how cross-request batching happens:
+N racing HTTP clients become one vmapped device dispatch.
+
+Protocol (all bodies JSON):
+
+  * ``POST /project`` — ``{"tenant": "...", "data": [[...]]}`` or
+    ``{"tenant": "...", "shape": [n, g], "b64": "<base64 f32
+    row-major>"}``. Success: ``{"ok": true, "shape": [n, k], "b64" |
+    "usage": ..., "meta": {...}}`` (the reply mirrors the request's
+    encoding). Errors carry ``{"ok": false, "status", "error"}`` with
+    HTTP 429 (shed), 422 (poison), 403 (quarantined), 400 (bad
+    request).
+  * ``GET /healthz`` — liveness + resident-reference summary.
+  * ``GET /reference`` — full reference description incl. gene order.
+  * ``GET /stats`` — serving counters + latency summary
+    (``utils/profiling.latency_summary``).
+  * ``POST /shutdown`` — clean stop (the socket file is removed).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import socket
+import socketserver
+import threading
+from http.client import HTTPConnection
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from .batcher import (PoisonError, ProjectionService, QuarantinedError,
+                      ServeError, ShedError)
+
+__all__ = ["ServeDaemon", "ServeClient", "serve_forever",
+           "default_socket_path"]
+
+_STATUS_HTTP = {"shed": 429, "poison": 422, "quarantined": 403,
+                "error": 400}
+
+
+def default_socket_path(run_dir: str) -> str:
+    name = os.path.basename(os.path.normpath(run_dir))
+    return os.path.join(run_dir, "cnmf_tmp", name + ".serve.sock")
+
+
+def _decode_matrix(payload: dict) -> np.ndarray:
+    if "b64" in payload:
+        shape = payload.get("shape")
+        if (not isinstance(shape, (list, tuple)) or len(shape) != 2):
+            raise ValueError("b64 requests need \"shape\": [n, g]")
+        raw = base64.b64decode(payload["b64"])
+        n, g = int(shape[0]), int(shape[1])
+        if len(raw) != n * g * 4:
+            raise ValueError(
+                f"b64 payload is {len(raw)} bytes; shape {n}x{g} needs "
+                f"{n * g * 4} (f32 row-major)")
+        return np.frombuffer(raw, np.float32).reshape(n, g)
+    if "data" in payload:
+        return np.asarray(payload["data"], dtype=np.float32)
+    raise ValueError("request needs \"data\" (nested lists) or "
+                     "\"b64\" + \"shape\"")
+
+
+def _encode_matrix(H: np.ndarray, like: dict) -> dict:
+    if "b64" in like:
+        return {"shape": list(H.shape),
+                "b64": base64.b64encode(
+                    np.ascontiguousarray(H, np.float32).tobytes()
+                ).decode("ascii")}
+    return {"shape": list(H.shape), "usage": H.tolist()}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # the daemon's own telemetry covers request accounting; stderr
+    # access logs would interleave with the pipeline's prints
+    def log_message(self, fmt, *args):  # noqa: D401 - BaseHTTP override
+        pass
+
+    @property
+    def service(self) -> ProjectionService:
+        return self.server.service
+
+    def _reply(self, code: int, obj: dict):
+        body = json.dumps(obj).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._reply(200, {
+                "ok": True,
+                "reference": self.service.reference.describe()})
+        elif self.path == "/reference":
+            ref = self.service.reference
+            self._reply(200, dict(
+                ref.describe(), genes=ref.genes,
+                components=[str(c) for c in ref.components]))
+        elif self.path == "/stats":
+            self._reply(200, {"ok": True, "stats": self.service.stats()})
+        else:
+            self._reply(404, {"ok": False, "error": f"no route "
+                              f"{self.path!r}"})
+
+    def do_POST(self):
+        if self.path == "/shutdown":
+            self._reply(200, {"ok": True, "stopping": True})
+            threading.Thread(target=self.server.shutdown,
+                             daemon=True).start()
+            return
+        if self.path != "/project":
+            self._reply(404, {"ok": False,
+                              "error": f"no route {self.path!r}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            X = _decode_matrix(payload)
+        except (ValueError, json.JSONDecodeError) as exc:
+            self._reply(400, {"ok": False, "status": "error",
+                              "error": str(exc)})
+            return
+        tenant = str(payload.get("tenant", "default"))
+        try:
+            H, meta = self.service.project(X, tenant=tenant)
+        except (ShedError, PoisonError, QuarantinedError,
+                ServeError) as exc:
+            self._reply(_STATUS_HTTP.get(exc.status, 400),
+                        {"ok": False, "status": exc.status,
+                         "error": str(exc)})
+            return
+        self._reply(200, dict({"ok": True, "meta": meta},
+                              **_encode_matrix(H, payload)))
+
+
+class _UnixHTTPServer(ThreadingHTTPServer):
+    address_family = socket.AF_UNIX
+
+    def server_bind(self):
+        # BaseHTTPServer's server_bind unpacks (host, port); a unix
+        # address is a path string
+        socketserver.TCPServer.server_bind(self)
+        self.server_name = "localhost"
+        self.server_port = 0
+
+
+class ServeDaemon:
+    """One resident reference behind one HTTP endpoint.
+
+    ``socket_path`` (default) binds a unix domain socket —
+    collision-free for tests/CI and invisible off-host; ``port`` binds
+    ``127.0.0.1:port`` instead. Construction binds and warms; call
+    :meth:`serve_forever` (blocking) or :meth:`start` (background
+    thread). :meth:`close` stops the batcher, closes the server, and
+    removes the socket file.
+    """
+
+    def __init__(self, service: ProjectionService,
+                 socket_path: str | None = None, port: int | None = None):
+        self.service = service
+        self.socket_path = None
+        if port is not None:
+            self.server = ThreadingHTTPServer(("127.0.0.1", int(port)),
+                                              _Handler)
+        else:
+            if socket_path is None:
+                raise ValueError("need socket_path or port")
+            # a stale socket file from a crashed daemon is unconnectable
+            # garbage; replace it (a LIVE daemon still owns the inode and
+            # keeps serving its existing connections — same model as the
+            # launcher's stale-ledger sweep)
+            if os.path.exists(socket_path):
+                os.unlink(socket_path)
+            self.server = _UnixHTTPServer(socket_path, _Handler)
+            self.socket_path = socket_path
+        self.server.daemon_threads = True
+        self.server.service = service
+        self._thread = None
+        self._closed = False
+
+    @property
+    def address(self) -> str:
+        if self.socket_path:
+            return self.socket_path
+        host, port = self.server.server_address[:2]
+        return f"{host}:{port}"
+
+    def start(self):
+        self.service.start()
+        t = threading.Thread(target=self.server.serve_forever,
+                             name="cnmf-serve-http", daemon=True)
+        self._thread = t
+        t.start()
+        return self
+
+    def serve_forever(self):
+        self.service.start()
+        try:
+            self.server.serve_forever()
+        finally:
+            self.close()
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self.server.shutdown()
+        self.server.server_close()
+        self.service.close()
+        if self.socket_path and os.path.exists(self.socket_path):
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+
+class _UnixHTTPConnection(HTTPConnection):
+    def __init__(self, path: str, timeout=None):
+        super().__init__("localhost", timeout=timeout)
+        self._unix_path = path
+
+    def connect(self):
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        if self.timeout is not None:
+            s.settimeout(self.timeout)
+        s.connect(self._unix_path)
+        self.sock = s
+
+
+class ServeClient:
+    """Minimal stdlib client for the daemon (tests, smoke, bench, and a
+    copy-paste example for real clients). One connection per call —
+    correctness over connection reuse."""
+
+    def __init__(self, socket_path: str | None = None,
+                 host: str = "127.0.0.1", port: int | None = None,
+                 timeout: float = 180.0):
+        if socket_path is None and port is None:
+            raise ValueError("need socket_path or port")
+        self.socket_path = socket_path
+        self.host, self.port = host, port
+        self.timeout = timeout
+
+    def _request(self, method: str, path: str, payload: dict | None = None):
+        if self.socket_path:
+            conn = _UnixHTTPConnection(self.socket_path,
+                                       timeout=self.timeout)
+        else:
+            conn = HTTPConnection(self.host, self.port,
+                                  timeout=self.timeout)
+        try:
+            body = (json.dumps(payload).encode("utf-8")
+                    if payload is not None else None)
+            headers = {"Content-Type": "application/json"} if body else {}
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = json.loads(resp.read() or b"{}")
+            return resp.status, data
+        finally:
+            conn.close()
+
+    def project(self, X, tenant: str = "default",
+                encoding: str = "b64"):
+        """Project ``X`` (n x genes) onto the resident reference;
+        returns ``(usage (n, k) np.ndarray, meta dict)``. Raises the
+        matching :class:`ServeError` subclass on a daemon-side error."""
+        X = np.ascontiguousarray(np.asarray(X), dtype=np.float32)
+        payload: dict = {"tenant": tenant}
+        if encoding == "b64":
+            payload["shape"] = list(X.shape)
+            payload["b64"] = base64.b64encode(X.tobytes()).decode("ascii")
+        else:
+            payload["data"] = X.tolist()
+        status, data = self._request("POST", "/project", payload)
+        if status != 200 or not data.get("ok"):
+            err = {"shed": ShedError, "poison": PoisonError,
+                   "quarantined": QuarantinedError}.get(
+                data.get("status"), ServeError)
+            raise err(data.get("error", f"HTTP {status}"))
+        if "b64" in data:
+            H = np.frombuffer(base64.b64decode(data["b64"]),
+                              np.float32).reshape(data["shape"])
+        else:
+            H = np.asarray(data["usage"], dtype=np.float32)
+        return H, data.get("meta", {})
+
+    def healthz(self) -> dict:
+        status, data = self._request("GET", "/healthz")
+        if status != 200:
+            raise ServeError(f"healthz: HTTP {status}: {data}")
+        return data
+
+    def reference(self) -> dict:
+        status, data = self._request("GET", "/reference")
+        if status != 200:
+            raise ServeError(f"reference: HTTP {status}: {data}")
+        return data
+
+    def stats(self) -> dict:
+        status, data = self._request("GET", "/stats")
+        if status != 200:
+            raise ServeError(f"stats: HTTP {status}: {data}")
+        return data["stats"]
+
+    def shutdown(self):
+        status, data = self._request("POST", "/shutdown")
+        return status == 200
+
+
+def serve_forever(run_dir: str, k: int | None = None,
+                  density_threshold=None, spectra_path: str | None = None,
+                  socket_path: str | None = None, port: int | None = None):
+    """The ``cnmf-tpu serve <run_dir>`` entry: load + stage the
+    reference, warm the program buckets, bind, and serve until
+    SIGINT/SIGTERM (clean close: batcher drained, socket removed)."""
+    import signal
+
+    from ..utils.telemetry import EventLog
+    from .reference import load_reference
+
+    name = os.path.basename(os.path.normpath(run_dir))
+    events = EventLog(
+        os.path.join(run_dir, "cnmf_tmp", name + ".events.jsonl"),
+        manifest_extra={"run_name": name, "role": "serve"})
+    ref = load_reference(run_dir, k=k, density_threshold=density_threshold,
+                         spectra_path=spectra_path)
+
+    liveness = None
+    from ..runtime.elastic import Heartbeat
+
+    hb = Heartbeat(os.path.join(run_dir, "cnmf_tmp"), name + ".serve", 0,
+                   events=events)
+    if hb.enabled:
+        liveness = hb.beat
+
+    service = ProjectionService(ref, events=events, liveness=liveness)
+    if port is None and socket_path is None:
+        socket_path = default_socket_path(run_dir)
+    daemon = ServeDaemon(service, socket_path=socket_path, port=port)
+
+    def _stop(signum, frame):
+        threading.Thread(target=daemon.server.shutdown,
+                         daemon=True).start()
+
+    prev = {}
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            prev[sig] = signal.signal(sig, _stop)
+        except ValueError:  # non-main thread (tests)
+            pass
+    print(f"cnmf-tpu serve: reference k={ref.k} x {ref.n_genes} genes "
+          f"(beta={ref.beta:g}) from {ref.source}")
+    try:
+        daemon.service.start()
+        print(f"cnmf-tpu serve: listening on {daemon.address} "
+              f"(buckets {list(service.buckets)}, batch <= "
+              f"{service.max_batch} lanes, linger "
+              f"{service.linger_s * 1e3:g} ms)")
+        daemon.serve_forever()
+    finally:
+        daemon.close()
+        for sig, handler in prev.items():
+            try:
+                signal.signal(sig, handler)
+            except ValueError:
+                pass
+    return 0

@@ -195,6 +195,33 @@ REGISTRY: dict[str, Knob] = _knobs(
     Knob("CNMF_H5_COMPRESSION", "str", "`none`",
          "h5ad artifact compression: `none` (reference-matching default; "
          "gzip-1 was ~5 s of a 22 s prepare), `gzip` (level 1), or `lzf`"),
+    # -- warm serving tier (serving/, ISSUE 12) ---------------------------
+    Knob("CNMF_TPU_SERVE_BATCH", "int", "`8`",
+         "projection daemon (`cnmf-tpu serve`): max request lanes "
+         "coalesced into one batched device dispatch; `1` disables "
+         "cross-request batching (every request solves solo)"),
+    Knob("CNMF_TPU_SERVE_LINGER_MS", "float", "`2`",
+         "micro-batching linger: after the first queued request, the "
+         "dispatcher waits up to this many milliseconds for batchmates "
+         "before launching the (possibly smaller) batch; `0` dispatches "
+         "immediately"),
+    Knob("CNMF_TPU_SERVE_BUCKETS", "str", "`64,256,1024`",
+         "padded-shape bucket schedule for the serve program cache: "
+         "request row counts round up to the next bucket (the run's "
+         "online chunk size is always appended as the top bucket) so a "
+         "bounded program set serves every request shape with zero "
+         "steady-state compiles"),
+    Knob("CNMF_TPU_SERVE_TIMEOUT_S", "float", "`30`",
+         "admission deadline: a request still undispatched this long "
+         "after arrival is shed with a clear error instead of waiting "
+         "behind an overloaded queue (the queue itself is bounded at "
+         "4x the batch size; arrivals beyond it shed immediately)"),
+    Knob("CNMF_TPU_SERVE_WARM_START", "flag", "`1`",
+         "serve-path usage warm starts: a repeat (tenant, matrix) "
+         "projection re-solves from the tenant's previous usage matrix "
+         "instead of the constant init — repeat projections converge in "
+         "a fraction of the inner iterations; `0` restores the "
+         "stateless solo-identical init for every request"),
     # -- observability ----------------------------------------------------
     Knob("CNMF_TPU_TELEMETRY", "flag", "`0`",
          "`1` enables the structured run-telemetry event log "

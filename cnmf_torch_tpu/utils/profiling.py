@@ -20,9 +20,72 @@ import os
 import threading
 import time
 
-__all__ = ["StageTimer", "trace", "PROFILE_ENV"]
+__all__ = ["StageTimer", "trace", "PROFILE_ENV", "percentile",
+           "latency_summary"]
 
 PROFILE_ENV = "CNMF_TPU_PROFILE_DIR"
+
+# log-ish histogram bucket edges for latency summaries, in the caller's
+# unit (serving uses milliseconds): fine buckets where SLOs live, coarse
+# tails, one overflow bucket
+_HIST_EDGES = (1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 500.0,
+               1000.0, 2000.0, 5000.0)
+
+
+def percentile(values, q: float) -> float:
+    """Linear-interpolated percentile (numpy's default method) over an
+    unsorted sequence — the ONE percentile implementation shared by the
+    serving tier's latency accounting (``bench.py --tier serve``) and the
+    telemetry report's serving section, instead of a third hand-rolled
+    variant next to the report's nearest-rank medians."""
+    vals = sorted(float(v) for v in values)
+    if not vals:
+        raise ValueError("percentile of an empty sequence")
+    if len(vals) == 1:
+        return vals[0]
+    pos = (len(vals) - 1) * (float(q) / 100.0)
+    lo = int(pos)
+    hi = min(lo + 1, len(vals) - 1)
+    frac = pos - lo
+    return vals[lo] * (1.0 - frac) + vals[hi] * frac
+
+
+def latency_summary(values, percentiles=(50.0, 95.0, 99.0)) -> dict:
+    """Latency distribution summary: count/mean/max, the requested
+    percentiles (``p50``/``p95``/``p99`` keys), and a fixed-edge histogram
+    (``{"<=1", ..., ">5000": count}`` in the caller's unit — serving
+    passes milliseconds). Empty input yields ``{"count": 0}`` so callers
+    can always embed the result."""
+    vals = [float(v) for v in values]
+    if not vals:
+        return {"count": 0}
+    out = {"count": len(vals),
+           "mean": sum(vals) / len(vals),
+           "max": max(vals)}
+    for q in percentiles:
+        label = ("p%g" % q).replace(".", "_")
+        out[label] = percentile(vals, q)
+    hist: dict = {}
+    edges = _HIST_EDGES
+    for v in vals:
+        for edge in edges:
+            if v <= edge:
+                label = "<=%g" % edge
+                break
+        else:
+            label = ">%g" % edges[-1]
+        hist[label] = hist.get(label, 0) + 1
+    # stable bucket order (dicts preserve insertion): edges first, overflow
+    ordered = {}
+    for edge in edges:
+        label = "<=%g" % edge
+        if label in hist:
+            ordered[label] = hist[label]
+    overflow = ">%g" % edges[-1]
+    if overflow in hist:
+        ordered[overflow] = hist[overflow]
+    out["histogram"] = ordered
+    return out
 
 
 def _sanitize_field(v) -> str:

@@ -87,6 +87,14 @@ EVENT_TYPES = {
     # — the audit trail the chaos gate uses to prove a relaunch resumed
     # mid-run instead of from scratch
     "checkpoint": {"action", "context"},
+    # warm serving tier (serving/, ISSUE 12): one event per projection
+    # request (status in {ok, shed, poison, error, quarantined} plus
+    # wait/solve/total walls and the batch it rode) and one per batched
+    # dispatch (lanes/requests/padded shape/cache hit) — the per-tenant
+    # audit trail behind the report's Serving section and the
+    # `bench.py --tier serve` batching-engagement assertions
+    "serve_request": {"tenant", "n_cells", "status"},
+    "serve_batch": {"lanes", "requests", "bucket"},
 }
 
 # per-record required fields inside a "replicates" event's records list
@@ -680,6 +688,48 @@ def summarize_events(events: list[dict]) -> dict:
             elasticity["max_resume_pass"] = max_resume_pass
         summary["elasticity"] = elasticity
 
+    # warm serving tier (ISSUE 12): request outcomes, per-tenant traffic,
+    # batch-size engagement, and the latency distribution — p50/p95/p99
+    # via the shared percentile helper (utils/profiling.py), the same
+    # implementation the bench serve tier reports
+    reqs = [e for e in events if e["t"] == "serve_request"]
+    batches = [e for e in events if e["t"] == "serve_batch"]
+    if reqs or batches:
+        from .profiling import latency_summary
+
+        by_status: dict = {}
+        by_tenant: dict = {}
+        lat_ms = []
+        for e in reqs:
+            st = str(e.get("status"))
+            by_status[st] = by_status.get(st, 0) + 1
+            ten = str(e.get("tenant"))
+            by_tenant[ten] = by_tenant.get(ten, 0) + 1
+            if st == "ok" and isinstance(e.get("total_ms"), (int, float)):
+                lat_ms.append(float(e["total_ms"]))
+        serving: dict = {"requests": len(reqs),
+                         "by_status": dict(sorted(by_status.items())),
+                         "tenants": len(by_tenant)}
+        if lat_ms:
+            serving["latency_ms"] = latency_summary(lat_ms)
+            span = max(e["ts"] for e in reqs) - min(e["ts"] for e in reqs)
+            if span > 0:
+                serving["qps"] = round(len(lat_ms) / span, 1)
+        if batches:
+            lanes = [int(e.get("lanes", 0)) for e in batches]
+            nreq = [int(e.get("requests", 0)) for e in batches]
+            serving["batches"] = len(batches)
+            serving["mean_lanes"] = round(sum(lanes) / len(lanes), 2)
+            serving["max_lanes"] = max(lanes)
+            serving["multi_request_batches"] = sum(
+                1 for r in nreq if r > 1)
+            hits = [e.get("cache_hit") for e in batches
+                    if e.get("cache_hit") is not None]
+            if hits:
+                serving["cache_hit_fraction"] = round(
+                    sum(bool(h) for h in hits) / len(hits), 3)
+        summary["serving"] = serving
+
     mem_peak = 0
     mem_stage = None
     for e in events:
@@ -895,6 +945,39 @@ def render_report(run_dir: str) -> str:
         if el.get("max_resume_pass") is not None:
             lines.append(f"  {'deepest resumed pass':<28s}"
                          f" {el['max_resume_pass']:>7d}")
+
+    srv = summary.get("serving")
+    if srv:
+        lines.append("")
+        lines.append("Serving (projection daemon)")
+        lines.append("-" * 27)
+        status = "  ".join(f"{s}={n}" for s, n in
+                           srv.get("by_status", {}).items())
+        lines.append(f"  requests {srv['requests']} "
+                     f"({srv.get('tenants', 0)} tenant(s))  {status}")
+        if srv.get("batches"):
+            lines.append(
+                f"  batches {srv['batches']}  mean lanes "
+                f"{srv.get('mean_lanes')}  max {srv.get('max_lanes')}  "
+                f"cross-request batches "
+                f"{srv.get('multi_request_batches', 0)}"
+                + (f"  cache-hit {srv['cache_hit_fraction']:.0%}"
+                   if srv.get("cache_hit_fraction") is not None else ""))
+        lat = srv.get("latency_ms")
+        if lat and lat.get("count"):
+            lines.append(
+                f"  latency p50 {lat.get('p50', 0):.2f} ms  "
+                f"p95 {lat.get('p95', 0):.2f} ms  "
+                f"p99 {lat.get('p99', 0):.2f} ms  "
+                f"max {lat.get('max', 0):.2f} ms"
+                + (f"  ({srv['qps']} req/s sustained)"
+                   if srv.get("qps") is not None else ""))
+            hist = lat.get("histogram") or {}
+            if hist:
+                total = sum(hist.values())
+                for label, cnt in hist.items():
+                    bar = "#" * max(1, int(round(cnt / total * 32)))
+                    lines.append(f"    {label:>8s} ms {cnt:>7d}  {bar}")
 
     lines.append("")
     lines.append("Device memory")

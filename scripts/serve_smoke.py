@@ -1,0 +1,206 @@
+"""Tier-1 serve smoke gate (scripts/verify_tier1.sh, ISSUE 12).
+
+Builds a consensus-complete mini run, starts the REAL daemon through the
+CLI surface (``cnmf-tpu serve <run_dir> --socket ...`` in a subprocess),
+fires concurrent clients plus one poison tenant at it, and asserts the
+serving tier's contract end-to-end:
+
+  * cross-request batching ENGAGED: telemetry ``serve_batch`` events
+    record multi-request batches under concurrent load;
+  * every successful projection is BIT-identical to solo
+    ``cNMF.refit_usage`` dispatch against the same reference;
+  * the poison request fails alone (clear client error + quarantine
+    accounting) without sinking its batchmates;
+  * every emitted event line is schema-valid;
+  * clean shutdown: daemon exits 0, no orphaned socket or temp files.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    import numpy as np
+    import pandas as pd
+
+    from cnmf_torch_tpu import cNMF
+    from cnmf_torch_tpu.serving import (PoisonError, ServeClient,
+                                        ServeError)
+    from cnmf_torch_tpu.utils import save_df_to_npz
+    from cnmf_torch_tpu.utils.telemetry import (read_events,
+                                                validate_events_file)
+
+    workdir = tempfile.mkdtemp(prefix="serve_smoke_")
+    proc = None
+    try:
+        # -- fixture run (telemetry off: the events file should carry
+        # the DAEMON's stream) --------------------------------------------
+        rng = np.random.default_rng(8)
+        usage = rng.dirichlet(np.ones(4) * 0.3, size=160)
+        spectra = rng.gamma(0.3, 1.0, size=(4, 90)) * 40.0 / 90
+        counts = rng.poisson(usage @ spectra * 260.0).astype(np.float64)
+        counts[counts.sum(axis=1) == 0, 0] = 1.0
+        df = pd.DataFrame(counts, index=[f"c{i}" for i in range(160)],
+                          columns=[f"g{j}" for j in range(90)])
+        counts_fn = os.path.join(workdir, "counts.df.npz")
+        save_df_to_npz(df, counts_fn)
+
+        obj = cNMF(output_dir=workdir, name="smoke")
+        obj.prepare(counts_fn, components=[3], n_iter=6, seed=4,
+                    num_highvar_genes=70)
+        obj.factorize()
+        obj.combine()
+        obj.consensus(k=3, density_threshold=2.0, show_clustering=False)
+        run_dir = os.path.join(workdir, "smoke")
+
+        # -- daemon through the CLI surface --------------------------------
+        sock = os.path.join(workdir, "serve.sock")
+        env = dict(os.environ,
+                   CNMF_TPU_TELEMETRY="1",
+                   CNMF_TPU_SERVE_LINGER_MS="150",
+                   CNMF_TPU_SERVE_WARM_START="0")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "cnmf_torch_tpu", "serve", run_dir,
+             "--socket", sock],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        cli = ServeClient(socket_path=sock, timeout=60.0)
+        deadline = time.time() + 120
+        while True:
+            if proc.poll() is not None:
+                print("serve smoke: daemon exited early:\n"
+                      + (proc.stdout.read() or ""), file=sys.stderr)
+                return 1
+            try:
+                if cli.healthz().get("ok"):
+                    break
+            except Exception:
+                pass
+            if time.time() > deadline:
+                print("serve smoke: daemon never came up", file=sys.stderr)
+                return 1
+            time.sleep(0.25)
+
+        # -- concurrent clients + one poison tenant ------------------------
+        from cnmf_torch_tpu.serving import load_reference
+
+        ref = load_reference(run_dir)
+        queries = {f"tenant{i}": rng.gamma(
+            1.0, 1.0, size=(12 + 9 * i, ref.n_genes)).astype(np.float32)
+            for i in range(4)}
+        poison = queries["tenant0"].copy()
+        poison[1, 1] = np.nan
+        results: dict = {}
+
+        def client(tenant, X):
+            try:
+                results[tenant] = ServeClient(
+                    socket_path=sock, timeout=60.0).project(X, tenant=tenant)
+            except ServeError as exc:
+                results[tenant] = exc
+
+        threads = [threading.Thread(target=client, args=(t, X))
+                   for t, X in queries.items()]
+        threads.append(threading.Thread(
+            target=client, args=("poison_tenant", poison)))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        spectra_df = pd.DataFrame(ref.W, columns=ref.genes)
+        for tenant, X in queries.items():
+            got = results[tenant]
+            if isinstance(got, Exception):
+                print(f"serve smoke: {tenant} failed: {got}",
+                      file=sys.stderr)
+                return 1
+            H, _meta = got
+            solo = np.asarray(obj.refit_usage(X, spectra_df))
+            if not np.array_equal(H, solo):
+                print(f"serve smoke: {tenant} NOT bit-identical to solo "
+                      f"refit_usage (max diff "
+                      f"{np.abs(H - solo).max()})", file=sys.stderr)
+                return 1
+        if not isinstance(results["poison_tenant"], PoisonError):
+            print("serve smoke: poison request did not fail as poison: "
+                  f"{results['poison_tenant']!r}", file=sys.stderr)
+            return 1
+
+        stats = cli.stats()
+        if stats["ok"] != len(queries) or stats["poison"] != 1:
+            print(f"serve smoke: bad outcome counts: {stats}",
+                  file=sys.stderr)
+            return 1
+
+        # -- clean shutdown ------------------------------------------------
+        cli.shutdown()
+        rc = proc.wait(timeout=60)
+        out = proc.stdout.read() or ""
+        proc = None
+        if rc != 0:
+            print(f"serve smoke: daemon exit code {rc}:\n{out}",
+                  file=sys.stderr)
+            return 1
+        if os.path.exists(sock):
+            print("serve smoke: orphaned socket file after shutdown",
+                  file=sys.stderr)
+            return 1
+        orphans = [fn for fn in os.listdir(os.path.join(run_dir,
+                                                        "cnmf_tmp"))
+                   if fn.endswith((".sock", ".tmp"))
+                   or fn.startswith(".tmp")]
+        if orphans:
+            print(f"serve smoke: orphaned temp files: {orphans}",
+                  file=sys.stderr)
+            return 1
+
+        # -- telemetry: schema-valid, batching ENGAGED ---------------------
+        ev_path = os.path.join(run_dir, "cnmf_tmp", "smoke.events.jsonl")
+        n = validate_events_file(ev_path)
+        evs = read_events(ev_path)
+        batches = [e for e in evs if e["t"] == "serve_batch"]
+        reqs = [e for e in evs if e["t"] == "serve_request"]
+        if not batches or not reqs:
+            print(f"serve smoke: missing serve events "
+                  f"({ {e['t'] for e in evs} })", file=sys.stderr)
+            return 1
+        max_batch_requests = max(e["requests"] for e in batches)
+        if max_batch_requests < 2:
+            print(f"serve smoke: cross-request batching never engaged "
+                  f"(max batch {max_batch_requests} request(s) across "
+                  f"{len(batches)} batches)", file=sys.stderr)
+            return 1
+        statuses = {e["status"] for e in reqs}
+        if not {"ok", "poison"} <= statuses:
+            print(f"serve smoke: unexpected statuses {statuses}",
+                  file=sys.stderr)
+            return 1
+
+        print(f"serve smoke: {len(queries)} tenants bit-identical to solo "
+              f"refit_usage, poison isolated+accounted, max batch "
+              f"{max_batch_requests} requests across {len(batches)} "
+              f"dispatches, {n} schema-valid events, clean shutdown "
+              f"(exit 0, no orphans)")
+        return 0
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -121,4 +121,22 @@ if [ "$rc" -eq 0 ]; then
     exit 1
   fi
 fi
+
+# serve smoke: consensus-complete mini run served by the REAL daemon
+# (CLI subprocess on a unix socket) under concurrent clients + one
+# poison tenant — asserts cross-request batching engaged (telemetry
+# batch sizes > 1), every projection bit-identical to solo refit_usage,
+# poison isolated + quarantine-accounted, schema-valid serve events,
+# clean shutdown with no orphaned sockets/temp files
+# (scripts/serve_smoke.py)
+if [ "$rc" -eq 0 ]; then
+  echo "[tier1] serve smoke (projection daemon: batching + bit-parity + poison isolation) ..."
+  if timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      python scripts/serve_smoke.py; then
+    echo SERVE_SMOKE=ok
+  else
+    echo SERVE_SMOKE=fail
+    exit 1
+  fi
+fi
 exit $rc

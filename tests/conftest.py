@@ -50,7 +50,12 @@ from cnmf_torch_tpu.utils.envknobs import env_flag  # noqa: E402
 #     legitimately hand numpy across the dispatch boundary (that IS the
 #     boundary), so the transfer guard would flag their staging, not a
 #     bug; a NaN escaping the jitted solve still fails hard.
-SANITIZE_GUARD_SUBSET = ("test_sanitize.py",)
+SANITIZE_GUARD_SUBSET = (
+    "test_sanitize.py",
+    # the serving tier's batched projection dispatch (ISSUE 12): the
+    # daemon's per-request device work is guard-clean end to end
+    "test_serving.py::test_serve_program_no_implicit_transfers",
+)
 SANITIZE_NANS_SUBSET = (
     "test_nmf.py::test_vmapped_replicates_differ_and_converge",
     "test_nmf.py::test_bundled_batch_solver_matches_vmapped",

@@ -1,0 +1,588 @@
+"""Warm serving tier tests (ISSUE 12): resident reference, cross-request
+micro-batching bit-parity with solo dispatch, poison quarantine, warm
+starts, the HTTP daemon, and the serve telemetry surface."""
+
+import os
+import shutil
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cnmf_torch_tpu.ops.nmf import fit_h
+from cnmf_torch_tpu.serving import (
+    PoisonError,
+    ProjectionService,
+    QuarantinedError,
+    ReferenceError,
+    ResidentReference,
+    ServeClient,
+    ServeDaemon,
+    ShedError,
+    find_references,
+    load_reference,
+)
+from cnmf_torch_tpu.serving.batcher import (
+    batched_project,
+    bucket_for,
+    lane_buckets,
+    lane_count,
+    resolve_buckets,
+)
+from cnmf_torch_tpu.utils.profiling import latency_summary, percentile
+
+K, G = 6, 90
+
+
+def _reference(beta=2.0, chunk_size=5000, seed=0, g=G, k=K, **kw):
+    rng = np.random.default_rng(seed)
+    W = rng.gamma(0.3, 1.0, size=(k, g)).astype(np.float32)
+    return ResidentReference(W, beta=beta, chunk_size=chunk_size,
+                             chunk_max_iter=150, h_tol=0.05, l1_H=0.0,
+                             **kw)
+
+
+def _query(ref, n, seed):
+    rng = np.random.default_rng(seed)
+    u = rng.dirichlet(np.ones(ref.k) * 0.3, size=n)
+    return (u @ ref.W * 40.0
+            + rng.random((n, ref.n_genes)) * 0.01).astype(np.float32)
+
+
+def _solo(ref, X, H_init=None):
+    """The solo comparator: exactly cNMF.refit_usage's fit_h call."""
+    return fit_h(X, ref.W, H_init=H_init, chunk_size=ref.chunk_size,
+                 chunk_max_iter=ref.chunk_max_iter, h_tol=ref.h_tol,
+                 l1_reg_H=ref.l1_H, l2_reg_H=0.0, beta=ref.beta)
+
+
+# ---------------------------------------------------------------------------
+# buckets / percentile units
+# ---------------------------------------------------------------------------
+
+def test_resolve_buckets_schedule_and_validation(monkeypatch):
+    assert resolve_buckets(5000, "64,256,1024") == (64, 256, 1024, 5000)
+    # buckets above the chunk size drop out; the chunk size caps the top
+    assert resolve_buckets(200, "64,256,1024") == (64, 200)
+    monkeypatch.setenv("CNMF_TPU_SERVE_BUCKETS", "32, 128")
+    assert resolve_buckets(5000) == (32, 128, 5000)
+    with pytest.raises(ValueError, match="CNMF_TPU_SERVE_BUCKETS"):
+        resolve_buckets(5000, "64,two")
+    with pytest.raises(ValueError, match=">= 1"):
+        resolve_buckets(5000, "0,64")
+
+
+def test_bucket_and_lane_helpers():
+    buckets = (64, 256, 1024)
+    assert bucket_for(1, buckets) == 64
+    assert bucket_for(64, buckets) == 64
+    assert bucket_for(65, buckets) == 256
+    assert bucket_for(4096, buckets) == 1024  # clamped to top
+    assert lane_buckets(8) == (1, 2, 4, 8)
+    assert lane_buckets(6) == (1, 2, 4, 6)
+    assert lane_buckets(1) == (1,)
+    assert lane_count(100, 5000) == 1
+    assert lane_count(5000, 5000) == 1
+    assert lane_count(5001, 5000) == 2
+    assert lane_count(150, 64) == 3
+
+
+def test_percentile_helper():
+    vals = list(range(1, 101))
+    assert percentile(vals, 50) == pytest.approx(50.5)
+    assert percentile(vals, 99) == pytest.approx(99.01)
+    assert percentile([7.0], 95) == 7.0
+    with pytest.raises(ValueError):
+        percentile([], 50)
+
+
+def test_latency_summary_shape():
+    s = latency_summary([0.5, 1.5, 3.0, 30.0, 700.0])
+    assert s["count"] == 5 and s["max"] == 700.0
+    assert set(s["histogram"]) == {"<=1", "<=2", "<=5", "<=50", "<=1000"}
+    assert sum(s["histogram"].values()) == 5
+    assert latency_summary([]) == {"count": 0}
+
+
+# ---------------------------------------------------------------------------
+# batched dispatch: bit-parity with solo refit_usage dispatch
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("beta", [2.0, 1.0])
+def test_batched_bit_identical_to_solo(beta):
+    ref = _reference(beta=beta)
+    with ProjectionService(ref, max_batch=8, linger_ms=60.0,
+                           warm_start=False) as svc:
+        queries = [_query(ref, n, seed) for n, seed in
+                   ((33, 1), (100, 2), (256, 3))]
+        results = [None] * len(queries)
+
+        def go(i):
+            results[i] = svc.project(queries[i], tenant=f"t{i}")
+
+        threads = [threading.Thread(target=go, args=(i,))
+                   for i in range(len(queries))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for q, (H, meta) in zip(queries, results):
+            assert np.array_equal(H, _solo(ref, q))
+        stats = svc.stats()
+    assert stats["ok"] == 3
+    assert stats["cold_dispatches_after_warmup"] == 0
+
+
+def test_multichunk_request_bit_identical():
+    """A request taller than the chunk size splits into the SOLO chunk
+    partition (one lane per chunk) and still reproduces solo dispatch
+    bit-exactly."""
+    ref = _reference(beta=2.0, chunk_size=64)
+    with ProjectionService(ref, max_batch=8, linger_ms=0.0,
+                           warm_start=False) as svc:
+        X = _query(ref, 150, 7)  # 3 lanes of chunk 64
+        H, meta = svc.project(X)
+        assert meta["batch_lanes"] == 3
+        assert np.array_equal(H, _solo(ref, X))
+
+
+def test_two_racing_clients_land_in_one_batch():
+    """The ISSUE's concurrency pin: two racing clients coalesce into ONE
+    batched dispatch and each gets the bit-exact solo result."""
+    ref = _reference(beta=2.0)
+    with ProjectionService(ref, max_batch=4, linger_ms=120.0,
+                           warm_start=False) as svc:
+        Xa, Xb = _query(ref, 40, 11), _query(ref, 55, 12)
+        out = {}
+
+        def go(name, X):
+            out[name] = svc.project(X, tenant=name)
+
+        ta = threading.Thread(target=go, args=("a", Xa))
+        tb = threading.Thread(target=go, args=("b", Xb))
+        ta.start()
+        tb.start()
+        ta.join()
+        tb.join()
+        assert out["a"][1]["batch_requests"] == 2
+        assert out["b"][1]["batch_requests"] == 2
+        assert np.array_equal(out["a"][0], _solo(ref, Xa))
+        assert np.array_equal(out["b"][0], _solo(ref, Xb))
+        assert svc.stats()["multi_request_batches"] >= 1
+
+
+def test_poison_quarantines_without_sinking_batchmates():
+    ref = _reference(beta=2.0)
+    with ProjectionService(ref, max_batch=4, linger_ms=120.0,
+                           warm_start=False) as svc:
+        good = _query(ref, 30, 21)
+        bad = _query(ref, 20, 22)
+        bad[5, 3] = np.nan
+        out = {}
+
+        def go_good():
+            out["good"] = svc.project(good, tenant="fine")
+
+        def go_bad():
+            try:
+                svc.project(bad, tenant="evil")
+                out["bad"] = "no error"
+            except PoisonError as exc:
+                out["bad"] = exc
+
+        tg, tb = (threading.Thread(target=go_good),
+                  threading.Thread(target=go_bad))
+        tg.start()
+        tb.start()
+        tg.join()
+        tb.join()
+        # the poison lane failed alone; its batchmate is bit-exact
+        assert isinstance(out["bad"], PoisonError)
+        H, meta = out["good"]
+        assert meta["batch_requests"] == 2
+        assert np.array_equal(H, _solo(ref, good))
+
+        # strikes accumulate to quarantine; admission then rejects
+        for _ in range(2):
+            with pytest.raises(PoisonError):
+                svc.project(bad, tenant="evil")
+        with pytest.raises(QuarantinedError):
+            svc.project(good, tenant="evil")
+        # other tenants unaffected
+        H2, _ = svc.project(good, tenant="fine")
+        assert np.array_equal(H2, _solo(ref, good))
+
+
+def test_admission_shed_paths():
+    ref = _reference()
+    svc = ProjectionService(ref, max_batch=1, linger_ms=0.0,
+                            timeout_s=0.05, warm_start=False)
+    # queue-full shed (dispatcher not running; bounded queue fills)
+    svc._running = True
+    for _ in range(svc._q.maxsize):
+        svc._q.put_nowait(object())
+    with pytest.raises(ShedError, match="queue full"):
+        svc.submit(_query(ref, 5, 1))
+    # deadline shed: an aged request is dropped with a clear error
+    while not svc._q.empty():
+        svc._q.get_nowait()
+    req = svc.submit(_query(ref, 5, 2))
+    req.t_enqueue -= 10.0
+    assert svc._expired(req)
+    with pytest.raises(ShedError, match="CNMF_TPU_SERVE_TIMEOUT_S"):
+        req.wait(1.0)
+    svc._running = False
+
+
+def test_admission_validates_shape_and_accounts_rejections():
+    ref = _reference(chunk_size=64)
+    with ProjectionService(ref, max_batch=2, linger_ms=0.0,
+                           warm_start=False) as svc:
+        with pytest.raises(Exception, match="genes"):
+            svc.submit(np.ones((4, ref.n_genes + 1), np.float32))
+        with pytest.raises(Exception, match="matrix"):
+            svc.submit(np.ones((0, ref.n_genes), np.float32))
+        # oversized requests reject at admission (the warmed program
+        # bucket schedule stays the ONLY shapes ever dispatched)
+        with pytest.raises(Exception, match="split the matrix"):
+            svc.submit(np.ones((64 * 2 + 1, ref.n_genes), np.float32))
+        # rejected traffic is visible to the operator, not silent
+        stats = svc.stats()
+        assert stats["error"] == 3
+        assert stats["requests"] == 3
+
+
+# ---------------------------------------------------------------------------
+# warm starts (ISSUE 12 satellite)
+# ---------------------------------------------------------------------------
+
+def test_warm_start_reuses_previous_usage_bit_identically():
+    ref = _reference(beta=1.0)
+    with ProjectionService(ref, max_batch=2, linger_ms=0.0,
+                           warm_start=True) as svc:
+        X = _query(ref, 48, 31)
+        H1, meta1 = svc.project(X, tenant="repeat")
+        assert meta1["warm_start"] is False
+        H2, meta2 = svc.project(X, tenant="repeat")
+        assert meta2["warm_start"] is True
+        # the warm comparator is solo fit_h seeded with the previous H
+        assert np.array_equal(H1, _solo(ref, X))
+        assert np.array_equal(H2, _solo(ref, X, H_init=H1))
+        # a different tenant stays cold
+        H3, meta3 = svc.project(X, tenant="other")
+        assert meta3["warm_start"] is False
+        assert np.array_equal(H3, H1)
+        # a DIFFERENT matrix of the same shape stays cold too: inheriting
+        # another solve's usage would let its exact-zero entries (which
+        # are absorbing under MU) pin genuinely-active components to
+        # zero — warm starts are keyed by matrix content, not shape
+        X_other = _query(ref, 48, 32)
+        H4, meta4 = svc.project(X_other, tenant="repeat")
+        assert meta4["warm_start"] is False
+        assert np.array_equal(H4, _solo(ref, X_other))
+
+
+def _iters_to_fixed_point(ref, X, H_init, target):
+    """Smallest inner-iteration budget whose result equals ``target``
+    bit-exactly — the deterministic 'how many iterations did this solve
+    need' probe (fit_h's inner loop has no iteration output)."""
+    for budget in (1, 2, 4, 8, 16, 32, 64, 128, 150):
+        H = fit_h(X, ref.W, H_init=H_init, chunk_size=ref.chunk_size,
+                  chunk_max_iter=budget, h_tol=ref.h_tol, beta=ref.beta)
+        if np.array_equal(H, target):
+            return budget
+    return 10 ** 9
+
+
+def test_warm_start_converges_in_fraction_of_iterations():
+    """The satellite's convergence pin: a repeat projection from the
+    previous usage needs a small fraction of the cold inner iterations."""
+    ref = _reference(beta=2.0)
+    X = _query(ref, 64, 41)
+    H_cold = _solo(ref, X)
+    cold_iters = _iters_to_fixed_point(ref, X, None, H_cold)
+    H_warm_target = _solo(ref, X, H_init=H_cold)
+    warm_iters = _iters_to_fixed_point(ref, X, H_cold, H_warm_target)
+    assert cold_iters >= 8
+    assert warm_iters * 4 <= cold_iters, (
+        f"warm start took {warm_iters} iters vs cold {cold_iters}")
+
+
+# ---------------------------------------------------------------------------
+# resident reference
+# ---------------------------------------------------------------------------
+
+def test_serve_refuses_legacy_threefry():
+    """The bit-identical-to-solo contract rests on the partitionable
+    threefry's prefix property — a legacy-threefry pin must refuse at
+    daemon start (the fit_h(k_pad) stance), never serve silently
+    divergent projections."""
+    import jax
+
+    ref = _reference()
+    jax.config.update("jax_threefry_partitionable", False)
+    try:
+        with pytest.raises(RuntimeError, match="threefry"):
+            ProjectionService(ref, warm_start=False).start(warmup=False)
+    finally:
+        jax.config.update("jax_threefry_partitionable", True)
+
+
+def test_reference_rejects_nonfinite_and_bad_shapes():
+    W = np.ones((3, 10), np.float32)
+    W[1, 2] = np.inf
+    with pytest.raises(ReferenceError, match="nonfinite"):
+        ResidentReference(W, beta=2.0, chunk_size=100, chunk_max_iter=10)
+    with pytest.raises(ReferenceError, match="matrix"):
+        ResidentReference(np.ones(5, np.float32), beta=2.0,
+                          chunk_size=100, chunk_max_iter=10)
+
+
+def test_reference_resident_products():
+    import jax
+
+    ref = _reference(beta=2.0).stage()
+    assert isinstance(ref.Wd, jax.Array)
+    assert np.array_equal(np.asarray(ref.WWT),
+                          np.asarray(jax.jit(lambda w: w @ w.T)(ref.Wd)))
+    ref_kl = _reference(beta=1.0).stage()
+    assert ref_kl.WWT is None
+    assert np.allclose(np.asarray(ref_kl.w_colsum), ref_kl.W.sum(axis=1))
+    # stage() is idempotent
+    assert ref.stage() is ref
+
+
+# ---------------------------------------------------------------------------
+# run-directory reference resolution + serve events (pipeline fixture)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def serve_run(tmp_path_factory):
+    """A consensus-complete mini run — the daemon's real input."""
+    from cnmf_torch_tpu import cNMF
+    from cnmf_torch_tpu.utils import save_df_to_npz
+
+    tmp = tmp_path_factory.mktemp("serve_run")
+    rng = np.random.default_rng(5)
+    usage = rng.dirichlet(np.ones(4) * 0.3, size=150)
+    spectra = rng.gamma(0.3, 1.0, size=(4, 80)) * 40.0 / 80
+    counts = rng.poisson(usage @ spectra * 250.0).astype(np.float64)
+    counts[counts.sum(axis=1) == 0, 0] = 1.0
+    df = pd.DataFrame(counts, index=[f"c{i}" for i in range(150)],
+                      columns=[f"g{j}" for j in range(80)])
+    counts_fn = os.path.join(tmp, "counts.df.npz")
+    save_df_to_npz(df, counts_fn)
+    obj = cNMF(output_dir=str(tmp), name="srv")
+    obj.prepare(counts_fn, components=[3], n_iter=6, seed=11,
+                num_highvar_genes=60)
+    obj.factorize()
+    obj.combine()
+    obj.consensus(k=3, density_threshold=2.0, show_clustering=False)
+    return obj, os.path.join(str(tmp), "srv")
+
+
+def test_load_reference_from_run_dir(serve_run):
+    obj, run_dir = serve_run
+    refs = find_references(run_dir)
+    assert [r["k"] for r in refs] == [3]
+    ref = load_reference(run_dir)
+    assert ref.k == 3 and ref.n_genes == 60
+    assert ref.genes is not None and len(ref.genes) == 60
+    # explicit (k, dt) selection and clear failures
+    assert load_reference(run_dir, k=3, density_threshold="2.0").k == 3
+    with pytest.raises(ReferenceError, match="no consensus"):
+        load_reference(run_dir, k=9)
+    # ambiguity is loud: a second artifact forces an explicit pick
+    second = refs[0]["path"].replace("dt_2_0", "dt_0_4")
+    shutil.copyfile(refs[0]["path"], second)
+    try:
+        with pytest.raises(ReferenceError, match="multiple"):
+            load_reference(run_dir)
+        assert load_reference(run_dir,
+                              density_threshold="0.4").k == 3
+    finally:
+        os.unlink(second)
+
+
+def test_load_reference_from_shard_store(serve_run):
+    """Atlas-scale reference: spectra in a digest-validated ShardStore."""
+    obj, run_dir = serve_run
+    from cnmf_torch_tpu.utils.shardstore import write_shard_store
+
+    base = load_reference(run_dir)
+    store_dir = os.path.join(run_dir, "cnmf_tmp", "ref.store")
+    write_shard_store(store_dir, base.W,
+                      var_names=[str(g) for g in base.genes])
+    try:
+        ref = load_reference(run_dir, spectra_path=store_dir)
+        assert np.array_equal(ref.W, base.W)
+        assert ref.genes == [str(g) for g in base.genes]
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+
+
+def test_serve_matches_refit_usage_on_run_fixture(serve_run):
+    """End-to-end acceptance pin: the daemon's batched projection is
+    bit-identical to cNMF.refit_usage solo dispatch on the run's own
+    consensus reference."""
+    obj, run_dir = serve_run
+    ref = load_reference(run_dir)
+    rng = np.random.default_rng(17)
+    X = rng.gamma(1.0, 1.0, size=(37, ref.n_genes)).astype(np.float32)
+    with ProjectionService(ref, max_batch=4, linger_ms=0.0,
+                           warm_start=False) as svc:
+        H, _ = svc.project(X)
+    spectra = pd.DataFrame(ref.W, columns=ref.genes)
+    solo = obj.refit_usage(X, spectra)
+    assert np.array_equal(H, np.asarray(solo))
+
+
+def test_serve_events_schema_and_report(serve_run, tmp_path, monkeypatch):
+    obj, run_dir = serve_run
+    from cnmf_torch_tpu.utils.telemetry import (EventLog, read_events,
+                                                render_report,
+                                                summarize_events,
+                                                validate_events_file)
+
+    monkeypatch.setenv("CNMF_TPU_TELEMETRY", "1")
+    ev_dir = tmp_path / "evrun" / "cnmf_tmp"
+    ev_dir.mkdir(parents=True)
+    ev_path = str(ev_dir / "evrun.events.jsonl")
+    events = EventLog(ev_path, manifest_extra={"run_name": "evrun"})
+
+    ref = _reference(beta=2.0)
+    with ProjectionService(ref, max_batch=4, linger_ms=80.0,
+                           warm_start=False, events=events) as svc:
+        Xa, Xb = _query(ref, 16, 61), _query(ref, 24, 62)
+        outs = []
+        ts = [threading.Thread(
+            target=lambda X=X, t=t: outs.append(svc.project(X, tenant=t)))
+            for X, t in ((Xa, "a"), (Xb, "b"))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        bad = Xa.copy()
+        bad[0, 0] = np.nan
+        with pytest.raises(PoisonError):
+            svc.project(bad, tenant="evil")
+
+    assert validate_events_file(ev_path) > 0
+    evs = read_events(ev_path)
+    kinds = {e["t"] for e in evs}
+    assert {"manifest", "serve_request", "serve_batch"} <= kinds
+    batch_sizes = [e["requests"] for e in evs if e["t"] == "serve_batch"]
+    assert max(batch_sizes) > 1  # cross-request batching engaged
+    s = summarize_events(evs)
+    assert s["serving"]["requests"] == 3
+    assert s["serving"]["by_status"] == {"ok": 2, "poison": 1}
+    assert s["serving"]["multi_request_batches"] >= 1
+    assert "p95" in s["serving"]["latency_ms"]
+    report = render_report(str(tmp_path / "evrun"))
+    assert "Serving (projection daemon)" in report
+    assert "latency p50" in report
+
+
+# ---------------------------------------------------------------------------
+# the daemon (HTTP over unix socket / TCP)
+# ---------------------------------------------------------------------------
+
+def test_daemon_unix_socket_end_to_end(tmp_path):
+    ref = _reference(beta=2.0)
+    svc = ProjectionService(ref, max_batch=4, linger_ms=5.0,
+                            warm_start=False)
+    sock = str(tmp_path / "serve.sock")
+    daemon = ServeDaemon(svc, socket_path=sock).start()
+    try:
+        cli = ServeClient(socket_path=sock)
+        hz = cli.healthz()
+        assert hz["ok"] and hz["reference"]["resident"]
+        X = _query(ref, 21, 71)
+        H_b64, meta = cli.project(X)
+        assert np.array_equal(H_b64, _solo(ref, X))
+        H_json, _ = cli.project(X, encoding="data")
+        assert np.array_equal(H_json, H_b64)
+        stats = cli.stats()
+        assert stats["ok"] == 2
+        assert cli.reference()["components"]
+        # protocol errors are clear, not daemon crashes
+        with pytest.raises(Exception, match="genes"):
+            cli.project(np.ones((3, ref.n_genes + 2), np.float32))
+        assert cli.shutdown()
+    finally:
+        daemon.close()
+    assert not os.path.exists(sock)  # no orphaned socket
+
+
+def test_daemon_tcp_loopback():
+    ref = _reference(beta=2.0)
+    svc = ProjectionService(ref, max_batch=2, linger_ms=0.0,
+                            warm_start=False)
+    daemon = ServeDaemon(svc, port=0).start()
+    try:
+        port = daemon.server.server_address[1]
+        cli = ServeClient(port=port)
+        X = _query(ref, 9, 81)
+        H, _ = cli.project(X)
+        assert np.array_equal(H, _solo(ref, X))
+    finally:
+        daemon.close()
+
+
+def test_daemon_replaces_stale_socket(tmp_path):
+    sock = str(tmp_path / "stale.sock")
+    with open(sock, "w") as f:  # cnmf-lint: disable=artifact-nonatomic
+        f.write("")
+    ref = _reference()
+    svc = ProjectionService(ref, linger_ms=0.0, warm_start=False)
+    daemon = ServeDaemon(svc, socket_path=sock)
+    daemon.start()
+    try:
+        assert ServeClient(socket_path=sock).healthz()["ok"]
+    finally:
+        daemon.close()
+
+
+# ---------------------------------------------------------------------------
+# sanitize: the serve hot path performs no implicit host transfers
+# ---------------------------------------------------------------------------
+
+def test_serve_program_no_implicit_transfers():
+    """The batched projection dispatch — the daemon's per-request device
+    work — compiles and executes entirely under
+    ``jax.transfer_guard("disallow")`` with explicitly staged operands
+    (the test_sanitize.py contract applied to the serving tier)."""
+    import jax
+
+    ref = _reference(beta=2.0).stage()
+    X = _query(ref, 32, 91)
+    H0 = np.zeros((2, 64, ref.k), np.float32)
+    Xb = np.zeros((2, 64, ref.n_genes), np.float32)
+    Xb[0, :32] = X
+    Xd = jax.device_put(Xb)
+    Hd = jax.device_put(H0)
+    prog = batched_project()
+    with jax.transfer_guard("disallow"):
+        H, rel = prog(Xd, Hd, ref.Wd, ref.WWT, ref.w_colsum,
+                      ref.h_tol_dev, beta=ref.beta,
+                      max_iter=ref.chunk_max_iter,
+                      l1=ref.l1_H, l2=0.0)
+        out_h, out_rel = jax.device_get((H, rel))
+    assert np.isfinite(out_h).all() and np.isfinite(out_rel).all()
+
+
+def test_cli_serve_argument_validation(tmp_path):
+    from cnmf_torch_tpu.cli import main as cli_main
+
+    with pytest.raises(SystemExit):
+        cli_main(["serve", str(tmp_path / "nope")])  # missing run dir
+    run_dir = tmp_path / "cnmf_tmp"
+    run_dir.mkdir()
+    with pytest.raises(SystemExit):
+        cli_main(["serve", str(tmp_path), "--socket", "/tmp/x.sock",
+                  "--port", "1234"])  # mutually exclusive
+    # an unprepared run dir is a one-line usage error, not a traceback
+    with pytest.raises(SystemExit) as exc:
+        cli_main(["serve", str(tmp_path)])
+    assert exc.value.code == 2
