@@ -69,11 +69,11 @@ _CHIP_PEAKS = {
 }
 
 TIERS = ["north_star", "anchor", "kl", "accel", "sketch", "mfu",
-         "rowshard", "ingest", "serve", "harmony"]
+         "rowshard", "grid2d", "ingest", "serve", "harmony"]
 TIER_TIMEOUT_S = {"north_star": 2400, "anchor": 1200, "kl": 1800,
                   "accel": 1200, "sketch": 1200, "mfu": 900,
-                  "rowshard": 1500, "ingest": 1200, "serve": 1200,
-                  "harmony": 1500}
+                  "rowshard": 1500, "grid2d": 1200, "ingest": 1200,
+                  "serve": 1200, "harmony": 1500}
 
 
 def synthetic_pbmc_like(n=2700, g=2000, k_true=12, seed=0, scale=400.0):
@@ -1106,6 +1106,133 @@ def bench_rowshard():
     }
 
 
+def bench_grid2d():
+    """ISSUE 13 tier: the true 2-D (cells x genes) grid. Measures the
+    per-pass statistics-collective wall and the overlap fraction the
+    double-buffered dispatch hides (pass-with-overlap vs pass-with-
+    barrier vs collectives-only probe — the three programs compute
+    bit-identical results, so the difference is pure scheduling), and
+    1-D rowshard vs 2-D grid weak scaling at 4 and 8 simulated devices
+    (per-device rows held fixed; ideal efficiency 1.0 — on an
+    oversubscribed CPU host the simulated devices timeshare cores, so
+    the absolute numbers are structural, not hardware, signals)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from cnmf_torch_tpu.ops.nmf import random_init
+    from cnmf_torch_tpu.parallel.grid2d import (_grid_pass_jit,
+                                                grid_blocks,
+                                                measure_collectives,
+                                                mesh_grid2d,
+                                                stage_x_grid)
+    from cnmf_torch_tpu.parallel.rowshard import (_rowshard_pass_jit,
+                                                  stream_rows_to_mesh)
+
+    n_dev = len(jax.devices())
+    k, g = 10, 1024
+    rows_per_dev = 2048
+    rng = np.random.default_rng(17)
+    results: dict = {"devices": n_dev,
+                     "rows_per_device": rows_per_dev, "genes": g, "k": k}
+    if n_dev < 8:
+        # a pre-pinned smaller device count would collapse the 4-vs-8
+        # weak-scaling comparison into one point and fabricate an
+        # ideal-looking efficiency — refuse to report that
+        results["error"] = (
+            "grid2d tier needs >= 8 simulated devices; XLA_FLAGS pinned "
+            "%d before the tier could set them" % n_dev)
+        return results
+
+    def fixture(n):
+        return rng.gamma(2.0, 1.0, size=(n, g)).astype(np.float32)
+
+    # --- collective wall + hidden-overlap fraction on the full grid ---
+    n_full = rows_per_dev * n_dev
+    X_full = fixture(n_full)
+    mesh_full = mesh_grid2d()
+    Xd_full, _, _ = stage_x_grid(X_full, mesh_full)
+    for beta, label in ((2.0, "frobenius"), (1.0, "kl")):
+        results[f"collectives_{label}"] = measure_collectives(
+            Xd_full, k, mesh_full, beta=beta)
+    del Xd_full
+
+    # --- 1-D vs 2-D weak scaling at 4 and 8 devices -------------------
+    h_tol = jnp.float32(0.05)
+
+    def pass_wall(kind, use_dev, beta):
+        n = rows_per_dev * use_dev
+        X = fixture(n)
+        key = jax.random.key(3)
+        if kind == "1d":
+            mesh = Mesh(np.asarray(jax.devices()[:use_dev]), ("cells",))
+            Xd, _ = stream_rows_to_mesh(X, mesh, "cells")
+            H0, W0 = random_init(key, n, g, k, float(X.mean()))
+            H0 = jax.device_put(H0, NamedSharding(mesh, P("cells", None)))
+            W0 = jax.device_put(W0, NamedSharding(mesh, P()))
+
+            def run():
+                out = _rowshard_pass_jit(Xd, H0, W0, mesh, "cells", beta,
+                                         h_tol, 30, 0.0, 0.0, 0.0, 0.0)
+                jax.block_until_ready(out[1])
+        else:
+            mesh = mesh_grid2d(devices=jax.devices()[:use_dev])
+            Xd, _, _ = stage_x_grid(X, mesh)
+            H0, W0 = random_init(key, n, g, k, float(X.mean()))
+            caxis, gaxis = mesh.axis_names
+            H0 = jax.device_put(H0, NamedSharding(mesh, P(caxis, None)))
+            W0 = jax.device_put(W0, NamedSharding(mesh, P(None, gaxis)))
+            # block counts from the PADDED per-device extents (the tile
+            # shapes the kernels actually see) — the kernels reject
+            # non-divisors rather than dropping tails
+            n_pad, g_pad = int(Xd.shape[0]), int(Xd.shape[1])
+            c_dim, g_dim = (int(d) for d in mesh.devices.shape)
+            nblk_h = grid_blocks(g_pad // g_dim)
+            nblk_w = grid_blocks(n_pad // c_dim)
+
+            def run():
+                out = _grid_pass_jit(Xd, H0, W0, mesh, beta, h_tol, 30,
+                                     0.0, 0.0, 0.0, 0.0, nblk_h=nblk_h,
+                                     nblk_w=nblk_w, overlap=True)
+                jax.block_until_ready(out[1])
+
+        run()  # compile
+        walls = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            run()
+            walls.append(time.perf_counter() - t0)
+        del Xd
+        return float(np.median(walls))
+
+    for beta, label in ((2.0, "frobenius"), (1.0, "kl")):
+        row: dict = {}
+        for kind in ("1d", "grid2d"):
+            t4 = pass_wall(kind, min(4, n_dev), beta)
+            t8 = pass_wall(kind, n_dev, beta)
+            row[kind] = {
+                "pass_s_4dev": round(t4, 4),
+                "pass_s_%ddev" % n_dev: round(t8, 4),
+                # fixed per-device work: ideal 1.0 (t8 == t4)
+                "weak_scaling_efficiency": round(t4 / t8, 3)
+                if t8 > 0 else None,
+            }
+        results[f"weak_scaling_{label}"] = row
+
+    results["caveat"] = (
+        "simulated CPU devices timeshare %d host core(s); collective "
+        "walls and scaling efficiencies are structural comparisons "
+        "(same host, same fixture), not hardware throughput"
+        % (os.cpu_count() or 1))
+    results["telemetry"] = _tier_telemetry()
+    return results
+
+
 def bench_ingest():
     """ISSUE 10 tier: out-of-core shard-store ingestion. Measures the
     prepare-side store write, the disk->host->device streamed staging
@@ -1454,9 +1581,9 @@ def main():
         enable_persistent_compilation_cache()
         fn = {"north_star": bench_north_star, "anchor": bench_anchor,
               "kl": bench_kl, "accel": bench_accel, "mfu": bench_mfu,
-              "rowshard": bench_rowshard, "ingest": bench_ingest,
-              "harmony": bench_harmony, "serve": bench_serve,
-              "sketch": bench_sketch}[args.tier]
+              "rowshard": bench_rowshard, "grid2d": bench_grid2d,
+              "ingest": bench_ingest, "harmony": bench_harmony,
+              "serve": bench_serve, "sketch": bench_sketch}[args.tier]
         result = fn()
         with open(args.out, "w") as f:
             json.dump(result, f)

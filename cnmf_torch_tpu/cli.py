@@ -130,6 +130,15 @@ def build_parser() -> argparse.ArgumentParser:
                              "(replicates x cells) device mesh — the "
                              "multi-host layout: replicate shards across "
                              "hosts, cells-axis collectives on ICI")
+    parser.add_argument("--mesh-grid2d", dest="mesh_grid2d",
+                        action="store_true", default=False,
+                        help="[factorize] Run replicates over the true 2-D "
+                             "(cells x genes) processor grid with "
+                             "compute-overlapped statistics collectives "
+                             "(MPI-FAUN): X sharded over both axes, W over "
+                             "genes, H over cells; on pods the cells axis "
+                             "spans hosts so only k-sized reductions cross "
+                             "DCN")
     parser.add_argument("--distributed", action="store_true", default=False,
                         help="[factorize] Initialize jax.distributed from "
                              "CNMF_COORDINATOR_ADDRESS / CNMF_NUM_PROCESSES "
@@ -302,6 +311,8 @@ def main(argv=None):
         factorize_flags = []
         if args.mesh_2d:
             factorize_flags.append("--mesh-2d")
+        if args.mesh_grid2d:
+            factorize_flags.append("--mesh-grid2d")
         if args.sequential:
             factorize_flags.append("--sequential")
         if args.rowshard is not None:
@@ -358,6 +369,7 @@ def main(argv=None):
                 skip_completed_runs=args.skip_completed_runs,
                 batched=not args.sequential,
                 mesh="2d" if args.mesh_2d else None,
+                mesh_shape="grid2d" if args.mesh_grid2d else None,
                 rowshard=args.rowshard,
                 rowshard_threshold=args.rowshard_threshold,
                 packed=False if args.per_k_programs else None)

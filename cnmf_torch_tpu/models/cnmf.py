@@ -386,7 +386,8 @@ class cNMF:
             # can fall back to an older prepare's matrix.
             print("prepare: CNMF_TPU_OOC=1 — normalized counts live in "
                   "the shard store only (h5ad copy skipped); consensus "
-                  "and legacy readers assemble from the store.")
+                  "and k-selection stream it slab-wise, resident legacy "
+                  "readers assemble loudly.")
             try:
                 os.unlink(self.paths["normalized_counts"])
             except OSError:
@@ -634,7 +635,8 @@ class cNMF:
     def factorize(self, worker_i=0, total_workers=1,
                   skip_completed_runs=False, batched=True, mesh=None,
                   replicates_per_batch=None, rowshard=None,
-                  rowshard_threshold: int | None = None, packed=None):
+                  rowshard_threshold: int | None = None, packed=None,
+                  mesh_shape=None):
         """Run this worker's share of the replicate ledger.
 
         Contract-compatible with the reference (``cnmf.py:839-892``):
@@ -676,8 +678,52 @@ class cNMF:
         whole-K-group granularity so a resumed sweep is bit-identical to
         an uninterrupted one. (The 2-D multi-host path keeps the plain
         write path: cross-host retry coordination is out of scope.)
+
+        ``mesh_shape`` (ISSUE 13): named execution-layout dispatch —
+        ``'1d'``/``'rowshard'`` forces the 1-D cells mesh, ``'2d'`` the
+        (replicates x cells) mesh, ``'grid2d'`` the true 2-D
+        (cells x genes) processor grid (``parallel/grid2d.py``: X
+        sharded over both axes, W over genes, H over cells, statistics
+        collectives axis-local and compute-overlapped). A ``Mesh`` with
+        axes ``('cells', 'genes')`` passed as ``mesh`` routes to the
+        grid too.
         """
         from ..runtime import faults, resilience
+
+        # named layout dispatch (ISSUE 13): validated up front, before
+        # any ledger/matrix IO — a bad or conflicting layout request
+        # must fail in milliseconds, not after loading artifacts
+        if mesh_shape is not None and mesh_shape not in (
+                "1d", "rowshard", "2d", "grid2d", "grid"):
+            raise ValueError(
+                f"mesh_shape={mesh_shape!r}: expected '1d'/'rowshard', "
+                "'2d' (replicates x cells), or 'grid2d' (cells x genes)")
+        wants_2d_mesh = (mesh == "2d" or (
+            hasattr(mesh, "axis_names")
+            and tuple(mesh.axis_names) == ("replicates", "cells")))
+        if mesh_shape in ("1d", "rowshard"):
+            if wants_2d_mesh:
+                # same loud-conflict invariant as grid-vs-2d below: an
+                # explicit 1-D request must never silently run the
+                # (replicates x cells) path
+                raise ValueError(
+                    "conflicting execution layouts: mesh requests the "
+                    "(replicates x cells) mesh while mesh_shape "
+                    "requests the 1-D cells mesh — pass one of them")
+            rowshard = True
+        elif mesh_shape == "2d" and mesh is None:
+            mesh = "2d"
+        grid = (mesh == "grid2d" or mesh_shape in ("grid2d", "grid")
+                or (hasattr(mesh, "axis_names")
+                    and tuple(mesh.axis_names) == ("cells", "genes")))
+        if grid and wants_2d_mesh:
+            # conflicting layout requests (e.g. --mesh-2d --mesh-grid2d)
+            # must fail loudly, not silently drop one of them
+            raise ValueError(
+                "conflicting execution layouts: mesh requests the "
+                "(replicates x cells) mesh while mesh_shape requests the "
+                "(cells x genes) grid — pass one of them")
+        grid_mesh = mesh if grid and hasattr(mesh, "axis_names") else None
 
         run_params = load_df_from_npz(self.paths["nmf_replicate_parameters"])
         # out-of-core ingestion (ISSUE 10, utils/shardstore.py): when a
@@ -792,7 +838,8 @@ class cNMF:
 
         # 2-D replicates x cells mesh (multi-host layout, parallel/multihost):
         # mesh="2d" auto-builds it; a Mesh with those two axes routes as-is
-        if (mesh == "2d"
+        if not grid and (
+                mesh == "2d"
                 or (hasattr(mesh, "axis_names")
                     and tuple(mesh.axis_names) == ("replicates", "cells"))):
             from ..parallel import mesh_2d
@@ -863,6 +910,21 @@ class cNMF:
                   "artifacts%s; nothing to resume."
                   % (worker_i, " or quarantine records"
                      if quarantined_idx else ""))
+            return
+
+        if grid:
+            # true 2-D (cells x genes) grid (ISSUE 13): the rowshard
+            # execution shell (sequential replicates, checkpoint/
+            # heartbeat/hostloss contracts, resilience guard) over the
+            # grid solver — stage once sharded over BOTH axes, solve
+            # each replicate with axis-local overlapped collectives
+            _credit_completed(jobs)
+            self._factorize_rowsharded(jobs, run_params, norm_counts,
+                                       _nmf_kwargs, grid_mesh, worker_i,
+                                       guard=guard,
+                                       resume=skip_completed_runs,
+                                       heartbeat=heartbeat, store=store,
+                                       grid=True)
             return
 
         if rowshard_threshold is None:
@@ -1035,13 +1097,27 @@ class cNMF:
         else:
             X = norm_counts.X
             if sp.issparse(X):
-                X = X.toarray()
-            # device-resident once, reused by every per-K sweep program (a
-            # jit argument, so the host->HBM transfer happens exactly
-            # once); with a mesh, replicate it across devices here rather
-            # than per sweep call
-            X = jnp.asarray(np.asarray(X, dtype=np.float32))
+                # over-density-threshold sparse fallback: slab-streamed
+                # staging (ISSUE 13 satellite) — CSR slabs densify on
+                # device one block at a time, so peak host bytes stay
+                # slab-sized; the old X.toarray() materialized the full
+                # dense matrix on host before the upload
+                from ..parallel.streaming import (StreamStats,
+                                                  stream_to_device)
+
+                dense_stats = StreamStats()
+                X = stream_to_device(X, stats=dense_stats,
+                                     events=self._events)
+                self._events.emit_stream("factorize_stage_dense",
+                                         dense_stats)
+            else:
+                # device-resident once, reused by every per-K sweep
+                # program (a jit argument, so the host->HBM transfer
+                # happens exactly once)
+                X = jnp.asarray(np.asarray(X, dtype=np.float32))
             if mesh is not None:
+                # replicate across the mesh here rather than per sweep
+                # call (device-to-device; the host link is paid once)
                 from jax.sharding import NamedSharding, PartitionSpec
 
                 X = jax.device_put(X, NamedSharding(mesh, PartitionSpec()))
@@ -1437,7 +1513,8 @@ class cNMF:
 
     def _factorize_rowsharded(self, jobs, run_params, norm_counts,
                               nmf_kwargs, mesh, worker_i, guard=None,
-                              resume=False, heartbeat=None, store=None):
+                              resume=False, heartbeat=None, store=None,
+                              grid=False):
         """Atlas-scale factorize: cells sharded over the mesh, replicates
         sequential. X streams host→HBM once (shard-sized CSR blocks, no host
         dense copy) and is reused by every replicate; padded rows contribute
@@ -1451,10 +1528,21 @@ class cNMF:
         scratch; ``=0`` keeps the fused pre-checkpoint programs,
         byte-identical. Shard staging failures flow into the resilience
         ledger (``ReplicateGuard.record_shard_fault``) before the run
-        aborts cleanly."""
+        aborts cleanly.
+
+        ``grid=True`` (ISSUE 13): the same execution shell over the true
+        2-D (cells x genes) grid (``parallel/grid2d.py``) — X stages
+        once sharded over BOTH axes, each replicate solves with
+        axis-local compute-overlapped collectives, and every contract
+        here (checkpoint resume, heartbeat liveness, hostloss re-mesh,
+        resilience guard, telemetry) carries over unchanged."""
         from ..parallel import default_mesh
+        from ..parallel.grid2d import (mesh_grid2d, nmf_fit_grid2d,
+                                       stage_x_grid)
         from ..parallel.rowshard import nmf_fit_rowsharded, prepare_rowsharded
 
+        if mesh is None and grid:
+            mesh = mesh_grid2d()
         if mesh is None:
             mesh = default_mesh(axis_name="cells")
         if mesh is None:  # single device: a trivial 1-element mesh
@@ -1507,7 +1595,18 @@ class cNMF:
             ``nmf_fit_rowsharded`` runs as a slab-looped pass per solve."""
             stage_stats = StreamStats() if self._events.enabled else None
             try:
-                if store is not None:
+                if grid:
+                    # grid staging: full-width row stripes split into
+                    # per-device column tiles (store-backed inputs read
+                    # only the slabs overlapping addressable stripes);
+                    # no slab-loop tier — the grid's point is that the
+                    # per-device TILE shrinks with BOTH axes
+                    Xd_, _rp, _cp = stage_x_grid(
+                        store if store is not None else norm_counts.X,
+                        mesh_, stats=stage_stats, events=self._events,
+                        liveness=heartbeat)
+                    n_orig_ = int(norm_counts.X.shape[0])
+                elif store is not None:
                     from ..parallel.rowshard import store_dispatch
 
                     # force_dense: this path stages dense like its
@@ -1559,9 +1658,17 @@ class cNMF:
         _, n_passes_eff, _ = resolve_online_schedule(
             beta_loss_to_float(nmf_kwargs["beta_loss"]), 0.05,
             nmf_kwargs.get("n_passes"))
-        print("[Worker %d]. Row-sharded factorize: %d cells over %d devices, "
-              "%d tasks." % (worker_i, n_orig,
-                             int(np.prod(mesh.devices.shape)), len(jobs)))
+        if grid:
+            _gc, _gg = mesh.devices.shape
+            print("[Worker %d]. 2-D grid factorize: %d cells x %d genes "
+                  "over a %d x %d (cells x genes) grid, %d tasks."
+                  % (worker_i, n_orig, int(norm_counts.X.shape[1]),
+                     int(_gc), int(_gg), len(jobs)))
+        else:
+            print("[Worker %d]. Row-sharded factorize: %d cells over %d "
+                  "devices, %d tasks." % (worker_i, n_orig,
+                                          int(np.prod(mesh.devices.shape)),
+                                          len(jobs)))
         # solver recipe for the sharded pass program (ISSUE 9): only the
         # dna lane applies here (the pass loop IS the amu repeat schedule
         # natively); resolved once, recorded in dispatch + provenance,
@@ -1581,10 +1688,22 @@ class cNMF:
                   default=None))
         self._events.emit("dispatch", decision="solver_recipe",
                           context=recipe.as_context())
+        from ..parallel.grid2d import grid_blocks as _grid_blocks
+        from ..parallel.grid2d import grid_overlap_enabled as _grid_ovl
+
+        grid_ctx = {}
+        if grid:
+            _gc, _gg = (int(d) for d in mesh.devices.shape)
+            grid_ctx = {"mesh_shape": [_gc, _gg],
+                        "overlap": bool(_grid_ovl()),
+                        "blocks": [
+                            _grid_blocks(int(Xd.shape[1]) // _gg),
+                            _grid_blocks(int(Xd.shape[0]) // _gc)]}
         # the row-sharded block-coordinate solver ignores the ledger's
         # mode/batch_max_iter/online_chunk_size; record what actually runs
         self._save_factorize_provenance(
-            "rowshard", worker_i,
+            "grid2d" if grid else "rowshard", worker_i,
+            dict(grid_ctx) |
             {"beta_loss": nmf_kwargs["beta_loss"],
              "init": nmf_kwargs.get("init", "random"),
              "tol": nmf_kwargs.get("tol", 1e-4),
@@ -1600,6 +1719,46 @@ class cNMF:
                                 Xd, (jax.Array, _EllMatrix))
                              else "store_resident")),
              "ledger_keys_ignored": ["mode", "online_chunk_size"]})
+
+        if grid and self._events.enabled and jobs:
+            # measured collective probe (ISSUE 13): time one pass with
+            # the double-buffered overlap vs the serializing barrier vs
+            # a collectives-only program, and put the hidden-collective
+            # fraction on the record next to the per-solve collective
+            # events. Observability only — never takes factorize down.
+            from ..parallel.grid2d import measure_collectives
+            try:
+                k_probe = int(run_params.iloc[jobs[0]]["n_components"])
+                # observability-grade settings: 3 interleaved repeats
+                # (the bench tier owns the high-repeat measurement), and
+                # the PRODUCTION chunk_max_iter so the overlap=True pass
+                # program is the very executable the checkpointed loop
+                # dispatches on unregularized runs (the default) — only
+                # the serial variant and the tiny psum-probe are then
+                # extra compiles
+                probe = measure_collectives(
+                    topo["Xd"], k_probe, mesh, beta=rs_beta,
+                    chunk_max_iter=int(nmf_kwargs.get(
+                        "online_chunk_max_iter", _DEFAULT_CHUNK_MAX_ITER)),
+                    repeats=3)
+                self._events.emit(
+                    "collective",
+                    context=dict(grid_ctx, stage="grid2d_probe",
+                                 k=k_probe, beta=float(rs_beta),
+                                 pass_overlap_s=probe["pass_overlap_s"],
+                                 pass_serial_s=probe["pass_serial_s"],
+                                 coll_chained_s=probe["coll_chained_s"],
+                                 coll_free_s=probe["coll_free_s"],
+                                 pass_hidden_fraction=probe[
+                                     "pass_hidden_fraction"]),
+                    wall_s=probe["coll_chained_s"],
+                    nbytes=probe["nbytes_per_pass"],
+                    overlap_fraction=probe["overlap_fraction"])
+            except Exception as exc:
+                warnings.warn("grid2d collective probe failed (%s); "
+                              "continuing without the overlap "
+                              "measurement" % (exc,),
+                              RuntimeWarning, stacklevel=2)
 
         # mid-run checkpoint policy: cadence from the env (0 disables —
         # the solver then compiles the exact pre-checkpoint fused
@@ -1649,8 +1808,13 @@ class cNMF:
             tier = ("slab_loop"
                     if not isinstance(topo["Xd"], (jax.Array, _EllMatrix))
                     else "resident")
-            return repr(sorted(dict(params_base,
-                                    ingest_tier=tier).items()))
+            # the engaged LAYOUT is identity too: the grid splits the
+            # statistics contractions over the gene axis — resuming a
+            # 1-D rowshard cursor under --mesh-grid2d (or vice versa)
+            # would splice two solvers' trajectories
+            return repr(sorted(dict(params_base, ingest_tier=tier,
+                                    layout=("grid2d" if grid
+                                            else "rowshard")).items()))
 
         def _make_ckpt(k_c, it_c, seed_c, attempt=0, force_resume=False):
             """Checkpoint policy for one (k, iter) solve. Retry attempts
@@ -1690,8 +1854,7 @@ class cNMF:
                         else True))
 
         def _solve_rowshard(k_r, seed_r, ckpt=None):
-            _H, spectra, err = nmf_fit_rowsharded(
-                topo["Xd"], int(k_r), topo["mesh"],
+            common = dict(
                 beta_loss=nmf_kwargs["beta_loss"],
                 init=nmf_kwargs.get("init", "random"),
                 seed=int(seed_r),
@@ -1705,9 +1868,17 @@ class cNMF:
                 n_orig=n_orig,
                 telemetry_sink=self._emit_replicates_event,
                 checkpoint=ckpt, heartbeat=heartbeat, recipe=recipe,
-                events=self._events,
-                store_slab_loop=not isinstance(
-                    topo["Xd"], (jax.Array, _EllMatrix)))
+                events=self._events)
+            if grid:
+                _H, spectra, err = nmf_fit_grid2d(
+                    topo["Xd"], int(k_r), topo["mesh"],
+                    g_orig=int(norm_counts.X.shape[1]), **common)
+            else:
+                _H, spectra, err = nmf_fit_rowsharded(
+                    topo["Xd"], int(k_r), topo["mesh"],
+                    store_slab_loop=not isinstance(
+                        topo["Xd"], (jax.Array, _EllMatrix)),
+                    **common)
             return np.asarray(spectra), err
 
         def _remesh_after_loss(exc):
@@ -2370,13 +2541,171 @@ class cNMF:
     # ------------------------------------------------------------------
 
     @_timed("consensus")
+    def _consensus_stream_store(self):
+        """The shard store consensus/k-selection should STREAM from, or
+        ``None``. Streaming engages only when the store is authoritative
+        (a ``CNMF_TPU_OOC=1`` prepare skipped the h5ad copy): with the
+        h5ad present the resident path reads it bit-identically without
+        a slab loop, and with neither present ``_read_norm_counts``
+        raises its usual diagnosis."""
+        if os.path.exists(self.paths["normalized_counts"]):
+            return None
+        return self._probe_store()
+
+    def _stream_blocks(self, store, chunk_size, stats=None,
+                       f64_extra=False, peak_base=0):
+        """Yield ``(lo, hi, dense f32 block)`` row blocks of the store,
+        boundaries pinned to ``chunk_size`` multiples (the bit-identity
+        contract of ``ops.nmf.fit_h_slabbed``) and block bytes sized so
+        the consumer's live set stays under the
+        ``CNMF_TPU_OOC_BUDGET_BYTES`` slab budget (floor: one chunk —
+        the refit's irreducible unit). ``f64_extra`` (the K-selection
+        error pass): the consumer additionally holds a float64 copy of
+        the block (2x), so blocks shrink by that factor AND the copy is
+        charged into the residency high-water mark — the budget the OOC
+        smoke asserts against covers the TRUE live set, not just the
+        f32 block. ``stats`` collects per-block walls/bytes and that
+        peak."""
+        import time as _time
+
+        from ..utils.shardstore import host_matrix_bytes, ooc_budget_bytes
+
+        n, g = store.shape
+        chunk_size = int(min(int(chunk_size), max(n, 1)))
+        chunk_bytes = max(chunk_size * g * 4, 1)
+        # live set per block, sized against the block's DENSE bytes D:
+        # the raw slab read (CSR triplets run ~2D at single-cell
+        # densities) + the f32 block (a copy on the CSR path) + the
+        # consumer's f64 copy (2D) when charged — so D <= budget/3
+        # plain, budget/6 with the f64 copy, keeping the true live set
+        # under the budget with slack for vstack transients
+        divisor = 6 if f64_extra else 3
+        chunks_per = max(1, (ooc_budget_bytes() // divisor) // chunk_bytes)
+        rows_per = chunks_per * chunk_size
+        if stats is not None and peak_base > stats.host_peak_bytes:
+            # the caller's pass-lifetime working set (usage-sized init
+            # draws / accumulators) rides every block's live set
+            stats.host_peak_bytes = int(peak_base)
+        t_start = _time.perf_counter()
+        for lo in range(0, n, rows_per):
+            hi = min(lo + rows_per, n)
+            t0 = _time.perf_counter()
+            blk = store.row_block(lo, hi, events=self._events)
+            raw = host_matrix_bytes(blk)
+            if sp.issparse(blk):
+                dense = blk.toarray().astype(np.float32, copy=False)
+            else:
+                dense = np.asarray(blk, np.float32)
+            if stats is not None:
+                stats.add(disk_s=_time.perf_counter() - t0,
+                          disk_nbytes=raw, slabs=1, nbytes=dense.nbytes)
+                peak = (int(peak_base) + raw
+                        + dense.nbytes * (3 if f64_extra else 1))
+                if peak > stats.host_peak_bytes:
+                    stats.host_peak_bytes = peak
+            del blk
+            yield lo, hi, dense
+        if stats is not None:
+            stats.wall_s += _time.perf_counter() - t_start
+
+    def _refit_usage_streamed(self, store, spectra, collect=None,
+                              context="consensus_stream"):
+        """Fixed-spectra usage refit streamed from the shard store —
+        ``refit_usage``'s budget-bounded twin (ISSUE 13): identical
+        solver parameters, chunk partition, and default init, so the
+        result is BIT-identical to the resident ``fit_h`` dispatch on
+        the assembled matrix while host residency stays one block."""
+        from ..ops.nmf import fit_h_slabbed
+        from ..parallel.streaming import StreamStats
+
+        kwargs = self._solver_params()
+        beta = beta_loss_to_float(kwargs["beta_loss"])
+        stats = StreamStats()
+        chunk = int(kwargs["online_chunk_size"])
+        # usage-sized pass-lifetime buffers (the H0 draw + the output
+        # usages fit_h_slabbed fills) ride every block's live set
+        usage_bytes = 2 * store.n_rows * int(np.asarray(spectra).shape[0]) * 4
+        H = fit_h_slabbed(
+            self._stream_blocks(store, chunk, stats=stats,
+                                peak_base=usage_bytes),
+            store.n_rows, np.asarray(spectra),
+            chunk_size=chunk,
+            chunk_max_iter=int(kwargs["online_chunk_max_iter"]),
+            h_tol=0.05, l1_reg_H=float(kwargs["l1_ratio_H"]),
+            l2_reg_H=0.0, beta=beta, collect=collect)
+        self._events.emit_stream(context, stats)
+        return H
+
+    def _streamed_prediction_errors(self, store, spectra_by_k):
+        """The K-selection error curve from ONE shared slab pass over
+        the store (ISSUE 13): ``_frobenius_prediction_error`` needs only
+        ``HᵀX``, ``HᵀH`` and ``‖X‖²``, so each block is read once and
+        serves EVERY K — per-K usages solve block-wise (the same chunked
+        program the resident refit runs) and fold straight into the
+        f64 statistics before the buffer drops. Returns
+        ``{k: prediction_error}``; no stage assembles cells x genes.
+
+        Working set: the per-K init draws and statistics are
+        USAGE-sized — O(n x Σk) host bytes, the same order as the
+        rf_usages artifact consensus must materialize anyway, charged
+        into the residency peak below; the budget bounds the
+        cells x genes (genes-sized) buffers."""
+        from ..ops.nmf import _fit_h_block, fit_h_default_init
+        from ..parallel.streaming import StreamStats
+
+        kwargs = self._solver_params()
+        beta = beta_loss_to_float(kwargs["beta_loss"])
+        n, g = store.shape
+        chunk = int(min(int(kwargs["online_chunk_size"]), max(n, 1)))
+        cmi = int(kwargs["online_chunk_max_iter"])
+        l1 = float(kwargs["l1_ratio_H"])
+        W32 = {kk: np.asarray(W, np.float32)
+               for kk, W in spectra_by_k.items()}
+        H0 = {kk: np.asarray(fit_h_default_init(n, W.shape[0]))
+              for kk, W in W32.items()}
+        HtX = {kk: np.zeros((W.shape[0], g), np.float64)
+               for kk, W in W32.items()}
+        HtH = {kk: np.zeros((W.shape[0], W.shape[0]), np.float64)
+               for kk, W in W32.items()}
+        x_sq = 0.0
+        stats = StreamStats()
+        # the usage-sized per-K working set (H0 draws + f64 statistics)
+        # is live for the whole pass — charged on top of every block's
+        # genes-sized live set
+        usage_bytes = sum(H0[kk].nbytes + HtX[kk].nbytes + HtH[kk].nbytes
+                          for kk in H0)
+        for lo, hi, Xb in self._stream_blocks(store, chunk, stats=stats,
+                                              f64_extra=True,
+                                              peak_base=usage_bytes):
+            # ONE f64 copy of the block serves every K's HtX (numpy
+            # would make the same upcast copy inside each mixed-dtype
+            # matmul otherwise); it is charged to the residency peak and
+            # the block sizing by _stream_blocks(f64_extra=True).
+            # np.vdot accumulates the square sum without another temp.
+            Xb64 = Xb.astype(np.float64)
+            x_sq += float(np.vdot(Xb64, Xb64))
+            for kk, W in W32.items():
+                Hb = _fit_h_block(Xb, H0[kk][lo:hi], W, beta, chunk,
+                                  cmi, 0.05, l1, 0.0).astype(np.float64)
+                HtX[kk] += Hb.T @ Xb64
+                HtH[kk] += Hb.T @ Hb
+        self._events.emit_stream("kselection_stream", stats)
+        out = {}
+        for kk, W in spectra_by_k.items():
+            W64 = np.asarray(W, np.float64)
+            cross = float(np.sum(HtX[kk] * W64))
+            hw_sq = float(np.sum((HtH[kk] @ W64) * W64))
+            out[kk] = max(x_sq - 2.0 * cross + hw_sq, 0.0)
+        return out
+
     def consensus(self, k, density_threshold=0.5,
                   local_neighborhood_size=0.30, show_clustering=True,
                   build_ref=True, skip_density_and_return_after_stats=False,
                   close_clustergram_fig=False, refit_usage=True,
                   normalize_tpm_spectra=False, norm_counts=None,
                   ols_batch_size=65536, _packed_dims=None,
-                  _sketch_override=None):
+                  _sketch_override=None, _stream_store=None,
+                  _stream_error_collector=None):
         """Consensus spectra/usages from the merged replicate matrix
         (``cnmf.py:997-1256``): L2-normalize, KNN local-density outlier
         filter (cached), k-means(k, 10 inits, fixed key), cluster medians,
@@ -2395,12 +2724,20 @@ class cNMF:
                 and merged_spectra.shape[0] <= _packed_dims[0]
                 and int(k) <= _packed_dims[1]):
             _packed_dims = None  # partial-run ledger over-estimate: fall back
+        store = _stream_store
         if norm_counts is None:
-            # under a store-authoritative prepare (CNMF_TPU_OOC=1) the
-            # h5ad is absent: assemble from the store — bit-identical
-            # (slabs are row slices of the same buffers), and consensus
-            # operates on the resident matrix like always
-            norm_counts = self._read_norm_counts()
+            if store is None:
+                store = self._consensus_stream_store()
+            if store is not None:
+                # streaming consensus (ISSUE 13): under a store-
+                # authoritative prepare (CNMF_TPU_OOC=1, h5ad skipped)
+                # the usage refit and the error curve run as budget-
+                # bounded slab loops over the store — no stage assembles
+                # cells x genes on host. The AnnData view carries
+                # metadata only (obs/var names, shape).
+                norm_counts = self._store_anndata(store)
+            else:
+                norm_counts = self._read_norm_counts()
 
         density_threshold_str = str(density_threshold)
         if skip_density_and_return_after_stats:
@@ -2409,9 +2746,12 @@ class cNMF:
         n_neighbors = int(local_neighborhood_size
                           * merged_spectra.shape[0] / k)
 
-        if env_flag("CNMF_WARM_CONSENSUS", True) and _packed_dims is None:
+        if (env_flag("CNMF_WARM_CONSENSUS", True) and _packed_dims is None
+                and store is None):
             # packed stats runs warm their (shared) program set in
-            # k_selection_plot instead of a per-K set here
+            # k_selection_plot instead of a per-K set here; streaming
+            # runs skip the warm outright — its dummy buffers are
+            # dataset-sized, exactly what the slab budget forbids
             with self._timer.stage("consensus.warm"):
                 self._warm_consensus_programs(
                     merged_spectra.shape[0], int(k), norm_counts.X.shape[0],
@@ -2542,12 +2882,27 @@ class cNMF:
         median_spectra = (median_spectra.T / median_spectra.sum(axis=1)).T
 
         with self._timer.stage("consensus.refit_usage"):
-            X_resident = self._stage_dense("norm_counts", norm_counts.X)
-            rf_usages = self.refit_usage(
-                X_resident, median_spectra,
-                k_pad=None if _packed_dims is None else _packed_dims[1])
-        rf_usages = pd.DataFrame(rf_usages, index=norm_counts.obs.index,
-                                 columns=median_spectra.index)
+            if store is not None:
+                if skip_density_and_return_after_stats:
+                    # stats mode: the usages are consumed ONLY by the
+                    # prediction error, which the shared slab pass below
+                    # computes fused with its own block solves — solving
+                    # them here too would double the store reads
+                    rf_usages = None
+                else:
+                    rf_usages = self._refit_usage_streamed(
+                        store, median_spectra.values)
+            else:
+                X_resident = self._stage_dense("norm_counts",
+                                               norm_counts.X)
+                rf_usages = self.refit_usage(
+                    X_resident, median_spectra,
+                    k_pad=None if _packed_dims is None
+                    else _packed_dims[1])
+        if rf_usages is not None:
+            rf_usages = pd.DataFrame(rf_usages,
+                                     index=norm_counts.obs.index,
+                                     columns=median_spectra.index)
 
         if skip_density_and_return_after_stats:
             if _packed_dims is not None:
@@ -2558,12 +2913,25 @@ class cNMF:
                 # same feature space the clustering ran in (the sketched
                 # stats path is where the quadratic cost lives)
                 silhouette = silhouette_score(cluster_feats, labels0, k)
-            tok = self._content_token(norm_counts.X)
-            if tok not in self._x_sq_cache:
-                self._x_sq_cache[tok] = _x_squared_sum(norm_counts.X)
-            prediction_error = _frobenius_prediction_error(
-                norm_counts.X, rf_usages.values, median_spectra.values,
-                x_sq=self._x_sq_cache[tok])
+            if store is not None:
+                if _stream_error_collector is not None:
+                    # deferred to k_selection_plot's ONE shared slab
+                    # pass over the store (every K's HᵀX/HᵀH/‖X‖²
+                    # accumulate from the same block reads); the caller
+                    # fills this K's cell afterwards
+                    _stream_error_collector[int(k)] = \
+                        median_spectra.values
+                    prediction_error = float("nan")
+                else:
+                    prediction_error = self._streamed_prediction_errors(
+                        store, {int(k): median_spectra.values})[int(k)]
+            else:
+                tok = self._content_token(norm_counts.X)
+                if tok not in self._x_sq_cache:
+                    self._x_sq_cache[tok] = _x_squared_sum(norm_counts.X)
+                prediction_error = _frobenius_prediction_error(
+                    norm_counts.X, rf_usages.values,
+                    median_spectra.values, x_sq=self._x_sq_cache[tok])
             consensus_stats = pd.DataFrame(
                 [k, density_threshold, silhouette, prediction_error],
                 index=["k", "local_density_threshold", "silhouette",
@@ -2733,7 +3101,13 @@ class cNMF:
         import concurrent.futures
 
         run_params = load_df_from_npz(self.paths["nmf_replicate_parameters"])
-        norm_counts = self._read_norm_counts()
+        # streaming K-selection (ISSUE 13): under a store-authoritative
+        # prepare the error curve needs only HᵀX / HᵀH / ‖X‖², so ONE
+        # budget-bounded slab pass over the store serves every K — the
+        # full matrix is never assembled on host
+        store = self._consensus_stream_store()
+        norm_counts = (self._store_anndata(store) if store is not None
+                       else self._read_norm_counts())
         ks_sorted = sorted(set(run_params.n_components))
         if not ks_sorted:
             raise ValueError(
@@ -2761,19 +3135,24 @@ class cNMF:
                 R_max=int(packed_dims[0]), K_max=int(packed_dims[1]),
                 packed=True))
 
-        # the pool threads below must only ever HIT these caches: neither
-        # _stage_dense nor the x_sq fingerprint pass is safe/cheap under
-        # simultaneous misses (up to 4 concurrent dataset-sized uploads /
-        # float64 passes), so both populate serially here
-        self._stage_dense("norm_counts", norm_counts.X)
-        tok = self._content_token(norm_counts.X)
-        if tok not in self._x_sq_cache:
-            self._x_sq_cache[tok] = _x_squared_sum(norm_counts.X)
+        if store is None:
+            # the pool threads below must only ever HIT these caches:
+            # neither _stage_dense nor the x_sq fingerprint pass is
+            # safe/cheap under simultaneous misses (up to 4 concurrent
+            # dataset-sized uploads / float64 passes), so both populate
+            # serially here. Streaming runs skip both — their X work is
+            # the one shared slab pass after the clustering stages.
+            self._stage_dense("norm_counts", norm_counts.X)
+            tok = self._content_token(norm_counts.X)
+            if tok not in self._x_sq_cache:
+                self._x_sq_cache[tok] = _x_squared_sum(norm_counts.X)
 
-        if env_flag("CNMF_WARM_CONSENSUS", True):
+        if env_flag("CNMF_WARM_CONSENSUS", True) and store is None:
             # warm the packed program set concurrently up front: each
             # executable's first dispatch pays a ~2 s program-upload round
             # trip on a tunneled chip regardless of compile caching
+            # (streaming runs skip it — the refit-warm dummies are
+            # dataset-sized, exactly what the slab budget forbids)
             self._warm_kselection_packed(
                 packed_dims[0], packed_dims[1], norm_counts.X.shape[0],
                 norm_counts.X.shape[1])
@@ -2785,16 +3164,28 @@ class cNMF:
         # running them in a thread pool overlaps the RTTs of one K with
         # the host pandas work of another (measured: 9-K cold 29.5 s ->
         # 14.7-19.9 s, warm 18.1 s -> 5.9-10 s)
+        # streaming mode: each K's stats pass defers its prediction
+        # error into this collector (clustering/silhouette are spectra-
+        # only), then ONE slab pass over the store fills every cell
+        error_collector: dict = {} if store is not None else None
+
         def stats_for(k):
             return self.consensus(
                 int(k), skip_density_and_return_after_stats=True,
                 show_clustering=False, close_clustergram_fig=True,
                 norm_counts=norm_counts, _packed_dims=packed_dims,
-                _sketch_override=sk_sweep).stats
+                _sketch_override=sk_sweep, _stream_store=store,
+                _stream_error_collector=error_collector).stats
 
         with concurrent.futures.ThreadPoolExecutor(
                 min(4, len(ks_sorted))) as ex:
             stats = list(ex.map(stats_for, [int(k) for k in ks_sorted]))
+        if error_collector:
+            with self._timer.stage("k_selection.stream_errors"):
+                errs = self._streamed_prediction_errors(store,
+                                                        error_collector)
+            for s in stats:
+                s["prediction_error"] = errs[int(s["k"])]
         # a per-K fallback (ledger over-estimate) routes through
         # _warm_consensus_programs, whose shared dummy buffers are
         # dataset-sized device arrays — release them

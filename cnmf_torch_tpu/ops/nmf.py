@@ -1783,6 +1783,73 @@ def fit_h(X, W, H_init=None, chunk_size: int = 5000, chunk_max_iter: int = 200,
     return np.asarray(H)
 
 
+def _fit_h_block(Xb, Hb0, W, beta, chunk_size, chunk_max_iter, h_tol,
+                 l1_H, l2_H):
+    """One slab's rows through the chunked fixed-W solver — exactly the
+    unit :func:`fit_h` runs over the whole matrix (same ``_chunk_rows``
+    zero-padding, same ``_fit_h_chunked`` program body), so slab-looped
+    callers reproduce the resident refit chunk-for-chunk. Returns the
+    slab's usage rows as numpy ``(rows, k)``."""
+    rows = int(Xb.shape[0])
+    k = int(Hb0.shape[1])
+    Xc, Hc, pad = _chunk_rows(jnp.asarray(np.asarray(Xb), jnp.float32),
+                              jnp.asarray(np.asarray(Hb0), jnp.float32),
+                              int(chunk_size))
+    Hc = _fit_h_chunked(Xc, Hc, jnp.asarray(np.asarray(W), jnp.float32),
+                        float(beta), int(chunk_max_iter), float(h_tol),
+                        float(l1_H), float(l2_H))
+    H = np.asarray(Hc.reshape(-1, k))
+    return H[:rows]
+
+
+def fit_h_slabbed(blocks, n: int, W, *, chunk_size: int = 5000,
+                  chunk_max_iter: int = 200, h_tol: float = 0.05,
+                  l1_reg_H: float = 0.0, l2_reg_H: float = 0.0,
+                  beta: float = 2.0, key=None,
+                  collect=None) -> np.ndarray:
+    """Slab-looped fixed-W usage refit — :func:`fit_h` re-expressed as a
+    budget-bounded loop over row blocks (the streaming-consensus entry,
+    ISSUE 13): host residency is one block, never the cells x genes
+    matrix.
+
+    BIT-identical to ``fit_h`` on the assembled matrix when every block
+    boundary is a multiple of the (clamped) chunk size: the default
+    init draws the same ``(n, k)`` threefry stream (row-major counters —
+    rows ``lo:hi`` are position-determined, so slicing the one full
+    draw reproduces the resident rows exactly; the draw is k-sized host
+    bytes, not genes-sized), and chunks are solved INDEPENDENTLY by
+    ``_fit_h_chunked``, so only the chunk partition — which this loop
+    preserves — determines the result. Enforced: a misaligned block
+    boundary raises rather than silently changing chunk composition.
+
+    ``blocks``: iterable of ``(lo, hi, X_block)`` with ``X_block`` a
+    dense ``(hi-lo, genes)`` array. ``collect(lo, hi, X_block, H_block)``
+    runs per block before the buffers drop — a fused-statistics hook
+    for single-spectra callers (accumulate HᵀX / HᵀH / ‖X‖² in the same
+    pass that solves the usages). The MULTI-K K-selection error pass
+    shares :func:`_fit_h_block` directly instead (one block read must
+    serve every K, which a single-W loop cannot express)."""
+    W = np.asarray(W, dtype=np.float32)
+    k = int(W.shape[0])
+    chunk_size = int(min(int(chunk_size), max(int(n), 1)))
+    H0 = np.asarray(fit_h_default_init(int(n), k, key))
+    out = np.zeros((int(n), k), np.float32)
+    for lo, hi, Xb in blocks:
+        lo, hi = int(lo), int(hi)
+        if lo % chunk_size and lo < n:
+            raise ValueError(
+                f"block boundary {lo} is not a multiple of the chunk "
+                f"size {chunk_size} — slab-looped fit_h is only "
+                "bit-identical to the resident refit when the chunk "
+                "partition is preserved")
+        Hb = _fit_h_block(Xb, H0[lo:hi], W, beta, chunk_size,
+                          chunk_max_iter, h_tol, l1_reg_H, l2_reg_H)
+        out[lo:hi] = Hb
+        if collect is not None:
+            collect(lo, hi, Xb, Hb)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # initialization
 # ---------------------------------------------------------------------------

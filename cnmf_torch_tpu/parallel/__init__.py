@@ -15,6 +15,12 @@ from .replicates import (
     warm_sweep_programs,
     worker_filter,
 )
+from .grid2d import (
+    mesh_grid2d,
+    nmf_fit_grid2d,
+    stage_x_grid,
+    measure_collectives,
+)
 from .rowshard import fit_h_rowsharded, nmf_fit_rowsharded, pad_rows_to_mesh
 from .streaming import (
     ShardStallError,
@@ -46,4 +52,8 @@ __all__ = [
     "fit_h_rowsharded",
     "nmf_fit_rowsharded",
     "pad_rows_to_mesh",
+    "mesh_grid2d",
+    "nmf_fit_grid2d",
+    "stage_x_grid",
+    "measure_collectives",
 ]

@@ -1,0 +1,1125 @@
+"""True 2-D (cells x genes) processor grid with compute-overlapped
+collectives — the MPI-FAUN layout (arXiv 1609.09154).
+
+The package's earlier "2-D mesh" (:mod:`.multihost`) is replicates x
+cells: every device still holds full gene rows of W, so the mesh scales
+the sweep and the cells axis but not the GENE axis — a wide atlas (many
+genes, or k x g too big for one chip's replication) has nowhere to go.
+This module shards BOTH data axes:
+
+  * ``X`` lives as (cells, genes) blocks — each device holds an
+    (n/c_dim, g/g_dim) tile, staged by :func:`stage_x_grid` straight
+    from a host matrix or a :class:`~cnmf_torch_tpu.utils.shardstore.
+    ShardStore` (row-stripe reads, no full-matrix host copy).
+  * ``H`` (cells x k) shards over the cells axis, replicated along
+    genes; ``W`` (k x genes) shards over the genes axis, replicated
+    along cells — MPI-FAUN's factor distribution.
+  * Every update statistic is an AXIS-LOCAL reduction: the H-side
+    numerators (``X Wᵀ``-shaped, O(rows x k)) psum over the GENES axis
+    only, the W-side sufficient statistics (``Hᵀ X`` (k x g_loc),
+    ``Hᵀ H`` (k x k)) psum over the CELLS axis only. No collective ever
+    spans the full grid except the scalar objective.
+
+DCN-aware axis assignment (:func:`mesh_grid2d`): on a multi-host pod
+the CELLS axis is laid across hosts and the GENES axis stays within a
+host — the large per-pass H-side reductions (O(rows x k), and per inner
+iteration for KL/IS) ride ICI, while only the small k x g_loc / k x k
+W-side statistics cross DCN. Single-host grids factor most-square with
+cells taking the larger factor.
+
+Compute-overlapped collectives (the MPI-FAUN overlap): the statistics
+contractions are split into ``CNMF_TPU_GRID_BLOCKS`` sub-blocks and the
+psum for block *i* is dispatched while block *i+1*'s local gemm
+computes (:func:`_overlapped_psum` — a double-buffered, Python-unrolled
+loop the XLA latency-hiding scheduler can interleave).
+``CNMF_TPU_GRID_OVERLAP=0`` chains an ``optimization_barrier`` between
+each reduce and the next gemm instead — SAME partial-sum order, so the
+two modes are bit-identical in results and differ only in scheduling
+freedom; :func:`measure_collectives` times the two against a
+collectives-only probe to report the hidden-collective fraction
+(``bench.py --tier grid2d``, telemetry ``collective`` events).
+
+Solver semantics match :func:`~cnmf_torch_tpu.parallel.rowshard.
+nmf_fit_rowsharded` (block-coordinate passes, tightly solved usage
+blocks, statistics-based W subproblem, same f32 convergence
+arithmetic); the plain-MU lanes for beta in {2, 1, 0} and the
+Diagonalized-Newton KL recipe (``kl_newton``) are implemented on the
+grid. Parity with the 1-D path is to collective-reduction rounding
+(the gene axis splits contractions the 1-D path runs whole).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.sparse as sp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils.jax_compat import shard_map
+from ..utils.shardstore import ShardStore, SlabCursor
+
+from ..ops.nmf import (
+    EPS,
+    TRACE_LEN,
+    _apply_rate,
+    _beta_div_dense,
+    beta_loss_to_float,
+    mu_gamma,
+    random_init,
+    resolve_online_schedule,
+    split_regularization,
+)
+
+__all__ = [
+    "mesh_grid2d",
+    "stage_x_grid",
+    "nmf_fit_grid2d",
+    "measure_collectives",
+    "grid_overlap_enabled",
+    "grid_blocks",
+]
+
+GRID_OVERLAP_ENV = "CNMF_TPU_GRID_OVERLAP"
+GRID_BLOCKS_ENV = "CNMF_TPU_GRID_BLOCKS"
+GRID_SHAPE_ENV = "CNMF_TPU_GRID_SHAPE"
+
+
+def grid_overlap_enabled() -> bool:
+    """``CNMF_TPU_GRID_OVERLAP``: dispatch each statistics block's
+    collective while the next block's gemm computes (default on).
+    ``0`` serializes reduce -> gemm with an optimization barrier —
+    bit-identical results, no overlap (the bench baseline)."""
+    from ..utils.envknobs import env_flag
+
+    return env_flag(GRID_OVERLAP_ENV, True)
+
+
+def grid_blocks(extent: int) -> int:
+    """Statistics sub-blocks for the overlap loop, clamped to a divisor
+    of ``extent`` (the local rows/cols being blocked). ``0`` (default)
+    derives: 4 blocks when the extent affords them, fewer otherwise."""
+    from ..utils.envknobs import env_int
+
+    want = env_int(GRID_BLOCKS_ENV, 0, lo=0)
+    if want <= 0:
+        want = 4 if extent >= 64 else 1
+    want = max(1, min(int(want), max(int(extent), 1)))
+    while want > 1 and extent % want:
+        want -= 1
+    return want
+
+
+def _grid_rc(n_dev: int, n_proc: int) -> tuple[int, int]:
+    """Factor the device count into (cell_shards, gene_shards).
+
+    ``CNMF_TPU_GRID_SHAPE=CxG`` pins it. Multi-host: the CELLS axis
+    spans hosts (gene_shards = devices per host), so the O(rows x k)
+    H-side statistics reduce stays on ICI and only the k x g_loc /
+    k x k W-side reductions cross DCN. Single host: most-square, cells
+    taking the larger factor (cell counts exceed gene counts in every
+    BASELINE config)."""
+    from ..utils.envknobs import env_str
+
+    raw = env_str(GRID_SHAPE_ENV, "auto").strip().lower()
+    if raw and raw != "auto":
+        try:
+            c_s, g_s = raw.split("x")
+            c, g = int(c_s), int(g_s)
+        except ValueError:
+            raise ValueError(
+                f"{GRID_SHAPE_ENV}={raw!r}: expected 'CxG' (e.g. '4x2') "
+                "or 'auto'") from None
+        if c < 1 or g < 1 or c * g != n_dev:
+            raise ValueError(
+                f"{GRID_SHAPE_ENV}={raw!r}: {c}x{g} != {n_dev} devices")
+        return c, g
+    if n_proc > 1 and n_dev % n_proc == 0:
+        return n_proc, n_dev // n_proc
+    g = 1
+    for cand in range(int(math.isqrt(n_dev)), 0, -1):
+        if n_dev % cand == 0:
+            g = cand
+            break
+    return n_dev // g, g
+
+
+def mesh_grid2d(cell_shards: int | None = None,
+                gene_shards: int | None = None, devices=None) -> Mesh:
+    """The (cells, genes) grid mesh over all global devices.
+
+    ``jax.devices()`` lists process 0's chips first, so reshaping to
+    (cell_shards, gene_shards) with one cell shard per host puts each
+    host's chips in one grid ROW — the gene axis (and its per-pass
+    O(rows x k) reductions) never leaves the host."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n_dev = len(devices)
+    if cell_shards is None and gene_shards is None:
+        c, g = _grid_rc(n_dev, jax.process_count())
+    else:
+        if cell_shards is not None:
+            c = int(cell_shards)
+            g = n_dev // c if gene_shards is None else int(gene_shards)
+        else:
+            g = int(gene_shards)
+            c = n_dev // g
+        if c < 1 or g < 1 or c * g != n_dev:
+            raise ValueError(
+                f"grid {c}x{g} does not tile {n_dev} devices")
+    return Mesh(np.asarray(devices).reshape(c, g), ("cells", "genes"))
+
+
+# ---------------------------------------------------------------------------
+# staging
+# ---------------------------------------------------------------------------
+
+def stage_x_grid(X, mesh: Mesh, dtype=jnp.float32, stats=None, events=None,
+                 liveness=None):
+    """Stage a host matrix (dense / CSR / :class:`ShardStore` /
+    :class:`SlabCursor`) as (cells, genes) grid blocks.
+
+    Rows stream one full-width ROW STRIPE at a time (the 1-D staging
+    unit — host residency is one stripe, never the matrix), each stripe
+    split into its per-device column tiles on host and uploaded through
+    the pipelined streaming engine; store-backed inputs read only the
+    slabs overlapping each addressable stripe. Returns
+    ``(Xd (n_pad, g_pad) P('cells','genes'), row_pad, col_pad)`` —
+    padding is exact zeros (benign: padded rows collapse their usage
+    rows, padded gene columns are masked to exact zero in W at init and
+    stay absorbing under every MU/Newton rate).
+    """
+    from ..runtime.faults import maybe_fail
+
+    from .streaming import run_pipeline, stream_depth, stream_threads
+
+    maybe_fail("upload", context="stage_x_grid")
+    caxis, gaxis = mesh.axis_names
+    c_dim, g_dim = (dict(mesh.shape)[caxis], dict(mesh.shape)[gaxis])
+
+    if isinstance(X, SlabCursor):
+        X = X.store
+    if isinstance(X, ShardStore):
+        n, g = X.shape
+        store = X
+
+        def read_rows(lo, hi):
+            return store.row_block(lo, hi, events=events)
+    elif sp.issparse(X):
+        Xc = X.tocsr()
+        n, g = Xc.shape
+
+        def read_rows(lo, hi):
+            return Xc[lo:hi]
+    else:
+        Xn = np.asarray(X)
+        n, g = Xn.shape
+
+        def read_rows(lo, hi):
+            return Xn[lo:hi]
+
+    n_pad = -(-max(n, 1) // c_dim) * c_dim
+    g_pad = -(-max(g, 1) // g_dim) * g_dim
+    rows_per = n_pad // c_dim
+    cols_per = g_pad // g_dim
+    sharding = NamedSharding(mesh, P(caxis, gaxis))
+    idx_map = sharding.addressable_devices_indices_map((n_pad, g_pad))
+    # group addressable devices by row stripe: one disk/host read serves
+    # every column tile of the stripe
+    stripes: dict = {}
+    for dev, idx in idx_map.items():
+        r0 = idx[0].start or 0
+        c0 = idx[1].start or 0
+        stripes.setdefault(r0, []).append((dev, c0))
+
+    blocks: dict = {}
+    stripe_bytes = rows_per * g_pad * 4
+
+    def prep(r0):
+        t0 = time.perf_counter()
+        hi = min(r0 + rows_per, n)
+        block = read_rows(r0, hi) if hi > r0 else None
+        dense = np.zeros((rows_per, g_pad), np.float32)
+        if block is not None:
+            if sp.issparse(block):
+                dense[:block.shape[0], :g] = block.toarray()
+            else:
+                dense[:block.shape[0], :g] = np.asarray(block,
+                                                        np.float32)
+        t1 = time.perf_counter()
+        parts = {}
+        for dev, c0 in stripes[r0]:
+            tile = np.ascontiguousarray(dense[:, c0:c0 + cols_per])
+            parts[dev] = jax.device_put(tile, dev)
+        jax.block_until_ready(list(parts.values()))
+        t2 = time.perf_counter()
+        if stats is not None:
+            stats.add(host_prep_s=t1 - t0, h2d_s=t2 - t1, slabs=1,
+                      nbytes=stripe_bytes)
+        return parts
+
+    def commit(_r0, parts):
+        blocks.update(parts)
+
+    threads = stream_threads()
+    depth = stream_depth(slab_bytes=stripe_bytes, threads=threads)
+    t_wall = time.perf_counter()
+    run_pipeline(sorted(stripes), prep, commit, depth=depth,
+                 threads=threads, fault_context="stage_x_grid",
+                 events=events, liveness=liveness)
+    if stats is not None:
+        stats.wall_s += time.perf_counter() - t_wall
+    devs = list(idx_map)
+    Xd = jax.make_array_from_single_device_arrays(
+        (n_pad, g_pad), sharding, [blocks[d] for d in devs])
+    return Xd, n_pad - n, g_pad - g
+
+
+# ---------------------------------------------------------------------------
+# overlapped axis-local reductions
+# ---------------------------------------------------------------------------
+
+def _tree_add(a, b):
+    if a is None:
+        return b
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+def _overlapped_psum(fn, nblk: int, axis: str, overlap: bool):
+    """``Σ_b psum(fn(b, dep), axis)`` with block *b*'s collective
+    dispatched while block *b+1*'s local contraction computes — the
+    MPI-FAUN compute/communication overlap as a double-buffered,
+    Python-unrolled loop (``nblk`` is static and small).
+
+    ``fn(b, dep)`` returns a pytree of block-*b* partials and must fold
+    the scalar ``dep`` into one of its operands (``x + dep`` — exact
+    identity at ``dep == 0.0`` for the nonnegative factor state).
+    ``overlap=False`` passes a zero DERIVED from block *b-1*'s reduced
+    value instead of the literal ``0.0``: a true data dependence, so
+    the scheduler cannot start gemm *b* before collective *b-1*
+    completes — the serial baseline. Both modes accumulate the same
+    partials in the same order, so their results are BIT-identical;
+    only the scheduling freedom differs."""
+    if nblk <= 1:
+        return jax.tree_util.tree_map(
+            lambda x: jax.lax.psum(x, axis), fn(0, jnp.float32(0.0)))
+    acc = None
+    prev = fn(0, jnp.float32(0.0))
+    for b in range(1, nblk):
+        red = jax.tree_util.tree_map(
+            lambda x: jax.lax.psum(x, axis), prev)
+        acc = _tree_add(acc, red)
+        if overlap:
+            dep = jnp.float32(0.0)
+        else:
+            first = jax.tree_util.tree_leaves(red)[0]
+            dep = (first.ravel()[0] * jnp.float32(0.0)).astype(jnp.float32)
+        prev = fn(b, dep)
+    return _tree_add(acc, jax.tree_util.tree_map(
+        lambda x: jax.lax.psum(x, axis), prev))
+
+
+# ---------------------------------------------------------------------------
+# grid-local update steps (run inside shard_map)
+# ---------------------------------------------------------------------------
+
+def _h_solve_grid(X_blk, h, W_blk, gaxis, beta, l1, l2, max_iter, h_tol,
+                  kl_newton: bool, nblk: int, overlap: bool):
+    """Tightly solve this cell stripe's usage block with W fixed — the
+    grid twin of ``ops.nmf._chunk_h_solve``. W is gene-sharded, so the
+    numerator-type statistics assemble from axis-local psums over the
+    GENES axis (blocked + overlapped); the iteration itself (rates,
+    rel-change stop) is local and bit-identical across the gene axis
+    (every participant sees the same psum'd operands)."""
+    g_loc = int(W_blk.shape[1])
+    if nblk < 1 or g_loc % nblk:
+        # a non-divisor block count would silently DROP the tail columns
+        # from every psum'd statistic — fail at trace time instead
+        # (grid_blocks() clamps to divisors; this guards direct callers)
+        raise ValueError(
+            f"nblk={nblk} does not divide the local gene extent {g_loc}")
+    cb = g_loc // nblk
+
+    def col(mat, b):
+        return jax.lax.slice_in_dim(mat, b * cb, (b + 1) * cb, axis=1)
+
+    if beta == 2.0:
+        # loop-invariant statistics, one overlapped reduction each
+        def stats(b, dep):
+            Wb = col(W_blk, b) + dep
+            return col(X_blk, b) @ Wb.T, Wb @ Wb.T
+
+        numer0, WWT = _overlapped_psum(stats, nblk, gaxis, overlap)
+        numer0 = jnp.maximum(numer0 - l1, 0.0) if l1 else numer0
+
+        def step(h):
+            denom = h @ WWT
+            denom = denom + l2 * h if l2 else denom
+            rate = jnp.where(denom < EPS, 0.0,
+                             numer0 / jnp.maximum(denom, EPS))
+            return h * rate
+    elif kl_newton and beta == 1.0:
+        # Diagonalized-Newton KL H step with the per-row monotone MU
+        # fallback lane (ops/nmf.py:_dna_h_step) on grid statistics:
+        # numerator/Hessian and the exact per-row candidate objectives
+        # all psum over the genes axis
+        s = jax.lax.psum(W_blk.sum(axis=1), gaxis)
+        denom = jnp.broadcast_to(s[None, :], h.shape)
+
+        def step(h):
+            def stats(b, dep):
+                Wb = col(W_blk, b) + dep
+                WHb = jnp.maximum(h @ Wb, EPS)
+                ratio = col(X_blk, b) / WHb
+                return ratio @ Wb.T, (ratio / WHb) @ (Wb * Wb).T
+
+            numer, hess = _overlapped_psum(stats, nblk, gaxis, overlap)
+            H_mu = _apply_rate(h, numer, denom, l1, l2)
+            grad = s[None, :] - numer + l1 + l2 * h
+            H_nt = jnp.maximum(h - grad / jnp.maximum(hess + l2, EPS),
+                               0.0)
+
+            def objs(b, dep):
+                Wb = col(W_blk, b) + dep
+                Xb = col(X_blk, b)
+                d_nt = -jnp.sum(
+                    Xb * jnp.log(jnp.maximum(H_nt @ Wb, EPS)), axis=-1)
+                d_mu = -jnp.sum(
+                    Xb * jnp.log(jnp.maximum(H_mu @ Wb, EPS)), axis=-1)
+                return d_nt, d_mu
+
+            d_nt, d_mu = _overlapped_psum(objs, nblk, gaxis, overlap)
+            o_nt = H_nt @ s + d_nt
+            o_mu = H_mu @ s + d_mu
+            if l1:
+                o_nt = o_nt + l1 * jnp.sum(H_nt, axis=-1)
+                o_mu = o_mu + l1 * jnp.sum(H_mu, axis=-1)
+            if l2:
+                o_nt = o_nt + 0.5 * l2 * jnp.sum(H_nt * H_nt, axis=-1)
+                o_mu = o_mu + 0.5 * l2 * jnp.sum(H_mu * H_mu, axis=-1)
+            return jnp.where((o_nt < o_mu)[:, None], H_nt, H_mu)
+    else:  # plain MU, beta in {1, 0}
+        if beta == 1.0:
+            denom = jnp.broadcast_to(
+                jax.lax.psum(W_blk.sum(axis=1), gaxis)[None, :], h.shape)
+
+        def step(h):
+            if beta == 1.0:
+                def stats(b, dep):
+                    Wb = col(W_blk, b) + dep
+                    WHb = jnp.maximum(h @ Wb, EPS)
+                    return (col(X_blk, b) / WHb) @ Wb.T
+
+                numer = _overlapped_psum(stats, nblk, gaxis, overlap)
+                return _apply_rate(h, numer, denom, l1, l2)
+
+            def stats(b, dep):  # beta == 0.0 (itakura-saito)
+                Wb = col(W_blk, b) + dep
+                WHb = jnp.maximum(h @ Wb, EPS)
+                return ((col(X_blk, b) / (WHb * WHb)) @ Wb.T,
+                        (1.0 / WHb) @ Wb.T)
+
+            numer, den = _overlapped_psum(stats, nblk, gaxis, overlap)
+            return _apply_rate(h, numer, den, l1, l2,
+                               gamma=mu_gamma(beta))
+
+    def body(carry):
+        h, _, it = carry
+        h_new = step(h)
+        rel = jnp.linalg.norm(h_new - h) / (jnp.linalg.norm(h) + EPS)
+        return (h_new, rel, it + 1)
+
+    def cond(carry):
+        _, rel, it = carry
+        return (it < max_iter) & (rel >= h_tol)
+
+    rel0 = jnp.inf + 0.0 * jnp.sum(h)
+    h, _, _ = jax.lax.while_loop(cond, body, (h, rel0, jnp.int32(0)))
+    return h
+
+
+def _w_update_grid(X_blk, h, W_blk, caxis, gaxis, beta, l1_W, l2_W,
+                   max_iter, tol, nblk: int, overlap: bool):
+    """The global W update from cells-axis-local statistics. beta=2
+    solves the convex subproblem from the psum'd sufficient statistics
+    ``A = Hᵀ X`` / ``B = Hᵀ H`` (returned for the checkpoint layer);
+    beta in {1, 0} takes the exact MU step. The k x g_loc / k x k
+    reductions here are the ONLY collectives that cross the cells axis
+    (DCN on a pod) — O(k·(g+k)) bytes per pass, independent of cells."""
+    rows = int(X_blk.shape[0])
+    if nblk < 1 or rows % nblk:
+        # same tail-dropping hazard as _h_solve_grid's column blocks
+        raise ValueError(
+            f"nblk={nblk} does not divide the local row extent {rows}")
+    rb = rows // nblk
+
+    def row(mat, b):
+        return jax.lax.slice_in_dim(mat, b * rb, (b + 1) * rb, axis=0)
+
+    A = B = None
+    if beta == 2.0:
+        def stats(b, dep):
+            hb = row(h, b) + dep
+            return hb.T @ row(X_blk, b), hb.T @ hb
+
+        A, B = _overlapped_psum(stats, nblk, caxis, overlap)
+
+        # the convex W subproblem from the statistics alone, with the
+        # rel-change stop evaluated on the GLOBAL W (norms psum over the
+        # gene axis) so every shard runs the same trip count and the
+        # stopping rule matches ops.nmf._solve_w_from_stats
+        def w_body(carry):
+            W, _, it = carry
+            W_new = _apply_rate(W, A, B @ W, l1_W, l2_W)
+            d2 = jax.lax.psum(jnp.sum((W_new - W) ** 2), gaxis)
+            n2 = jax.lax.psum(jnp.sum(W * W), gaxis)
+            rel = jnp.sqrt(d2) / (jnp.sqrt(n2) + EPS)
+            return (W_new, rel, it + 1)
+
+        def w_cond(carry):
+            _, rel, it = carry
+            return (it < max_iter) & (rel >= tol)
+
+        rel0 = jnp.inf + 0.0 * jnp.sum(W_blk)
+        W_blk, _, _ = jax.lax.while_loop(
+            w_cond, w_body, (W_blk, rel0, jnp.int32(0)))
+        return W_blk, A, B
+    if beta == 1.0:
+        def stats(b, dep):
+            hb = row(h, b) + dep
+            WHb = jnp.maximum(hb @ W_blk, EPS)
+            return hb.T @ (row(X_blk, b) / WHb)
+
+        numer = _overlapped_psum(stats, nblk, caxis, overlap)
+        denom = jnp.broadcast_to(
+            jax.lax.psum(h.sum(axis=0), caxis)[:, None], W_blk.shape)
+        return _apply_rate(W_blk, numer, denom, l1_W, l2_W), A, B
+
+    def stats(b, dep):  # beta == 0.0 (itakura-saito)
+        hb = row(h, b) + dep
+        WHb = jnp.maximum(hb @ W_blk, EPS)
+        return (hb.T @ (row(X_blk, b) / (WHb * WHb)),
+                hb.T @ (1.0 / WHb))
+
+    numer, denom = _overlapped_psum(stats, nblk, caxis, overlap)
+    return _apply_rate(W_blk, numer, denom, l1_W, l2_W,
+                       gamma=mu_gamma(beta)), A, B
+
+
+def _grid_pass(X_blk, H, W_blk, caxis, gaxis, beta, h_tol, chunk_max_iter,
+               l1_H, l2_H, l1_W, l2_W, kl_newton: bool, nblk_h: int,
+               nblk_w: int, overlap: bool):
+    """One block-coordinate pass on this grid tile: tight usage solve
+    (genes-axis statistics), global W update (cells-axis statistics),
+    objective of the updated pair (both axes). Returns
+    ``(H, W_blk, err, A, B)`` — A/B are the beta=2 pass statistics for
+    the checkpoint layer, None otherwise."""
+    H = _h_solve_grid(X_blk, H, W_blk, gaxis, beta, l1_H, l2_H,
+                      chunk_max_iter, h_tol, kl_newton, nblk_h, overlap)
+    W_blk, A, B = _w_update_grid(X_blk, H, W_blk, caxis, gaxis, beta,
+                                 l1_W, l2_W, chunk_max_iter, h_tol,
+                                 nblk_w, overlap)
+    err = jax.lax.psum(
+        jax.lax.psum(_beta_div_dense(X_blk, H @ W_blk, beta), gaxis),
+        caxis)
+    return H, W_blk, err, A, B
+
+
+def _grid_solve_local(X_blk, H, W_blk, caxis, gaxis, beta, tol, h_tol,
+                      n_passes, chunk_max_iter, l1_H, l2_H, l1_W, l2_W,
+                      telemetry: bool, kl_newton: bool, nblk_h: int,
+                      nblk_w: int, overlap: bool):
+    """Fused pass loop (runs inside shard_map) — same f32 convergence
+    arithmetic and stopping rule as ``rowshard._rowsharded_solve_local``."""
+    def one(H, W_blk, it):
+        return _grid_pass(X_blk, H, W_blk, caxis, gaxis, beta, h_tol,
+                          chunk_max_iter, l1_H, l2_H, l1_W, l2_W,
+                          kl_newton, nblk_h, nblk_w, overlap)
+
+    def body(carry):
+        if telemetry:
+            H, W_blk, err_prev, err, it, trace, nonfin = carry
+        else:
+            H, W_blk, err_prev, err, it = carry
+        H, W_blk, err_new, _, _ = one(H, W_blk, it)
+        if telemetry:
+            trace = trace.at[jnp.minimum(it, TRACE_LEN - 1)].set(err_new)
+            nonfin = nonfin | ~jnp.isfinite(err_new)
+            return (H, W_blk, err, err_new, it + 1, trace, nonfin)
+        return (H, W_blk, err, err_new, it + 1)
+
+    def cond(carry):
+        err_prev, err, it = carry[2], carry[3], carry[4]
+        rel = (err_prev - err) / jnp.maximum(err_prev, EPS)
+        return (it < n_passes) & ((it < 2) | (rel >= tol))
+
+    H, W_blk, err0, _, _ = one(H, W_blk, jnp.int32(0))
+    init = (H, W_blk, err0 * (1.0 + 2.0 * tol) + 1.0, err0, jnp.int32(1))
+    if telemetry:
+        init = init + (jnp.full((TRACE_LEN,), jnp.nan,
+                                jnp.float32).at[0].set(err0),
+                       ~jnp.isfinite(err0))
+    out = jax.lax.while_loop(cond, body, init)
+    if telemetry:
+        H, W_blk, _, err, it, trace, nonfin = out
+        return H, W_blk, err, trace, it, nonfin | ~jnp.isfinite(err)
+    H, W_blk, _, err, _ = out
+    return H, W_blk, err
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "beta", "n_passes", "chunk_max_iter",
+                     "l1_H", "l2_H", "l1_W", "l2_W", "telemetry",
+                     "kl_newton", "nblk_h", "nblk_w", "overlap"),
+)
+def _fit_grid2d_jit(X, H0, W0, mesh, beta, tol, h_tol, n_passes,
+                    chunk_max_iter, l1_H, l2_H, l1_W, l2_W,
+                    telemetry: bool = False, kl_newton: bool = False,
+                    nblk_h: int = 1, nblk_w: int = 1,
+                    overlap: bool = True):
+    caxis, gaxis = mesh.axis_names
+    out_specs = ((P(caxis, None), P(None, gaxis), P())
+                 if not telemetry
+                 else (P(caxis, None), P(None, gaxis), P(), P(), P(),
+                       P()))
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(caxis, gaxis), P(caxis, None), P(None, gaxis)),
+        out_specs=out_specs,
+    )
+    def run(X_blk, H, W_blk):
+        out = _grid_solve_local(
+            X_blk, H, W_blk, caxis, gaxis, beta, tol, h_tol, n_passes,
+            chunk_max_iter, l1_H, l2_H, l1_W, l2_W, telemetry,
+            kl_newton, nblk_h, nblk_w, overlap)
+        if telemetry:
+            H, W_blk, err, trace, passes, nonfin = out
+            return (H, W_blk, err[None], trace, passes[None],
+                    nonfin[None])
+        H, W_blk, err = out
+        return H, W_blk, err[None]
+
+    out = run(X, H0, W0)
+    if telemetry:
+        H, W, err, trace, passes, nonfin = out
+        return H, W, err[0], trace, passes[0], nonfin[0]
+    H, W, err = out
+    return H, W, err[0]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "beta", "chunk_max_iter", "l1_H", "l2_H",
+                     "l1_W", "l2_W", "kl_newton", "nblk_h", "nblk_w",
+                     "overlap"),
+)
+def _grid_pass_jit(X, H, W, mesh, beta, h_tol, chunk_max_iter,
+                   l1_H, l2_H, l1_W, l2_W, kl_newton: bool = False,
+                   nblk_h: int = 1, nblk_w: int = 1,
+                   overlap: bool = True):
+    """ONE grid pass as its own dispatch — the unit of the checkpointed
+    host-driven loop. The per-tile program is exactly the fused loop's
+    pass body. Returns ``(H, W, err, A, B)`` (A/B None for beta != 2)."""
+    caxis, gaxis = mesh.axis_names
+    with_stats = beta == 2.0
+    out_specs = ((P(caxis, None), P(None, gaxis), P(), P(None, gaxis),
+                  P()) if with_stats
+                 else (P(caxis, None), P(None, gaxis), P()))
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(caxis, gaxis), P(caxis, None), P(None, gaxis)),
+        out_specs=out_specs,
+    )
+    def run(X_blk, H_loc, W_blk):
+        H_loc, W_blk, err, A, B = _grid_pass(
+            X_blk, H_loc, W_blk, caxis, gaxis, beta, h_tol,
+            chunk_max_iter, l1_H, l2_H, l1_W, l2_W, kl_newton, nblk_h,
+            nblk_w, overlap)
+        if with_stats:
+            return H_loc, W_blk, err[None], A, B
+        return H_loc, W_blk, err[None]
+
+    out = run(X, H, W)
+    if with_stats:
+        H, W, err, A, B = out
+        return H, W, err[0], A, B
+    H, W, err = out
+    return H, W, err[0], None, None
+
+
+def _fit_grid2d_checkpointed(Xd, H0, W0, mesh, beta, tol, h_tol, n_passes,
+                             chunk_max_iter, l1_H, l2_H, l1_W, l2_W, ckpt,
+                             heartbeat=None, n_orig=None, g_orig=None,
+                             kl_newton: bool = False, nblk_h: int = 1,
+                             nblk_w: int = 1, overlap: bool = True):
+    """Host-driven grid pass loop with mid-run checkpoints — the grid
+    twin of ``rowshard._fit_rowsharded_checkpointed`` (same f32
+    convergence arithmetic, same PassCheckpointer contract: W and the
+    beta=2 (A, B) statistics persist TRIMMED to the true gene width —
+    padded columns are exact zeros, so re-padding on a resumed mesh
+    with a different gene-shard count is exact — and H rides under the
+    byte budget). Heartbeat stamps + the ``hostloss`` chaos hook fire
+    at every pass boundary, so the elastic controller can re-plan the
+    grid over survivors and re-enter with ``resume=True``."""
+    from ..runtime.faults import maybe_hostloss
+
+    caxis, gaxis = mesh.axis_names
+    row_sh = NamedSharding(mesh, P(caxis, None))
+    w_sh = NamedSharding(mesh, P(None, gaxis))
+    k = int(W0.shape[0])
+    g_pad = int(W0.shape[1])
+    g = int(g_orig) if g_orig is not None else g_pad
+    n_pad = int(Xd.shape[0])
+    h_tol_j = jnp.float32(h_tol)
+    f32 = np.float32
+
+    def one_pass(H, W):
+        return _grid_pass_jit(
+            Xd, H, W, mesh, beta, h_tol_j, int(chunk_max_iter),
+            l1_H, l2_H, l1_W, l2_W, kl_newton=kl_newton, nblk_h=nblk_h,
+            nblk_w=nblk_w, overlap=overlap)
+
+    def _pad_w(w_np):
+        w_np = np.asarray(w_np, np.float32)[:, :g]
+        if w_np.shape[1] < g_pad:
+            w_np = np.pad(w_np, ((0, 0), (0, g_pad - w_np.shape[1])))
+        return w_np
+
+    trace = np.full((TRACE_LEN,), np.nan, np.float32)
+    A = B = None
+    ran_pass = False
+
+    state = (ckpt.load(n_rows_min=int(n_orig), n_genes=g)
+             if n_orig is not None else ckpt.load(n_rows=n_pad, n_genes=g))
+    if state is not None:
+        W = jax.device_put(jnp.asarray(_pad_w(state["W"])), w_sh)
+        if state["H"] is not None:
+            h_np = np.asarray(state["H"], np.float32)
+            if h_np.shape[0] > n_pad:
+                h_np = h_np[:n_pad]
+            elif h_np.shape[0] < n_pad:
+                h_np = np.pad(h_np, ((0, n_pad - h_np.shape[0]), (0, 0)))
+            H = jax.device_put(jnp.asarray(h_np), row_sh)
+        else:
+            H = H0
+        resumed_without_h = state["H"] is None
+        it = int(state["pass_idx"])
+        err_prev, err = f32(state["err_prev"]), f32(state["err"])
+        n_tr = min(len(state["trace"]), TRACE_LEN)
+        trace[:n_tr] = state["trace"][:n_tr]
+        A, B = state["A"], state["B"]
+    else:
+        resumed_without_h = False
+        H, W, err0, A, B = one_pass(H0, W0)
+        ran_pass = True
+        err = f32(err0)
+        err_prev = f32(err * f32(1.0 + 2.0 * tol) + f32(1.0))
+        it = 1
+        trace[0] = err
+
+    def _save():
+        h_np = (np.asarray(H) if n_pad * k * 4 <= ckpt.h_budget else None)
+        ckpt.save(pass_idx=it, err_prev=err_prev, err=err, trace=trace,
+                  W=np.asarray(W)[:, :g],
+                  A=(np.asarray(A)[:, :g] if A is not None
+                     else np.zeros((k, g), np.float32)),
+                  B=(np.asarray(B) if B is not None
+                     else np.zeros((k, k), np.float32)),
+                  H=h_np)
+
+    def _pass_boundary():
+        if heartbeat is not None:
+            heartbeat.beat(phase="pass", cursor=it)
+        maybe_hostloss(context="pass")
+
+    if ran_pass and ckpt.every and it % ckpt.every == 0 and ckpt.due():
+        _save()
+    _pass_boundary()
+
+    def active() -> bool:
+        if it >= int(n_passes):
+            return False
+        if it < 2:
+            return True
+        rel = (f32(err_prev) - f32(err)) / max(f32(err_prev), f32(EPS))
+        return bool(rel >= f32(tol))
+
+    while active():
+        H, W, err_new, A, B = one_pass(H, W)
+        ran_pass = True
+        err_prev, err = err, f32(err_new)
+        it += 1
+        trace[min(it - 1, TRACE_LEN - 1)] = err
+        if ckpt.every and it % ckpt.every == 0 and ckpt.due():
+            _save()
+        _pass_boundary()
+
+    if resumed_without_h and not ran_pass:
+        # already-converged checkpoint without H: re-derive usages from
+        # the final W with one fixed-W grid solve (W untouched)
+        H = _fit_h_grid_jit(Xd, H0, W, mesh, beta, int(chunk_max_iter),
+                            h_tol_j, l1_H, l2_H, kl_newton, nblk_h,
+                            overlap)
+    nonfin = not bool(np.isfinite(f32(err)))
+    return H, W, float(err), trace, it, nonfin
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "beta", "chunk_max_iter", "l1_H", "l2_H",
+                     "kl_newton", "nblk_h", "overlap"),
+)
+def _fit_h_grid_jit(X, H0, W, mesh, beta, chunk_max_iter, h_tol,
+                    l1_H, l2_H, kl_newton: bool = False, nblk_h: int = 1,
+                    overlap: bool = True):
+    caxis, gaxis = mesh.axis_names
+    fn = shard_map(
+        lambda x, h, w: _h_solve_grid(x, h, w, gaxis, beta, l1_H, l2_H,
+                                      chunk_max_iter, h_tol, kl_newton,
+                                      nblk_h, overlap),
+        mesh=mesh,
+        in_specs=(P(caxis, gaxis), P(caxis, None), P(None, gaxis)),
+        out_specs=P(caxis, None))
+    return fn(X, H0, W)
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+def _coll_bytes_per_pass(rows_loc, g_loc, k, beta, nblk_h, nblk_w,
+                         n_dev) -> int:
+    """Logical per-pass psum payload bytes (summed over devices) for the
+    pass-level statistics reductions — the H-side hoists/first iteration
+    plus the W-side sufficient statistics. KL/IS inner iterations add
+    one H-side round per iteration (not counted here; the telemetry
+    context records the loss so readers can scale)."""
+    if beta == 2.0:
+        h_side = nblk_h * (rows_loc * k + k * k)
+        w_side = nblk_w * (k * g_loc + k * k)
+    else:
+        h_side = nblk_h * rows_loc * k + k  # first iteration + colsum
+        w_side = nblk_w * k * g_loc + k
+    return int((h_side + w_side + 1) * 4 * n_dev)
+
+
+def nmf_fit_grid2d(X, k: int, mesh: Mesh, beta_loss="frobenius",
+                   seed: int = 0, tol: float = 1e-4, h_tol: float = 0.05,
+                   n_passes: int | None = None, chunk_max_iter: int = 1000,
+                   alpha_W: float = 0.0, l1_ratio_W: float = 0.0,
+                   alpha_H: float = 0.0, l1_ratio_H: float = 0.0,
+                   n_orig: int | None = None, g_orig: int | None = None,
+                   init: str = "random", telemetry_sink=None,
+                   checkpoint=None, heartbeat=None, recipe=None,
+                   events=None):
+    """Factorize X over the 2-D (cells x genes) grid ``mesh``. Returns
+    ``(H (n, k), W (k, g), err)`` as numpy arrays — the same contract,
+    recipe/checkpoint/heartbeat/hostloss hooks, and telemetry payload
+    shape as :func:`~cnmf_torch_tpu.parallel.rowshard.nmf_fit_rowsharded`
+    (mode ``grid2d``).
+
+    ``X`` may be a host matrix (dense/CSR — staged stripe-wise, no host
+    dense copy), a :class:`ShardStore` (each process reads only the
+    slabs overlapping its addressable cell stripes), or a device array
+    already staged by :func:`stage_x_grid` (pass ``n_orig``/``g_orig``).
+    Supported recipes: plain MU (beta in {2, 1, 0}) and the
+    Diagonalized-Newton KL lane (``kl_newton``); the sketch recipe has
+    no grid lane and raises.
+    """
+    beta = beta_loss_to_float(beta_loss)
+    _, n_passes, _ = resolve_online_schedule(beta, h_tol, n_passes)
+    if beta not in (2.0, 1.0, 0.0):
+        raise ValueError(
+            f"nmf_fit_grid2d supports beta in {{2, 1, 0}}, got {beta}")
+    if init != "random":
+        raise ValueError(
+            f"nmf_fit_grid2d requires init='random', got {init!r} (the "
+            "nndsvd gram base is not sharded over the gene axis)")
+    caxis, gaxis = mesh.axis_names
+    c_dim, g_dim = (dict(mesh.shape)[caxis], dict(mesh.shape)[gaxis])
+
+    if isinstance(X, jax.Array):
+        Xd = X
+        if n_orig is None:
+            n_orig = int(X.shape[0])
+        if g_orig is None:
+            g_orig = int(X.shape[1])
+    else:
+        n_orig = int(X.shape[0]) if n_orig is None else n_orig
+        g_orig = int(X.shape[1]) if g_orig is None else g_orig
+        Xd, _, _ = stage_x_grid(X, mesh, events=events,
+                                liveness=heartbeat)
+    n_pad, g_pad = int(Xd.shape[0]), int(Xd.shape[1])
+
+    if recipe is None:
+        from ..ops.recipe import resolve_recipe
+
+        recipe = resolve_recipe(beta, "rowshard", algo="mu", ell=False,
+                                n=int(n_orig), g=int(g_orig), k=int(k))
+    if recipe.kl_newton and beta != 1.0:
+        raise ValueError(
+            f"recipe {recipe.label!r} requires beta=1 (KL), got "
+            f"beta={beta}")
+    if recipe.algo == "sketch":
+        raise ValueError(
+            "the sketch recipe has no (cells x genes) grid lane — run "
+            "the 1-D rowshard path, or pin CNMF_TPU_SKETCH=0 for grid2d")
+    kl_newton = bool(recipe.kl_newton)
+
+    key = jax.random.key(int(seed) & 0x7FFFFFFF)
+    x_mean = jnp.sum(Xd) / (n_pad * g_pad)
+    H0, W0 = random_init(key, n_pad, g_pad, int(k), x_mean)
+    # padded gene columns masked to EXACT zero: a zero W column is
+    # absorbing under every rate here, contributes exact +0.0 to the
+    # H-side statistics (its X column is zero-padded too), and lets the
+    # checkpoint trim/re-pad W exactly across re-meshes
+    if g_pad > g_orig:
+        W0 = W0 * (jnp.arange(g_pad) < g_orig)[None, :]
+    H0 = jax.device_put(H0, NamedSharding(mesh, P(caxis, None)))
+    W0 = jax.device_put(W0, NamedSharding(mesh, P(None, gaxis)))
+
+    l1_W, l2_W = split_regularization(alpha_W, l1_ratio_W)
+    l1_H, l2_H = split_regularization(alpha_H, l1_ratio_H)
+
+    rows_loc = n_pad // c_dim
+    g_loc = g_pad // g_dim
+    overlap = grid_overlap_enabled()
+    nblk_h = grid_blocks(g_loc)
+    nblk_w = grid_blocks(rows_loc)
+
+    want_telem = False
+    if telemetry_sink is not None:
+        from ..utils.telemetry import telemetry_enabled
+
+        want_telem = telemetry_enabled()
+
+    t0 = time.perf_counter()
+    if checkpoint is not None and getattr(checkpoint, "every", 0) > 0:
+        H, W, err, trace_np, passes, nonfin = _fit_grid2d_checkpointed(
+            Xd, H0, W0, mesh, beta, float(tol), float(h_tol),
+            int(n_passes), int(chunk_max_iter), l1_H, l2_H, l1_W, l2_W,
+            checkpoint, heartbeat=heartbeat, n_orig=n_orig,
+            g_orig=g_orig, kl_newton=kl_newton, nblk_h=nblk_h,
+            nblk_w=nblk_w, overlap=overlap)
+        trace_arr, iters_run = trace_np, passes
+        nonfin_flag = nonfin
+    else:
+        out = _fit_grid2d_jit(
+            Xd, H0, W0, mesh, beta, jnp.float32(tol),
+            jnp.float32(h_tol), int(n_passes), int(chunk_max_iter),
+            l1_H, l2_H, l1_W, l2_W, telemetry=want_telem,
+            kl_newton=kl_newton, nblk_h=nblk_h, nblk_w=nblk_w,
+            overlap=overlap)
+        H, W, err = out[:3]
+        if want_telem:
+            trace_arr, iters_run, nonfin_flag = out[3:]
+        else:
+            trace_arr = iters_run = nonfin_flag = None
+    jax.block_until_ready(W)
+    wall = time.perf_counter() - t0
+
+    if jax.process_count() > 1:
+        # H is cells-sharded across hosts and W gene-sharded within
+        # them — neither is fully addressable on a pod, so every host
+        # gathers (each needs the full factors for artifacts anyway)
+        from jax.experimental import multihost_utils
+
+        H_np = np.asarray(
+            multihost_utils.process_allgather(H, tiled=True))[:n_orig]
+        W_np = np.asarray(
+            multihost_utils.process_allgather(W, tiled=True))[:, :g_orig]
+    else:
+        H_np = np.asarray(H)[:n_orig]
+        W_np = np.asarray(W)[:, :g_orig]
+    err_f = float(np.asarray(err))
+    if want_telem:
+        telemetry_sink({
+            "k": int(k), "beta": float(beta), "mode": "grid2d",
+            "seeds": [int(seed)], "cap": int(n_passes),
+            "cadence": "pass",
+            "trace": np.asarray(trace_arr)[None],
+            "iters": np.asarray([int(np.asarray(iters_run))]),
+            "nonfinite": np.asarray([bool(np.asarray(nonfin_flag))]),
+            "errs": np.asarray([err_f], np.float64),
+            "recipe": recipe.label})
+    if events is not None and getattr(events, "enabled", False):
+        n_dev = c_dim * g_dim
+        passes_run = (int(np.asarray(iters_run))
+                      if iters_run is not None else None)
+        events.emit(
+            "collective",
+            context={"stage": "grid2d_pass_stats", "k": int(k),
+                     "beta": float(beta),
+                     "mesh_shape": [int(c_dim), int(g_dim)],
+                     "blocks": [int(nblk_h), int(nblk_w)],
+                     "overlap": bool(overlap),
+                     "passes": passes_run},
+            wall_s=round(wall, 4),
+            nbytes=_coll_bytes_per_pass(rows_loc, g_loc, int(k), beta,
+                                        nblk_h, nblk_w, n_dev),
+            overlap_fraction=None)
+    return H_np, W_np, err_f
+
+
+# ---------------------------------------------------------------------------
+# collective-wall / overlap measurement (bench + telemetry probe)
+# ---------------------------------------------------------------------------
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "rows_loc", "g_loc", "k", "nblk_h",
+                     "nblk_w", "chained", "beta"),
+)
+def _collective_probe_jit(x, mesh, rows_loc: int, g_loc: int, k: int,
+                          nblk_h: int, nblk_w: int,
+                          chained: bool = False, beta: float = 2.0):
+    """Collectives-only probe: the psum schedule of ONE pass-level
+    statistics round for this ``beta`` (matching
+    :func:`_coll_bytes_per_pass` — beta=2: blocked (rows, k)/(k, k)
+    H-side + (k, g_loc)/(k, k) W-side; beta in {1, 0}: blocked
+    (rows, k) H-side + the (k,) colsum hoist, blocked (k, g_loc)
+    W-side + the (k,) row-sum — KL/IS additionally repeat the H-side
+    round per inner iteration, which this floor deliberately does not
+    model), on zero payloads derived from a tiny input (so the program
+    is not constant-folded).
+
+    ``chained=False`` leaves every reduce independent — the scheduler
+    may overlap their rendezvous latencies, exactly the freedom the
+    double-buffered pass gives its collectives. ``chained=True``
+    data-chains each reduce's input on the previous reduce's output —
+    the serial-baseline structure, one rendezvous fully paid per
+    block. Timing the two isolates the latency-hiding the overlap
+    dispatch buys on the collective wall itself."""
+    caxis, gaxis = mesh.axis_names
+    with_kk = beta == 2.0
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(P(),),
+                       out_specs=P())
+    def run(z):
+        zero = z[0] * 0.0
+        acc = zero
+        dep = zero
+        for _ in range(nblk_h):
+            fill = zero + dep if chained else zero
+            a = jax.lax.psum(jnp.full((rows_loc, k), fill), gaxis)
+            dep = a[0, 0] * 0.0
+            acc = acc + a[0, 0]
+            if with_kk:
+                acc = acc + jax.lax.psum(jnp.full((k, k), zero),
+                                         gaxis)[0, 0]
+        if not with_kk:  # the hoisted KL/IS colsum denominator
+            acc = acc + jax.lax.psum(jnp.full((k,), zero), gaxis)[0]
+        for _ in range(nblk_w):
+            fill = zero + dep if chained else zero
+            a = jax.lax.psum(jnp.full((k, g_loc), fill), caxis)
+            dep = a[0, 0] * 0.0
+            acc = acc + a[0, 0]
+            if with_kk:
+                acc = acc + jax.lax.psum(jnp.full((k, k), zero),
+                                         caxis)[0, 0]
+        if not with_kk:  # the KL W-step's psum'd H row-sum
+            acc = acc + jax.lax.psum(jnp.full((k,), zero), caxis)[0]
+        return jnp.asarray([acc])
+
+    return run(x)
+
+
+def measure_collectives(Xd, k: int, mesh: Mesh, beta: float = 2.0,
+                        h_tol: float = 0.05, chunk_max_iter: int = 50,
+                        seed: int = 0, repeats: int = 11) -> dict:
+    """Measure the statistics-collective wall and the overlap fraction
+    on a STAGED grid array.
+
+    Two measurements, reported together:
+
+      * ``overlap_fraction`` — collective-level latency hiding:
+        the per-pass psum schedule timed with every reduce independent
+        (the double-buffered dispatch's structure — rendezvous
+        latencies overlap) vs data-chained (the serial baseline's
+        structure — each reduce fully paid), interleaved sampling,
+        ``max(0, (chained - free) / chained)`` over medians. This is
+        the structural quantity: it measures what the overlapped
+        dispatch is free to hide, stable even on oversubscribed
+        single-host CPU simulation.
+      * ``pass_hidden_fraction`` — end-to-end: one full pass compiled
+        with the overlap vs with the serializing barrier (bit-identical
+        math), as a fraction of the collective wall. On real multi-chip
+        hardware this converges to the fraction of the collective wall
+        off the critical path; on a CPU host whose simulated devices
+        timeshare one core, blocked rendezvous waits cost no CPU, so
+        the true value is ~0 and the report says so honestly.
+
+    Returns ``{coll_chained_s, coll_free_s, overlap_fraction,
+    pass_overlap_s, pass_serial_s, pass_hidden_fraction, blocks,
+    nbytes_per_pass}``."""
+    caxis, gaxis = mesh.axis_names
+    c_dim, g_dim = (dict(mesh.shape)[caxis], dict(mesh.shape)[gaxis])
+    n_pad, g_pad = int(Xd.shape[0]), int(Xd.shape[1])
+    rows_loc, g_loc = n_pad // c_dim, g_pad // g_dim
+    nblk_h, nblk_w = grid_blocks(g_loc), grid_blocks(rows_loc)
+
+    key = jax.random.key(int(seed) & 0x7FFFFFFF)
+    x_mean = jnp.sum(Xd) / (n_pad * g_pad)
+    H0, W0 = random_init(key, n_pad, g_pad, int(k), x_mean)
+    H0 = jax.device_put(H0, NamedSharding(mesh, P(caxis, None)))
+    W0 = jax.device_put(W0, NamedSharding(mesh, P(None, gaxis)))
+    h_tol_j = jnp.float32(h_tol)
+
+    def one_pass(overlap):
+        out = _grid_pass_jit(Xd, H0, W0, mesh, float(beta), h_tol_j,
+                             int(chunk_max_iter), 0.0, 0.0, 0.0, 0.0,
+                             nblk_h=nblk_h, nblk_w=nblk_w,
+                             overlap=overlap)
+        jax.block_until_ready(out[1])
+
+    probe_in = jax.device_put(jnp.ones((1,), jnp.float32),
+                              NamedSharding(mesh, P()))
+
+    def coll_only(chained):
+        jax.block_until_ready(_collective_probe_jit(
+            probe_in, mesh, rows_loc, g_loc, int(k), nblk_h, nblk_w,
+            chained=chained, beta=float(beta)))
+
+    reps = max(int(repeats), 1)
+
+    def timed_pair(fn_a, fn_b):
+        # interleaved A/B sampling cancels slow host drift; medians of
+        # each stream are compared
+        fn_a()
+        fn_b()  # compile / warm both
+        wa, wb = [], []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn_a()
+            wa.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            fn_b()
+            wb.append(time.perf_counter() - t0)
+        return float(np.median(wa)), float(np.median(wb))
+
+    t_chain, t_free = timed_pair(lambda: coll_only(True),
+                                 lambda: coll_only(False))
+    t_ser, t_ovl = timed_pair(lambda: one_pass(False),
+                              lambda: one_pass(True))
+    frac = (max(0.0, (t_chain - t_free) / t_chain)
+            if t_chain > 0 else 0.0)
+    pass_frac = (min(1.0, max(0.0, t_ser - t_ovl) / t_chain)
+                 if t_chain > 0 else 0.0)
+    return {
+        "coll_chained_s": round(t_chain, 6),
+        "coll_free_s": round(t_free, 6),
+        "overlap_fraction": round(frac, 4),
+        "pass_overlap_s": round(t_ovl, 6),
+        "pass_serial_s": round(t_ser, 6),
+        "pass_hidden_fraction": round(pass_frac, 4),
+        "blocks": [int(nblk_h), int(nblk_w)],
+        "nbytes_per_pass": _coll_bytes_per_pass(
+            rows_loc, g_loc, int(k), float(beta), nblk_h, nblk_w,
+            c_dim * g_dim),
+    }

@@ -350,6 +350,12 @@ def plan_degraded_mesh(mesh, lost_devices):
         from ..parallel.multihost import mesh_2d
 
         return mesh_2d(devices=surviving)
+    if axis_names == ("cells", "genes"):
+        # the true 2-D grid (ISSUE 13) re-plans through its own
+        # DCN-aware planner, like the original mesh was built
+        from ..parallel.grid2d import mesh_grid2d
+
+        return mesh_grid2d(devices=surviving)
     if len(axis_names) != 1:
         raise DegradedMeshError(
             f"cannot re-plan a degraded mesh over axes {axis_names!r}")

@@ -163,6 +163,22 @@ REGISTRY: dict[str, Knob] = _knobs(
          "re-read per pass — solver-tolerance, not bit-identical); `0` "
          "derives from reported device memory (effectively resident on "
          "backends without memory stats)"),
+    # -- 2-D (cells x genes) grid (parallel/grid2d.py) ---------------------
+    Knob("CNMF_TPU_GRID_OVERLAP", "flag", "`1`",
+         "compute-overlapped grid collectives (MPI-FAUN): each statistics "
+         "block's psum dispatches while the next block's local gemm "
+         "computes; `0` serializes reduce→gemm with a barrier — results "
+         "are bit-identical either way, only scheduling freedom differs"),
+    Knob("CNMF_TPU_GRID_BLOCKS", "int", "`0` (auto)",
+         "statistics sub-blocks per overlapped reduction on the "
+         "(cells × genes) grid (clamped to a divisor of the local "
+         "rows/cols); `0` derives 4 blocks when the tile affords them, "
+         "`1` disables blocking (one psum per statistic)"),
+    Knob("CNMF_TPU_GRID_SHAPE", "str", "auto",
+         "pin the (cells × genes) grid factorization as `CxG` (e.g. "
+         "`4x2`); `auto` lays cells across hosts / genes within a host "
+         "on pods (the O(rows·k) reductions stay on ICI, only k×g/k×k "
+         "crosses DCN) and factors most-square single-host"),
     # -- checkpointing / multihost ----------------------------------------
     Knob("CNMF_TPU_CKPT_EVERY_PASSES", "int", "`1`",
          "mid-run checkpoint cadence for the rowsharded solver, in solver "

@@ -95,6 +95,13 @@ EVENT_TYPES = {
     # `bench.py --tier serve` batching-engagement assertions
     "serve_request": {"tenant", "n_cells", "status"},
     "serve_batch": {"lanes", "requests", "bucket"},
+    # 2-D grid statistics collectives (parallel/grid2d.py, ISSUE 13):
+    # one event per grid solve (context: mesh shape, overlap blocks,
+    # pass count; wall_s = solve wall, nbytes = logical per-pass psum
+    # payload) plus one measured-probe event per factorize carrying the
+    # overlap_fraction (the fraction of the collective wall hidden
+    # behind compute — optional: present only when the probe ran)
+    "collective": {"context", "wall_s", "nbytes"},
 }
 
 # per-record required fields inside a "replicates" event's records list
@@ -538,6 +545,17 @@ def summarize_events(events: list[dict]) -> dict:
              "overlap_fraction": e.get("overlap_fraction")}
             for e in streams]
 
+    # 2-D grid statistics collectives (ISSUE 13, parallel/grid2d.py):
+    # per-solve reduce wall + logical psum payload, and the measured
+    # probe's hidden-collective (overlap) fraction when it ran
+    colls = [e for e in events if e["t"] == "collective"]
+    if colls:
+        summary["collectives"] = [
+            {"context": e.get("context"), "wall_s": e.get("wall_s"),
+             "nbytes": e.get("nbytes"),
+             "overlap_fraction": e.get("overlap_fraction")}
+            for e in colls]
+
     # out-of-core ingestion (ISSUE 10): the shard store written at
     # prepare (dispatch decision=shard_store_write), factorize's store
     # engagement (decision=ooc_ingest), and the disk-producer staging
@@ -875,6 +893,26 @@ def render_report(run_dir: str) -> str:
             lines.append(
                 f"  {'host slab residency peak':<28s}"
                 f" {_fmt_bytes(ing['host_peak_bytes']):>10s}")
+
+    if summary.get("collectives"):
+        lines.append("")
+        lines.append("Collectives (2-D grid statistics reductions)")
+        lines.append("-" * 44)
+        for c in summary["collectives"]:
+            ctx = c.get("context") or {}
+            if not isinstance(ctx, dict):
+                ctx = {}
+            mesh_s = "x".join(str(x) for x in (ctx.get("mesh_shape")
+                                               or [])) or "?"
+            blocks = "/".join(str(x) for x in (ctx.get("blocks")
+                                               or [])) or "?"
+            frac = c.get("overlap_fraction")
+            lines.append(
+                f"  {str(ctx.get('stage', 'grid2d')):<20s} "
+                f"k={str(ctx.get('k', '?')):<4s} mesh {mesh_s:<6s} "
+                f"blocks {blocks:<6s} {float(c.get('wall_s', 0)):>8.3f} s"
+                f"  {_fmt_bytes(c.get('nbytes', 0)):>10s}"
+                + (f"  overlap {frac:.2f}" if frac is not None else ""))
 
     if summary.get("convergence"):
         lines.append("")

@@ -1,18 +1,30 @@
 """Tier-1 out-of-core ingestion smoke gate (scripts/verify_tier1.sh).
 
-Runs the mini pipeline twice on the same seeds — once resident
-(``CNMF_TPU_OOC=0``) and once with ``CNMF_TPU_OOC_BUDGET_BYTES`` forced
-far below the fixture's matrix size, so prepare writes the row-slab
-shard store and the rowsharded factorize streams every slab from disk —
-and asserts:
+Runs the mini pipeline — prepare → factorize → combine → consensus →
+k_selection — twice on the same seeds: once resident (``CNMF_TPU_OOC=0``)
+and once with ``CNMF_TPU_OOC_BUDGET_BYTES`` forced below the fixture's
+matrix size, so prepare writes the row-slab shard store, factorize
+streams every slab from disk, and consensus + k-selection run their
+budget-bounded slab loops (ISSUE 13) instead of assembling the matrix.
+Asserts:
 
   * the store exists with > 1 slab and the h5ad copy is SKIPPED under
     ``CNMF_TPU_OOC=1`` (the double-write satellite);
-  * merged spectra AND consensus are BIT-identical to the resident run
-    (store-backed staging places values, never sums them);
+  * merged spectra AND consensus spectra/usages are BIT-identical to
+    the resident run (store-backed staging places values, never sums
+    them; the slab-looped usage refit preserves the chunk partition);
+  * the store-backed run NEVER assembles the full matrix on host (no
+    "assembling the full matrix" warning), and the streamed consensus +
+    k-selection slab passes report a host-residency peak UNDER the
+    budget (telemetry ``stream`` events, contexts ``consensus_stream``
+    / ``kselection_stream``);
+  * the k-selection stats match the resident run (silhouette exactly —
+    it is spectra-only; prediction error to f64 accumulation-order
+    tolerance);
   * a ``shard_read``-injected torn slab is DETECTED by the reader's
     content-digest validation and healed by a disk re-read (telemetry
-    ``fault`` kind ``shard_read_torn``), with the run still bit-identical;
+    ``fault`` kind ``shard_read_torn``), with the run still
+    bit-identical;
   * every emitted event validates against the telemetry schema.
 
 Exits nonzero on any violation, failing the gate.
@@ -24,6 +36,7 @@ import os
 import shutil
 import sys
 import tempfile
+import warnings
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -33,8 +46,15 @@ os.environ["CNMF_TPU_TELEMETRY"] = "1"
 _OOC_KNOBS = ("CNMF_TPU_OOC", "CNMF_TPU_OOC_BUDGET_BYTES",
               "CNMF_TPU_OOC_SLAB_ROWS", "CNMF_TPU_FAULT_SPEC")
 
+N_CELLS, N_GENES_HV = 450, 100
+# below the 450 x 100 f32 fixture (180 KB), so every stage must stream;
+# sized so one 64-row refit chunk's TRUE live set (raw CSR slab ~2x +
+# f32 block + the error pass's charged f64 copy + the usage-sized
+# pass-lifetime buffers — the irreducible floor) still fits under it
+BUDGET = 147456
 
-def _pipeline(workdir: str, env: dict) -> "object":
+
+def _pipeline(workdir: str, env: dict, k_selection: bool = True):
     import numpy as np
     import pandas as pd
 
@@ -45,22 +65,29 @@ def _pipeline(workdir: str, env: dict) -> "object":
     os.environ.update(env)
     try:
         rng = np.random.default_rng(3)
-        usage = rng.dirichlet(np.ones(5) * 0.3, size=220)
+        usage = rng.dirichlet(np.ones(5) * 0.3, size=N_CELLS)
         spectra = rng.gamma(0.3, 1.0, size=(5, 130)) * 40.0 / 130
         counts = rng.poisson(usage @ spectra * 300.0).astype(np.float64)
         counts[counts.sum(axis=1) == 0, 0] = 1.0
-        df = pd.DataFrame(counts, index=[f"c{i}" for i in range(220)],
+        df = pd.DataFrame(counts,
+                          index=[f"c{i}" for i in range(N_CELLS)],
                           columns=[f"g{j}" for j in range(130)])
         counts_fn = os.path.join(workdir, "counts.df.npz")
         save_df_to_npz(df, counts_fn)
 
         obj = cNMF(output_dir=workdir, name="ooc")
+        # batch_size=64: the refit chunk is the slab loop's irreducible
+        # unit, and bit-identity pins the chunk partition — a 64-row
+        # chunk keeps the streamed blocks well under the budget where
+        # the default 5000 would cover the whole mini fixture
         obj.prepare(counts_fn, components=[3], n_iter=4, seed=7,
-                    num_highvar_genes=100)
+                    num_highvar_genes=N_GENES_HV, batch_size=64)
         obj.factorize(rowshard=True)
         obj.combine()
         obj.consensus(k=3, density_threshold=2.0, show_clustering=False)
-        return obj
+        stats = obj.k_selection_plot(close_fig=True) if k_selection \
+            else None
+        return obj, stats
     finally:
         for k, v in prior.items():
             if v is None:
@@ -79,19 +106,26 @@ def main() -> int:
     ooc_dir = tempfile.mkdtemp(prefix="ooc_smoke_ooc_")
     torn_dir = tempfile.mkdtemp(prefix="ooc_smoke_torn_")
     try:
-        base = _pipeline(base_dir, {"CNMF_TPU_OOC": "0"})
+        base, stats_base = _pipeline(base_dir, {"CNMF_TPU_OOC": "0"})
 
-        # fixture matrix ~220 x 100 f32 = 88 KB >> 16 KB budget: the
-        # store MUST be written and factorize MUST stream slab-wise.
-        # Slab rows pinned to 64 (the auto sizing floors at 256 rows so
-        # production budgets never explode the slab count — on this mini
-        # fixture that floor would collapse the store to one slab and the
-        # smoke would prove nothing); 220/64 also leaves a RAGGED final
-        # slab, the boundary case the staging parity must absorb.
+        # budget below the fixture's dense bytes: the store MUST be
+        # written, factorize MUST stream slab-wise, and consensus +
+        # k-selection MUST run the slab-looped refit/error passes.
+        # Slab rows pinned to 64 (matching the refit chunk; the auto
+        # sizing floors at 256 rows, which would collapse this mini
+        # store to one slab); 450/64 leaves a RAGGED final slab (8
+        # slabs, 2-row tail), the boundary case the staging + slab-loop
+        # parity must absorb.
         ooc_env = {"CNMF_TPU_OOC": "1",
-                   "CNMF_TPU_OOC_BUDGET_BYTES": "16384",
+                   "CNMF_TPU_OOC_BUDGET_BYTES": str(BUDGET),
                    "CNMF_TPU_OOC_SLAB_ROWS": "64"}
-        ooc = _pipeline(ooc_dir, ooc_env)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            ooc, stats_ooc = _pipeline(ooc_dir, ooc_env)
+        assembled = [w for w in caught
+                     if "assembling the full matrix" in str(w.message)]
+        assert not assembled, \
+            "store-authoritative run assembled the full matrix on host"
         store_manifest = os.path.join(ooc.paths["shard_store"],
                                       "manifest.json")
         assert os.path.exists(store_manifest), "shard store not written"
@@ -107,10 +141,22 @@ def main() -> int:
             return np.load(obj.paths[key] % fmt, allow_pickle=True)["data"]
 
         for key, fmt in (("merged_spectra", (3,)),
-                         ("consensus_spectra", (3, "2_0"))):
+                         ("consensus_spectra", (3, "2_0")),
+                         ("consensus_usages", (3, "2_0"))):
             a, b = _load(base, key, *fmt), _load(ooc, key, *fmt)
             assert np.array_equal(a, b), \
                 f"{key}: store-backed run is not bit-identical to resident"
+
+        # k-selection parity: silhouette is spectra-only (exact);
+        # prediction error differs only by f64 accumulation order
+        sb, so = stats_base.iloc[0], stats_ooc.iloc[0]
+        assert sb["silhouette"] == so["silhouette"], \
+            "k-selection silhouette diverged under streaming"
+        rel = abs(sb["prediction_error"] - so["prediction_error"]) \
+            / max(abs(sb["prediction_error"]), 1e-12)
+        assert rel < 1e-5, \
+            f"k-selection prediction error diverged ({rel:.2e} rel)"
+
         ev_path = os.path.join(ooc_dir, "ooc", "cnmf_tmp",
                                "ooc.events.jsonl")
         validate_events_file(ev_path)
@@ -119,19 +165,32 @@ def main() -> int:
                    for e in evs), "no ooc_ingest dispatch event"
         assert any(e["t"] == "stream" and e.get("disk_nbytes")
                    for e in evs), "no disk-producer stream stats recorded"
+        # host-residency budget: every streamed consensus/k-selection
+        # pass must report a peak under the budget (and therefore under
+        # the full-matrix bytes the resident path would hold)
+        slab_streams = [e for e in evs if e["t"] == "stream"
+                        and e.get("context") in ("consensus_stream",
+                                                 "kselection_stream")]
+        assert slab_streams, "no streamed consensus/k-selection events"
+        peaks = [int(e.get("host_peak_bytes") or 0) for e in slab_streams]
+        assert all(0 < p <= BUDGET for p in peaks), \
+            f"slab-pass host peak {peaks} exceeds the budget {BUDGET}"
+        full_bytes = N_CELLS * N_GENES_HV * 4
+        assert max(peaks) < full_bytes, \
+            "slab-pass host peak is not below the full-matrix footprint"
         print("[ooc_smoke] store-backed run bit-identical to resident "
-              f"({n_slabs} slabs, h5ad skipped) ... ok")
+              f"({n_slabs} slabs, h5ad skipped); consensus+k_selection "
+              f"streamed, host peak {max(peaks)} <= budget {BUDGET} "
+              f"(< full {full_bytes}) ... ok")
 
         # torn-slab containment: the injected corruption must be caught
         # by the digest check and healed by a clean re-read — output
         # still bit-identical, fault event on the record
         torn_env = dict(ooc_env,
                         CNMF_TPU_FAULT_SPEC="shard_read:context=slab")
-        import warnings
-
         with warnings.catch_warnings(record=True) as caught:
             warnings.simplefilter("always")
-            torn = _pipeline(torn_dir, torn_env)
+            torn, _ = _pipeline(torn_dir, torn_env, k_selection=False)
         heal_warn = [w for w in caught
                      if "re-reading from disk" in str(w.message)]
         assert heal_warn, "torn shard read was not detected/re-read"

@@ -76,11 +76,13 @@ fi
 
 # ooc smoke: mini pipeline with the slab budget forced below the fixture
 # size — prepare writes the shard store, factorize streams every slab
-# from disk, and the merged spectra + consensus must be BIT-identical to
+# from disk, consensus + k_selection run their budget-bounded slab loops
+# (host-residency peak asserted under the budget, no full-matrix
+# assembly), and the merged spectra + consensus must be BIT-identical to
 # the resident run; a shard_read-injected torn slab must be detected by
 # the digest check and healed by a disk re-read (scripts/ooc_smoke.py)
 if [ "$rc" -eq 0 ]; then
-  echo "[tier1] ooc smoke (shard-store ingestion: bit parity + torn-slab re-read) ..."
+  echo "[tier1] ooc smoke (shard-store ingestion: bit parity + streamed consensus/k-selection + torn-slab re-read) ..."
   if timeout -k 10 600 env JAX_PLATFORMS=cpu \
       python scripts/ooc_smoke.py; then
     echo OOC_SMOKE=ok

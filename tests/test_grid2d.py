@@ -1,0 +1,374 @@
+"""True 2-D (cells x genes) grid with compute-overlapped collectives
+(ISSUE 13, ``parallel/grid2d.py``) — parity with the 1-D rowshard path
+at 4 and 8 simulated devices (2x2, 2x4, 4x2 grids), overlap on/off
+bit-identity, ragged gene shards, store-backed staging, degraded-mesh
+re-planning on the grid, and the slab-looped consensus refit's
+bit-identity contract (``ops.nmf.fit_h_slabbed``)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+import jax
+from jax.sharding import Mesh
+
+from cnmf_torch_tpu.models.cnmf import cNMF
+from cnmf_torch_tpu.ops.nmf import fit_h, fit_h_slabbed
+from cnmf_torch_tpu.ops.recipe import resolve_recipe
+from cnmf_torch_tpu.parallel.grid2d import (
+    _grid_rc,
+    grid_blocks,
+    measure_collectives,
+    mesh_grid2d,
+    nmf_fit_grid2d,
+    stage_x_grid,
+)
+from cnmf_torch_tpu.parallel.rowshard import nmf_fit_rowsharded
+from cnmf_torch_tpu.runtime import elastic, faults
+from cnmf_torch_tpu.utils import save_df_to_npz
+from cnmf_torch_tpu.utils.io import load_df_from_npz
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) != 8,
+    reason="grid tests assume the 8-device simulated mesh (conftest)")
+
+
+def _fixture(n=96, g=48, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.gamma(2.0, 1.0, size=(n, g)).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# mesh planning
+# ---------------------------------------------------------------------------
+
+def test_grid_rc_single_host_cells_biased():
+    # most-square with cells taking the larger factor
+    assert _grid_rc(8, 1) == (4, 2)
+    assert _grid_rc(4, 1) == (2, 2)
+    assert _grid_rc(6, 1) == (3, 2)
+    assert _grid_rc(1, 1) == (1, 1)
+    # multi-host: cells across hosts, genes within
+    assert _grid_rc(8, 2) == (2, 4)
+
+
+def test_grid_shape_knob(monkeypatch):
+    monkeypatch.setenv("CNMF_TPU_GRID_SHAPE", "2x4")
+    mesh = mesh_grid2d()
+    assert mesh.devices.shape == (2, 4)
+    assert mesh.axis_names == ("cells", "genes")
+    monkeypatch.setenv("CNMF_TPU_GRID_SHAPE", "3x2")
+    with pytest.raises(ValueError, match="devices"):
+        mesh_grid2d()
+    monkeypatch.setenv("CNMF_TPU_GRID_SHAPE", "bogus")
+    with pytest.raises(ValueError, match="CxG"):
+        mesh_grid2d()
+
+
+def test_mesh_grid2d_explicit_and_invalid():
+    assert mesh_grid2d(cell_shards=4).devices.shape == (4, 2)
+    assert mesh_grid2d(gene_shards=4).devices.shape == (2, 4)
+    with pytest.raises(ValueError, match="tile"):
+        mesh_grid2d(cell_shards=3)
+
+
+def test_grid_blocks_clamps_to_divisor(monkeypatch):
+    assert grid_blocks(128) == 4
+    assert grid_blocks(30) == 1          # < 64: no blocking by default
+    monkeypatch.setenv("CNMF_TPU_GRID_BLOCKS", "4")
+    assert grid_blocks(30) == 3          # clamped to a divisor
+    assert grid_blocks(128) == 4
+    monkeypatch.setenv("CNMF_TPU_GRID_BLOCKS", "1")
+    assert grid_blocks(128) == 1
+
+
+# ---------------------------------------------------------------------------
+# staging
+# ---------------------------------------------------------------------------
+
+def test_stage_x_grid_dense_csr_store_identical(tmp_path):
+    import scipy.sparse as sp
+
+    from cnmf_torch_tpu.utils import shardstore
+
+    X = _fixture(40, 20)
+    X[X < 1.0] = 0.0  # sparsify
+    mesh = mesh_grid2d(cell_shards=4, gene_shards=2)
+    Xd_dense, rp, cp = stage_x_grid(X, mesh)
+    assert (rp, cp) == (0, 0)
+    np.testing.assert_array_equal(np.asarray(Xd_dense), X)
+
+    Xd_csr, _, _ = stage_x_grid(sp.csr_matrix(X), mesh)
+    np.testing.assert_array_equal(np.asarray(Xd_csr), X)
+
+    store_dir = str(tmp_path / "store")
+    shardstore.write_shard_store(store_dir, sp.csr_matrix(X), slab_rows=16)
+    store = shardstore.open_shard_store(store_dir)
+    Xd_store, _, _ = stage_x_grid(store, mesh)
+    np.testing.assert_array_equal(np.asarray(Xd_store), X)
+
+
+def test_stage_x_grid_ragged_pads_zero():
+    X = _fixture(42, 19)  # ragged on both axes for a 4x2 grid
+    mesh = mesh_grid2d(cell_shards=4, gene_shards=2)
+    Xd, rp, cp = stage_x_grid(X, mesh)
+    assert (rp, cp) == (2, 1)
+    full = np.asarray(Xd)
+    np.testing.assert_array_equal(full[:42, :19], X)
+    assert (full[42:] == 0).all() and (full[:, 19:] == 0).all()
+
+
+# ---------------------------------------------------------------------------
+# solver parity vs the 1-D rowshard path
+# ---------------------------------------------------------------------------
+
+def _parity(X, k, grid_mesh, beta_loss, n_passes=12, seed=5, **kw):
+    mesh1 = Mesh(np.asarray(jax.devices()), ("cells",))
+    H1, W1, e1 = nmf_fit_rowsharded(X, k, mesh1, beta_loss=beta_loss,
+                                    seed=seed, n_passes=n_passes, **kw)
+    H2, W2, e2 = nmf_fit_grid2d(X, k, grid_mesh, beta_loss=beta_loss,
+                                seed=seed, n_passes=n_passes, **kw)
+    return (H1, W1, e1), (H2, W2, e2)
+
+
+@pytest.mark.parametrize("shape", [(4, 2), (2, 4)])
+@pytest.mark.parametrize("beta_loss", ["frobenius", "kullback-leibler"])
+def test_grid_parity_8dev(shape, beta_loss):
+    """(cells x genes) factorize matches the 1-D rowshard path at the
+    same seed to collective-reduction tolerance (the gene axis splits
+    contractions the 1-D path runs whole): matched objectives, same
+    shapes, finite nonnegative spectra."""
+    X = _fixture()
+    mesh = mesh_grid2d(cell_shards=shape[0], gene_shards=shape[1])
+    (H1, W1, e1), (H2, W2, e2) = _parity(X, 3, mesh, beta_loss)
+    assert W2.shape == W1.shape and H2.shape == H1.shape
+    assert np.isfinite(W2).all() and (W2 >= 0).all()
+    assert abs(e1 - e2) / abs(e1) < 5e-3
+    # spectra match component-for-component (same init, same pass
+    # structure — only reduction grouping differs)
+    for r in range(3):
+        a, b = W1[r], W2[r]
+        cos = float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b)))
+        assert cos > 0.999
+
+
+@pytest.mark.parametrize("beta_loss", ["frobenius", "itakura-saito"])
+def test_grid_parity_4dev_2x2(beta_loss):
+    X = _fixture()
+    mesh = mesh_grid2d(cell_shards=2, gene_shards=2,
+                       devices=jax.devices()[:4])
+    mesh1 = Mesh(np.asarray(jax.devices()[:4]), ("cells",))
+    H1, W1, e1 = nmf_fit_rowsharded(X, 3, mesh1, beta_loss=beta_loss,
+                                    seed=5, n_passes=8)
+    H2, W2, e2 = nmf_fit_grid2d(X, 3, mesh, beta_loss=beta_loss,
+                                seed=5, n_passes=8)
+    assert abs(e1 - e2) / abs(e1) < 5e-3
+
+
+def test_grid_trivial_gene_axis_bit_identical_to_rowshard():
+    """An 8x1 grid has a trivial gene axis and (at this width, unblocked
+    statistics) reduces exactly like the 1-D mesh — pinning the shared
+    convergence arithmetic bit-for-bit."""
+    X = _fixture()
+    mesh = mesh_grid2d(cell_shards=8, gene_shards=1)
+    (H1, W1, e1), (H2, W2, e2) = _parity(X, 3, mesh, "frobenius")
+    np.testing.assert_array_equal(W1, W2)
+    np.testing.assert_array_equal(H1, H2)
+    assert e1 == e2
+
+
+def test_grid_ragged_gene_shards():
+    """Gene count not divisible by the gene axis: padded columns are
+    masked to exact zero in W and trimmed on return; the solve lands in
+    the 1-D path's objective band. (The band is wider than the aligned
+    cases: the init draw happens at the padded width, so the ragged
+    grid runs a DIFFERENT random init than the 1-D path — statistically
+    equivalent, not trajectory-matched.)"""
+    X = _fixture(96, 49)
+    mesh = mesh_grid2d(cell_shards=4, gene_shards=2)
+    (H1, W1, e1), (H2, W2, e2) = _parity(X, 3, mesh, "frobenius")
+    assert W2.shape == (3, 49)
+    assert np.isfinite(W2).all() and (W2 >= 0).all()
+    assert abs(e1 - e2) / abs(e1) < 2e-2
+
+
+def test_grid_overlap_toggle_bit_identical(monkeypatch):
+    """CNMF_TPU_GRID_OVERLAP=0 serializes each block's reduce before the
+    next gemm — same partials, same order, so results are BIT-identical
+    to the overlapped dispatch (blocking engaged: local tiles >= 64)."""
+    X = _fixture(256, 256, seed=2)
+    mesh = mesh_grid2d(cell_shards=2, gene_shards=4)
+    assert grid_blocks(256 // 4) == 4  # blocking really engaged
+    H_a, W_a, e_a = nmf_fit_grid2d(X, 4, mesh, seed=7, n_passes=6)
+    monkeypatch.setenv("CNMF_TPU_GRID_OVERLAP", "0")
+    H_b, W_b, e_b = nmf_fit_grid2d(X, 4, mesh, seed=7, n_passes=6)
+    np.testing.assert_array_equal(W_a, W_b)
+    np.testing.assert_array_equal(H_a, H_b)
+    assert e_a == e_b
+
+
+def test_grid_kl_newton_recipe():
+    """The Diagonalized-Newton KL lane runs on the grid and lands near
+    the 1-D dna solve; a dna recipe on a non-KL grid solve raises."""
+    X = _fixture(128, 64, seed=3)
+    rec = resolve_recipe(1.0, "rowshard", accel="1", kl_newton=True,
+                         n=128, g=64, k=3)
+    assert rec.kl_newton
+    mesh = mesh_grid2d(cell_shards=4, gene_shards=2)
+    mesh1 = Mesh(np.asarray(jax.devices()), ("cells",))
+    H1, W1, e1 = nmf_fit_rowsharded(X, 3, mesh1, "kullback-leibler",
+                                    seed=5, n_passes=6, recipe=rec)
+    H2, W2, e2 = nmf_fit_grid2d(X, 3, mesh, "kullback-leibler",
+                                seed=5, n_passes=6, recipe=rec)
+    assert abs(e1 - e2) / abs(e1) < 5e-3
+    with pytest.raises(ValueError, match="beta=1"):
+        nmf_fit_grid2d(X, 3, mesh, "frobenius", recipe=rec)
+
+
+def test_grid_rejects_sketch_and_nonrandom_init():
+    X = _fixture(64, 32)
+    mesh = mesh_grid2d(cell_shards=4, gene_shards=2)
+    sk = resolve_recipe(1.0, "rowshard", sketch="1", n=64, g=32, k=3)
+    with pytest.raises(ValueError, match="sketch"):
+        nmf_fit_grid2d(X, 3, mesh, "kullback-leibler", recipe=sk)
+    with pytest.raises(ValueError, match="init"):
+        nmf_fit_grid2d(X, 3, mesh, init="nndsvd")
+
+
+def test_measure_collectives_reports():
+    X = _fixture(512, 256, seed=4)
+    mesh = mesh_grid2d(cell_shards=4, gene_shards=2)
+    Xd, _, _ = stage_x_grid(X, mesh)
+    probe = measure_collectives(Xd, 4, mesh, beta=2.0, repeats=3)
+    for key in ("coll_chained_s", "coll_free_s", "overlap_fraction",
+                "pass_overlap_s", "pass_serial_s",
+                "pass_hidden_fraction", "nbytes_per_pass"):
+        assert key in probe
+    assert probe["coll_chained_s"] > 0 and probe["nbytes_per_pass"] > 0
+    assert 0.0 <= probe["overlap_fraction"] <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# degraded-mesh re-planning on the grid
+# ---------------------------------------------------------------------------
+
+def test_plan_degraded_mesh_grid_axes():
+    mesh = mesh_grid2d(cell_shards=4, gene_shards=2)
+    lost = list(mesh.devices.flat)[-2:]
+    new = elastic.plan_degraded_mesh(mesh, lost)
+    assert new.axis_names == ("cells", "genes")
+    assert int(np.prod(new.devices.shape)) == 6
+    assert new.devices.shape == (3, 2)
+
+
+def _prepare_mini(tmp_path, name, n_iter=2):
+    counts = np.random.default_rng(5).binomial(
+        40, 0.02, size=(60, 100)).astype(np.float64)
+    counts[counts.sum(axis=1) == 0, 0] = 1.0
+    df = pd.DataFrame(counts, index=[f"c{i}" for i in range(60)],
+                      columns=[f"g{j}" for j in range(100)])
+    counts_fn = str(tmp_path / f"{name}_counts.df.npz")
+    save_df_to_npz(df, counts_fn)
+    obj = cNMF(output_dir=str(tmp_path), name=name)
+    obj.prepare(counts_fn, components=[3], n_iter=n_iter, seed=4,
+                num_highvar_genes=50, batch_size=64, max_NMF_iter=50)
+    return obj
+
+
+def test_factorize_grid2d_pipeline(tmp_path):
+    """factorize(mesh_shape='grid2d') produces the standard artifact
+    contract, grid provenance, and consensus runs downstream."""
+    obj = _prepare_mini(tmp_path, "g2d", n_iter=4)
+    obj.factorize(mesh_shape="grid2d")
+    for it in range(4):
+        assert os.path.exists(obj.paths["iter_spectra"] % (3, it))
+    obj.combine()
+    obj.consensus(3, density_threshold=2.0, show_clustering=False,
+                  build_ref=False)
+    assert os.path.exists(obj.paths["consensus_spectra"] % (3, "2_0"))
+    import yaml
+
+    prov = yaml.safe_load(open(obj.paths["factorize_provenance"] % 0))
+    assert prov["engaged_path"] == "grid2d"
+    assert prov["effective_params"]["mesh_shape"] == [4, 2]
+    assert "overlap" in prov["effective_params"]
+
+
+def test_factorize_grid2d_hostloss_remesh(tmp_path, monkeypatch):
+    """A device loss at a grid pass boundary re-plans the (cells x
+    genes) grid over the survivors, re-stages, and completes from the
+    pass checkpoint — remesh + host_loss on the telemetry record."""
+    from cnmf_torch_tpu.utils.telemetry import (read_events,
+                                                validate_events_file)
+
+    obj = _prepare_mini(tmp_path, "g2dloss")
+    monkeypatch.setenv("CNMF_TPU_TELEMETRY", "1")
+    monkeypatch.setenv(faults.FAULT_SPEC_ENV,
+                       "hostloss:context=pass,after=1,count=2")
+    with pytest.warns(RuntimeWarning, match="continuing degraded"):
+        obj.factorize(mesh_shape="grid2d")
+    monkeypatch.delenv(faults.FAULT_SPEC_ENV)
+    for it in range(2):
+        spec = load_df_from_npz(obj.paths["iter_spectra"] % (3, it)).values
+        assert np.isfinite(spec).all() and (spec >= 0).all()
+    ev_path = os.path.join(str(tmp_path), "g2dloss", "cnmf_tmp",
+                           "g2dloss.events.jsonl")
+    validate_events_file(ev_path)
+    evs = list(read_events(ev_path))
+    kinds = [e["kind"] for e in evs if e["t"] == "fault"]
+    assert "host_loss" in kinds and "remesh" in kinds
+    remesh = next(e for e in evs if e["t"] == "fault"
+                  and e["kind"] == "remesh")
+    assert remesh["context"]["from_devices"] == 8
+    assert remesh["context"]["to_devices"] == 6
+    # the grid provenance + collective events survive the re-mesh
+    assert any(e["t"] == "collective" for e in evs)
+
+
+# ---------------------------------------------------------------------------
+# slab-looped consensus refit (ops.nmf.fit_h_slabbed)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("beta", [2.0, 1.0])
+def test_fit_h_slabbed_bit_identical(beta):
+    """Chunk-aligned slab blocks reproduce the resident fit_h refit
+    BIT-for-bit (same init stream, same chunk partition)."""
+    rng = np.random.default_rng(7)
+    n, g, k, chunk = 210, 40, 3, 32
+    X = rng.gamma(2.0, 1.0, size=(n, g)).astype(np.float32)
+    W = rng.gamma(1.0, 1.0, size=(k, g)).astype(np.float32) + 0.1
+    H_res = fit_h(X, W, chunk_size=chunk, beta=beta)
+
+    def blocks(rows_per):
+        for lo in range(0, n, rows_per):
+            hi = min(lo + rows_per, n)
+            yield lo, hi, X[lo:hi]
+
+    # one chunk per block AND several chunks per block (ragged tail)
+    for rows_per in (chunk, 3 * chunk):
+        H_slab = fit_h_slabbed(blocks(rows_per), n, W, chunk_size=chunk,
+                               beta=beta)
+        np.testing.assert_array_equal(H_res, H_slab)
+
+
+def test_fit_h_slabbed_rejects_misaligned_blocks():
+    X = np.ones((64, 8), np.float32)
+    W = np.ones((2, 8), np.float32)
+    with pytest.raises(ValueError, match="chunk"):
+        fit_h_slabbed([(0, 30, X[:30]), (30, 64, X[30:])], 64, W,
+                      chunk_size=32)
+
+
+def test_fit_h_slabbed_collect_hook():
+    rng = np.random.default_rng(1)
+    X = rng.gamma(2.0, 1.0, size=(64, 8)).astype(np.float32)
+    W = rng.random((2, 8)).astype(np.float32) + 0.1
+    seen = []
+    H = fit_h_slabbed([(0, 32, X[:32]), (32, 64, X[32:])], 64, W,
+                      chunk_size=32,
+                      collect=lambda lo, hi, xb, hb: seen.append(
+                          (lo, hi, xb.shape, hb.shape)))
+    assert seen == [(0, 32, (32, 8), (32, 2)),
+                    (32, 64, (32, 8), (32, 2))]
+    assert H.shape == (64, 2)
