@@ -151,6 +151,14 @@ def build_parser() -> argparse.ArgumentParser:
                              "by default quick multi-K scans (>=4 Ks, <=32 "
                              "replicates per K) run as one packed K_max "
                              "program with bit-identical spectra")
+    parser.add_argument("--store-uri", type=str, default=None,
+                        help="[all] Shard-store transport (sets "
+                             "CNMF_TPU_STORE_URI for this run and every "
+                             "spawned worker): unset "
+                             "= local paths, file:///base relocates the "
+                             "store, http(s)://host/prefix streams it from "
+                             "an object store with retry/hedge/cache fault "
+                             "containment")
     parser.add_argument("--engine", type=str, default="subprocess",
                         choices=["subprocess", "multihost"],
                         help="[run_parallel] How factorize workers run: "
@@ -257,6 +265,14 @@ def main(argv=None):
                     ("--components/-k", args.components)) if val is None]
         if missing:
             parser.error(f"{args.command} requires {' and '.join(missing)}")
+
+    if getattr(args, "store_uri", None):
+        # the flag is sugar for the knob: exported here so this process,
+        # run_parallel's spawned workers, and the multihost engine's
+        # subprocesses all resolve the same backend
+        from .utils.storebackend import STORE_URI_ENV
+
+        os.environ[STORE_URI_ENV] = args.store_uri
 
     # pod-simulation hook (set by the multihost launcher engine): force N
     # virtual CPU devices BEFORE the backend initializes. Env vars are too

@@ -545,6 +545,11 @@ def _clean_run_dir(run_dir: str):
                     os.path.join("cnmf_tmp", "*.tmp-*"),
                     os.path.join("cnmf_tmp", "*.norm_counts.store",
                                  "*.tmp-*"),
+                    # the remote backend's read-through cache (ISSUE 15)
+                    # is a re-fetchable optimization, not an artifact:
+                    # sweep entries, digest sidecars, and temp orphans
+                    os.path.join("cnmf_tmp", "*.norm_counts.store.cache",
+                                 "*"),
                     "*.tmp-*"):
         for f in glob.glob(os.path.join(run_dir, pattern)):
             os.remove(f)

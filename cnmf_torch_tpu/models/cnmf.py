@@ -409,7 +409,7 @@ class cNMF:
         if shardstore.ooc_mode() == "0":
             return None
         store, _reason = shardstore.probe_shard_store(
-            self.paths["shard_store"])
+            self.paths["shard_store"], events=self._events)
         return store
 
     def _read_norm_counts(self, store=None):
@@ -833,6 +833,8 @@ class cNMF:
                          "store_bytes": int(store.store_bytes),
                          "format": store.format,
                          "rows": int(store.n_rows),
+                         "backend": getattr(getattr(store, "backend", None),
+                                            "kind", "local"),
                          "h5ad_present": os.path.exists(
                              self.paths["normalized_counts"])})
 
@@ -1553,7 +1555,7 @@ class cNMF:
 
         from ..parallel.streaming import (ShardStallError, ShardUploadError,
                                           StreamStats)
-        from ..utils.shardstore import TornShardError
+        from ..utils.shardstore import RemoteStoreError, TornShardError
         from ..runtime import checkpoint as ckpt_mod
         from ..runtime import elastic, faults, resilience
 
@@ -1632,18 +1634,22 @@ class cNMF:
                                                       events=self._events,
                                                       liveness=heartbeat)
             except (ShardUploadError, ShardStallError,
-                    TornShardError) as exc:
-                # exhausted/stalled shards (and store slabs that failed
-                # digest validation past the retry budget) land in the
-                # PR-4 ledger before the abort: the staged array cannot
-                # be completed, so there is no degraded mode here — but
-                # the audit trail (and the launcher's respawn, which
-                # re-stages) must see WHY the worker died
+                    TornShardError, RemoteStoreError) as exc:
+                # exhausted/stalled shards, store slabs that failed
+                # digest validation past the retry budget, and a remote
+                # store down past the transport budget with no cached
+                # copy all land in the PR-4 ledger before the abort: the
+                # staged array cannot be completed, so there is no
+                # degraded mode here — but the audit trail (and the
+                # launcher's respawn, which re-stages) must see WHY the
+                # worker died
                 guard.record_shard_fault(
                     "shard_stall" if isinstance(exc, ShardStallError)
                     else ("shard_read_torn"
                           if isinstance(exc, TornShardError)
-                          else "shard_upload_failed"),
+                          else ("remote_store"
+                                if isinstance(exc, RemoteStoreError)
+                                else "shard_upload_failed")),
                     {"stage": "rowshard_stage_x", "error": str(exc)})
                 guard.finalize()
                 raise
@@ -2569,7 +2575,9 @@ class cNMF:
         import time as _time
 
         from ..utils.shardstore import host_matrix_bytes, ooc_budget_bytes
+        from ..utils.storebackend import backend_counter_snapshot
 
+        bk_before = backend_counter_snapshot(store)
         n, g = store.shape
         chunk_size = int(min(int(chunk_size), max(n, 1)))
         chunk_bytes = max(chunk_size * g * 4, 1)
@@ -2607,6 +2615,10 @@ class cNMF:
             yield lo, hi, dense
         if stats is not None:
             stats.wall_s += _time.perf_counter() - t_start
+            # remote-store transport counters (ISSUE 15) accrued by this
+            # pass's slab reads ride the caller's stream event
+            stats.fold_store_counters(bk_before,
+                                      backend_counter_snapshot(store))
 
     def _refit_usage_streamed(self, store, spectra, collect=None,
                               context="consensus_stream"):

@@ -201,6 +201,16 @@ class StreamStats:
         self.disk_s = 0.0
         self.disk_nbytes = 0
         self.host_peak_bytes = 0
+        # remote store-backend transport counters (utils/storebackend.py),
+        # folded in as a snapshot delta around the staging pass — zero
+        # (and omitted from telemetry) on local-backend runs
+        self.store_remote = False
+        self.store_retries = 0
+        self.store_hedges = 0
+        self.store_hedges_won = 0
+        self.store_cache_hits = 0
+        self.store_cache_misses = 0
+        self.store_degraded = 0
 
     def add(self, host_prep_s=0.0, h2d_s=0.0, device_s=0.0, nbytes=0,
             slabs=0, disk_s=0.0, disk_nbytes=0):
@@ -212,6 +222,24 @@ class StreamStats:
             self.slabs += slabs
             self.disk_s += disk_s
             self.disk_nbytes += disk_nbytes
+
+    def fold_store_counters(self, before, after):
+        """Fold a remote backend's counter delta (snapshots from
+        ``storebackend.backend_counter_snapshot``, taken before/after
+        the pass) into this ledger; no-op when either side is None
+        (local backend)."""
+        if before is None or after is None:
+            return
+        with self._lock:
+            self.store_remote = True
+            for field, key in (("store_retries", "retries"),
+                               ("store_hedges", "hedges"),
+                               ("store_hedges_won", "hedges_won"),
+                               ("store_cache_hits", "cache_hits"),
+                               ("store_cache_misses", "cache_misses"),
+                               ("store_degraded", "degraded_reads")):
+                delta = int(after.get(key, 0)) - int(before.get(key, 0))
+                setattr(self, field, getattr(self, field) + max(delta, 0))
 
     @property
     def overlap_fraction(self) -> float:
@@ -373,13 +401,17 @@ def _retrying(prep, context: str | None, events, heartbeat: dict | None = None,
                 raise
             except Exception as exc:
                 # a TornShardError already burned read_slab's OWN
-                # disk-retry ladder — re-running it here would square the
-                # retries and misreport disk corruption as a transfer
-                # fault (ShardUploadError). Lazy type lookup: shardstore
-                # imports this module.
-                from ..utils.shardstore import TornShardError
+                # disk-retry ladder, and a RemoteStoreError already
+                # exhausted the network transport's retry/backoff budget
+                # (utils/storebackend.py) — re-running either here would
+                # square the retries and misreport the failure as a
+                # transfer fault (ShardUploadError). Lazy type lookup
+                # keeps this jax-heavy module importable without the
+                # store layer loaded.
+                from ..utils.shardstore import (RemoteStoreError,
+                                               TornShardError)
 
-                if isinstance(exc, TornShardError):
+                if isinstance(exc, (TornShardError, RemoteStoreError)):
                     raise
                 attempt += 1
                 ctx = {"context": str(context), "task": str(task),
@@ -902,9 +934,13 @@ def stream_store_sharded(cursor, sharding, dtype=jnp.float32, *,
     bit-identical to staging the in-memory matrix regardless of slab
     boundaries."""
     from ..utils.shardstore import ooc_budget_bytes
+    from ..utils.storebackend import backend_counter_snapshot
 
     t_wall = time.perf_counter()
     store = cursor.store
+    # remote-transport accounting: the pass's retries/hedges/cache hits
+    # are the counter delta across the staging window
+    bk_before = backend_counter_snapshot(store)
     base = cursor.rows[0]
     n_data = cursor.n_rows
     n_out = n_data + int(pad_rows)
@@ -1062,6 +1098,8 @@ def stream_store_sharded(cursor, sharding, dtype=jnp.float32, *,
         stats.add(device_s=time.perf_counter() - t0)
         stats.wall_s += time.perf_counter() - t_wall
         stats.host_peak_bytes = max(stats.host_peak_bytes, residency.peak)
+        stats.fold_store_counters(bk_before,
+                                  backend_counter_snapshot(store))
     return out
 
 

@@ -28,6 +28,17 @@ spec into injected faults at fixed hook points in the pipeline:
   * ``shard_read`` — corrupt the next shard-store slab READ (the
     reader's digest validation must detect it and re-read from disk —
     ``utils/shardstore.py``). Default ``limit`` 1.
+  * ``netflake`` / ``netslow`` / ``netdown`` / ``nettorn`` — network
+    faults injected at the remote store-backend seam
+    (``utils/storebackend.py``, hook context ``<method>:<object>``, e.g.
+    ``get:slab_00003.npz``): a transient connection error that the
+    retry/backoff ladder must heal (``netflake``, default ``limit`` 1),
+    a slow response (``netslow``, ``seconds=N`` default 2, ``limit`` 1)
+    that the hedged-read path must beat, a hard outage (``netdown``,
+    UNBOUNDED by default — every matching request fails until the spec
+    changes) that must degrade to the local cache or raise
+    ``RemoteStoreError``, and a torn response body (``nettorn``,
+    ``limit`` 1) the digest check must catch.
 
 Spec grammar (semicolon-separated clauses)::
 
@@ -67,12 +78,14 @@ __all__ = [
     "maybe_hostloss",
     "maybe_straggle",
     "maybe_shard_read",
+    "maybe_netfault",
 ]
 
 FAULT_SPEC_ENV = "CNMF_TPU_FAULT_SPEC"
 
 _KINDS = ("nonfinite", "kill", "torn", "upload", "stall", "hostloss",
-          "straggler", "shard_read")
+          "straggler", "shard_read", "netflake", "netslow", "netdown",
+          "nettorn")
 _CONTROL_KEYS = ("after", "limit", "once")
 
 
@@ -444,6 +457,53 @@ def maybe_shard_read(context=None, worker=None) -> bool:
         if _clause_fires(clause, context, worker, default_limit=1):
             return True
     return False
+
+
+def maybe_netfault(op=None, context=None) -> str | None:
+    """Network-fault hook at the remote store-backend seam
+    (``utils/storebackend.py``): called once per HTTP request BEFORE the
+    socket opens, with ``op`` the lowercased method (``get``/``put``/
+    ``head``/``delete``) and ``context`` the object name. The clause
+    ``context`` selector substring-matches the combined ``op:object``
+    string, so ``netdown:context=get:slab`` downs slab GETs while
+    manifest reads, HEAD probes, and listings stay up.
+
+      * ``netflake`` — raise ``ConnectionError`` (transient; the
+        retry/backoff ladder heals it). Default ``limit`` 1.
+      * ``netslow`` — sleep ``seconds`` (default 2) then proceed, the
+        deterministic tail-latency request a hedged read must beat.
+        Default ``limit`` 1.
+      * ``netdown`` — raise ``ConnectionError`` on EVERY matching
+        request (default limit unbounded): a hard outage that must end
+        in cache-degraded service or a named ``RemoteStoreError``.
+      * ``nettorn`` — return ``"tear"``: the backend flips a byte of the
+        response body it is about to hand back, so the shard reader's
+        content-digest validation must catch the damage and re-fetch.
+        Default ``limit`` 1.
+
+    Returns ``"tear"`` when the caller must corrupt the body, else None.
+    """
+    spec = active_spec()
+    if spec is None:
+        return None
+    import time
+
+    ctx = "%s:%s" % (op or "", context or "")
+    for clause in spec:
+        if clause.kind not in ("netflake", "netslow", "netdown", "nettorn"):
+            continue
+        limit = None if clause.kind == "netdown" else 1
+        if not _clause_fires(clause, ctx, None, default_limit=limit):
+            continue
+        if clause.kind == "netslow":
+            time.sleep(float(clause.params.get("seconds", 2.0)))
+            return None
+        if clause.kind == "nettorn":
+            return "tear"
+        raise ConnectionError(
+            "cnmf-tpu injected fault: %s (%s) — remote store unreachable"
+            % (clause.kind, ctx))
+    return None
 
 
 def maybe_fail(kind: str, **ctx) -> None:

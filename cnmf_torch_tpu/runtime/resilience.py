@@ -302,8 +302,11 @@ class ReplicateGuard:
         retries, stalled transfers) into the SAME ledger the replicate
         quarantines live in, so a degraded run's audit trail covers every
         recovery layer. ``kind`` is the fault class (``shard_upload_failed``
-        / ``shard_stall``); per-slab retry events are emitted by the
-        streaming engine itself."""
+        / ``shard_stall``, plus the store-read classes ``shard_read_torn``
+        for a slab that failed digest validation past the re-read budget
+        and ``remote_store`` for a remote object store down past the
+        transport retry budget with no cached copy, ISSUE 15); per-slab
+        retry events are emitted by the streaming engine itself."""
         rec = dict(context, kind=str(kind))
         self.shard_faults.append(rec)
         self._emit(str(kind), dict(context))

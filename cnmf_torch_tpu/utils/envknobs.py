@@ -129,10 +129,12 @@ REGISTRY: dict[str, Knob] = _knobs(
          "`dense` densifies slab-by-slab on host (auto on CPU backends, "
          "where XLA's scatter costs ~4× the memcpy it replaces)"),
     Knob("CNMF_TPU_SHARD_RETRIES", "int", "`2`",
-         "per-slab upload retry budget: a transient prep/transfer failure "
-         "retries with bounded backoff instead of failing the whole "
-         "staging call; exhausted slabs raise `ShardUploadError`. `0` "
-         "disables retries"),
+         "shard-LAYER retry budget, two scopes: per-slab upload retries in "
+         "the staging pipeline (exhausted slabs raise `ShardUploadError`) "
+         "and per-slab disk re-reads after a torn/digest-mismatched store "
+         "read (exhausted raises `TornShardError`). Network-transport "
+         "retries are separate (`CNMF_TPU_STORE_RETRIES`). `0` disables "
+         "retries"),
     Knob("CNMF_TPU_SHARD_BACKOFF_S", "float", "`0.1`",
          "shard-retry backoff base: attempt N waits `base * 2^(N-1)` "
          "seconds"),
@@ -163,6 +165,45 @@ REGISTRY: dict[str, Knob] = _knobs(
          "re-read per pass — solver-tolerance, not bit-identical); `0` "
          "derives from reported device memory (effectively resident on "
          "backends without memory stats)"),
+    # -- remote store transport (utils/storebackend.py) --------------------
+    Knob("CNMF_TPU_STORE_URI", "str", "unset",
+         "shard-store transport: unset/empty keeps today's POSIX paths; "
+         "`file:///base/dir` relocates the store under that directory "
+         "(still the local backend); `http(s)://host:port/prefix` speaks "
+         "GET/PUT/HEAD/DELETE against an object-store endpoint (the "
+         "in-repo `utils/netstore.py` fixture stands in for GCS) with "
+         "retry/backoff/hedging/read-through caching — staging is pinned "
+         "bit-identical between backends"),
+    Knob("CNMF_TPU_STORE_RETRIES", "int", "`3`",
+         "network-transport retry budget per store operation (GET/PUT/"
+         "HEAD/LIST/DELETE): transient network faults retry with bounded "
+         "exponential backoff + deterministic jitter; exhausted "
+         "operations raise `RemoteStoreError` (or degrade to the local "
+         "cache where a digest-valid copy exists). Distinct from the "
+         "shard-layer `CNMF_TPU_SHARD_RETRIES`. `0` disables retries"),
+    Knob("CNMF_TPU_STORE_BACKOFF_S", "float", "`0.05`",
+         "store-retry backoff base: attempt N waits "
+         "`base * 2^(N-1) * (1 + 0.5*jitter)` seconds, jitter derived "
+         "deterministically from (object, attempt) so chaos runs replay "
+         "exactly"),
+    Knob("CNMF_TPU_STORE_TIMEOUT_S", "float", "`30`",
+         "per-request socket timeout for slab transfers; metadata "
+         "operations (manifest/HEAD/LIST) use the tighter "
+         "`max(1, timeout/4)` so a down remote is detected at metadata "
+         "speed, not slab speed"),
+    Knob("CNMF_TPU_STORE_HEDGE_S", "float", "`0` (off)",
+         "hedged reads for tail latency: a store GET still unanswered "
+         "after this many seconds issues a second identical request and "
+         "the first valid response wins (the loser is abandoned, its "
+         "daemon thread drains harmlessly); `0` never hedges"),
+    Knob("CNMF_TPU_STORE_CACHE_BYTES", "int", "`1<<30`",
+         "read-through local slab cache budget for remote stores (LRU by "
+         "recency, entries landed via `atomic_artifact` + sha1 sidecar "
+         "and revalidated on every hit; `<store>.cache/` beside the "
+         "store path, swept by `--clean` and the fresh-run orphan "
+         "sweep): warm entries serve repeat reads without touching the "
+         "network and let a fully-down remote degrade gracefully. `0` "
+         "disables caching"),
     # -- 2-D (cells x genes) grid (parallel/grid2d.py) ---------------------
     Knob("CNMF_TPU_GRID_OVERLAP", "flag", "`1`",
          "compute-overlapped grid collectives (MPI-FAUN): each statistics "

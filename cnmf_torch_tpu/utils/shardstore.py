@@ -43,6 +43,17 @@ Knobs (``utils/envknobs.py`` registry): ``CNMF_TPU_OOC`` (auto|0|1),
 ``CNMF_TPU_OOC_SHARD_BYTES`` (per-device resident-shard budget gating the
 slab-looped solver pass, ``parallel/rowshard.py``).
 
+All reads and writes flow through a :class:`~.storebackend.StoreBackend`
+transport (``utils/storebackend.py``): with ``CNMF_TPU_STORE_URI`` unset
+that is the POSIX ``LocalBackend``, byte-for-byte today's behavior; an
+``http(s)://`` URI swaps in the ``RemoteBackend`` (retry/backoff,
+hedged reads, read-through cache, graceful degradation) with the digest
+validation, manifest-last protocol, and torn-read healing here — above
+the seam — carrying over unchanged. An unhealable remote object raises
+:class:`~.storebackend.RemoteStoreError` (re-exported here), which
+deliberately ESCAPES the torn-read retry ladder and propagates to the
+resilience ledger like :class:`TornShardError` does.
+
 Kept jax-free so the writer/reader can run in IO-only contexts (prepare,
 ``--clean`` sweeps, report tooling) without backend initialization.
 """
@@ -50,6 +61,7 @@ Kept jax-free so the writer/reader can run in IO-only contexts (prepare,
 from __future__ import annotations
 
 import hashlib
+import io
 import json
 import os
 import threading
@@ -59,22 +71,26 @@ import zipfile
 import numpy as np
 import scipy.sparse as sp
 
-from .anndata_lite import atomic_artifact
 from .envknobs import env_int, env_str
+from .storebackend import (RemoteStoreError, resolve_backend,
+                           store_cache_dir)
 
 __all__ = [
     "OOC_ENV",
     "OOC_BUDGET_ENV",
     "OOC_SLAB_ROWS_ENV",
     "OOC_SHARD_BYTES_ENV",
+    "SHARD_RETRIES_ENV",
     "STORE_SCHEMA",
     "TornShardError",
+    "RemoteStoreError",
     "ShardStore",
     "SlabCursor",
     "HostResidency",
     "ooc_mode",
     "ooc_budget_bytes",
     "ooc_shard_bytes",
+    "shard_reread_retries",
     "host_matrix_bytes",
     "host_rss_peak_bytes",
     "write_shard_store",
@@ -87,6 +103,7 @@ OOC_ENV = "CNMF_TPU_OOC"
 OOC_BUDGET_ENV = "CNMF_TPU_OOC_BUDGET_BYTES"
 OOC_SLAB_ROWS_ENV = "CNMF_TPU_OOC_SLAB_ROWS"
 OOC_SHARD_BYTES_ENV = "CNMF_TPU_OOC_SHARD_BYTES"
+SHARD_RETRIES_ENV = "CNMF_TPU_SHARD_RETRIES"
 
 STORE_SCHEMA = 1
 
@@ -130,6 +147,16 @@ def ooc_shard_bytes() -> int:
     site (``parallel/rowshard.py``) — effectively "stage resident" on
     backends that report no stats (CPU tests)."""
     return env_int(OOC_SHARD_BYTES_ENV, 0, lo=0)
+
+
+def shard_reread_retries() -> int:
+    """Shard-layer re-read budget for a torn/digest-mismatched slab read
+    (``CNMF_TPU_SHARD_RETRIES``, default 2; ``0`` disables). The same
+    knob also bounds the staging pipeline's per-slab upload retries
+    (``parallel/streaming.py``) — the two shard-layer scopes; network-
+    TRANSPORT retries are governed separately by
+    ``CNMF_TPU_STORE_RETRIES`` (``utils/storebackend.py``)."""
+    return env_int(SHARD_RETRIES_ENV, 2, lo=0)
 
 
 def host_matrix_bytes(X) -> int:
@@ -215,23 +242,26 @@ def _auto_slab_rows(g: int, itemsize: int, budget: int) -> int:
 
 
 def write_shard_store(store_dir, X, obs_names=None, var_names=None,
-                      slab_rows: int | None = None, events=None) -> dict:
+                      slab_rows: int | None = None, events=None,
+                      backend=None) -> dict:
     """Write the row-slab shard store for matrix ``X`` under ``store_dir``.
 
     Layout: ``slab_NNNNN.npz`` per slab (CSR triplets ``data``/``indices``/
     ``indptr`` or a dense ``block``), ``names.npz`` (obs/var name arrays),
-    and ``manifest.json`` — every file via ``atomic_artifact``, manifest
+    and ``manifest.json`` — every object through the transport backend
+    (local puts via ``atomic_artifact``; remote puts retried), manifest
     LAST so readers only ever see complete stores. Values land as float32
     (the solve dtype; prepare's f64 moment accumulators never reach disk).
     Returns the manifest dict.
     """
     store_dir = os.fspath(store_dir)
-    os.makedirs(store_dir, exist_ok=True)
+    if backend is None:
+        backend = resolve_backend(store_dir)
     # a previous prepare's slabs are stale the moment this writer starts;
     # remove them up front so a shrinking slab count can't leave orphans
     # a future manifest never references (the manifest-last protocol makes
     # the store unopenable until this write completes)
-    _clear_store(store_dir)
+    _clear_backend(backend)
 
     fmt = "csr" if sp.issparse(X) else "dense"
     if fmt == "csr":
@@ -251,14 +281,16 @@ def write_shard_store(store_dir, X, obs_names=None, var_names=None,
         block = X[lo:hi]
         arrays = _slab_arrays(block, fmt)
         fn = "slab_%05d.npz" % i
-        path = os.path.join(store_dir, fn)
-        with atomic_artifact(path) as tmp:
-            with open(tmp, "wb") as f:
-                if fmt == "csr":
-                    np.savez(f, data=arrays[0], indices=arrays[1],
-                             indptr=arrays[2])
-                else:
-                    np.savez(f, block=arrays[0])
+        # serialize to memory, hand bytes to the transport (the npz
+        # bytes never touch disk non-atomically: the local backend
+        # lands them via atomic_artifact, remote puts are whole-object)
+        buf = io.BytesIO()
+        if fmt == "csr":
+            np.savez(buf, data=arrays[0], indices=arrays[1],  # cnmf-lint: disable=artifact-nonatomic
+                     indptr=arrays[2])
+        else:
+            np.savez(buf, block=arrays[0])  # cnmf-lint: disable=artifact-nonatomic
+        backend.put(fn, buf.getvalue(), op="slab", events=events)
         if fmt == "csr":
             nnz = int(block.nnz)
             row_nnz = np.diff(block.indptr)
@@ -280,17 +312,15 @@ def write_shard_store(store_dir, X, obs_names=None, var_names=None,
         if hi >= n:
             break
 
-    names_digest = None
-    names_path = os.path.join(store_dir, _NAMES)
-    with atomic_artifact(names_path) as tmp:
-        obs = np.asarray([] if obs_names is None
-                         else [str(s) for s in obs_names], dtype=object)
-        var = np.asarray([] if var_names is None
-                         else [str(s) for s in var_names], dtype=object)
-        with open(tmp, "wb") as f:
-            np.savez(f, obs=obs, var=var)
-        names_digest = _arrays_digest(
-            (obs.astype(str).astype("U"), var.astype(str).astype("U")))
+    obs = np.asarray([] if obs_names is None
+                     else [str(s) for s in obs_names], dtype=object)
+    var = np.asarray([] if var_names is None
+                     else [str(s) for s in var_names], dtype=object)
+    buf = io.BytesIO()
+    np.savez(buf, obs=obs, var=var)  # cnmf-lint: disable=artifact-nonatomic
+    backend.put(_NAMES, buf.getvalue(), op="meta", events=events)
+    names_digest = _arrays_digest(
+        (obs.astype(str).astype("U"), var.astype(str).astype("U")))
 
     from ..runtime.checkpoint import input_digest
 
@@ -319,16 +349,27 @@ def write_shard_store(store_dir, X, obs_names=None, var_names=None,
     # splicing two matrices' trajectories (runtime/checkpoint.py)
     manifest["store_digest"] = h.hexdigest()
 
-    with atomic_artifact(os.path.join(store_dir, _MANIFEST)) as tmp:
-        with open(tmp, "w") as f:
-            json.dump(manifest, f)
+    backend.put(_MANIFEST, json.dumps(manifest).encode("utf-8"),
+                op="manifest", events=events)
     if events is not None:
         events.emit("dispatch", decision="shard_store_write",
                     context={"slabs": len(slabs), "rows": int(n),
                              "format": fmt, "slab_rows": int(slab_rows),
+                             "backend": backend.kind,
                              "store_bytes": int(sum(s["raw_bytes"]
                                                     for s in slabs))})
     return manifest
+
+
+def _clear_backend(backend):
+    """Delete a previous store generation through the transport —
+    manifest FIRST, so a crash mid-clear leaves an unopenable store,
+    never a manifest referencing deleted slabs."""
+    stale = [s for s in backend.list()
+             if s == _MANIFEST or s == _NAMES or s.startswith("slab_")
+             or ".tmp-" in s]
+    for s in sorted(stale, key=lambda fn: fn != _MANIFEST):
+        backend.delete(s)
 
 
 def _clear_store(store_dir: str):
@@ -342,8 +383,22 @@ def _clear_store(store_dir: str):
 
 
 def remove_store(store_dir) -> None:
-    """Delete a store directory and its contents (stale store sweep)."""
+    """Delete a LOCAL store directory, its contents, and its read-through
+    cache (stale store sweep). Remote objects are not touched here — a
+    re-prepare clears them through the backend (:func:`_clear_backend`)
+    under the manifest-last protocol."""
     store_dir = os.fspath(store_dir)
+    cache_dir = store_cache_dir(store_dir)
+    if os.path.isdir(cache_dir):
+        for fn in os.listdir(cache_dir):
+            try:
+                os.unlink(os.path.join(cache_dir, fn))
+            except OSError:
+                pass
+        try:
+            os.rmdir(cache_dir)
+        except OSError:
+            pass
     if not os.path.isdir(store_dir):
         return
     _clear_store(store_dir)
@@ -355,19 +410,21 @@ def remove_store(store_dir) -> None:
 
 def sweep_store_temps(store_dir) -> int:
     """Remove orphaned atomic-write temp files inside a store directory
-    (killed writers leave pid-suffixed temps no reader ever trusts);
-    returns the count removed. Complete stores are left intact."""
+    AND its read-through cache (killed writers leave pid-suffixed temps
+    no reader ever trusts); returns the count removed. Complete stores
+    and digest-valid cache entries are left intact."""
     store_dir = os.fspath(store_dir)
-    if not os.path.isdir(store_dir):
-        return 0
     n = 0
-    for fn in os.listdir(store_dir):
-        if ".tmp-" in fn:
-            try:
-                os.unlink(os.path.join(store_dir, fn))
-                n += 1
-            except OSError:
-                pass
+    for d in (store_dir, store_cache_dir(store_dir)):
+        if not os.path.isdir(d):
+            continue
+        for fn in os.listdir(d):
+            if ".tmp-" in fn:
+                try:
+                    os.unlink(os.path.join(d, fn))
+                    n += 1
+                except OSError:
+                    pass
     return n
 
 
@@ -381,8 +438,10 @@ class ShardStore:
     digest (torn reads retry from disk). Thread-safe for concurrent
     reads (the streaming pipeline's disk-producer stage)."""
 
-    def __init__(self, store_dir: str, manifest: dict):
+    def __init__(self, store_dir: str, manifest: dict, backend=None):
         self.dir = store_dir
+        self.backend = backend if backend is not None \
+            else resolve_backend(store_dir)
         self.manifest = manifest
         self.shape = tuple(int(s) for s in manifest["shape"])
         self.format = str(manifest["format"])
@@ -418,9 +477,9 @@ class ShardStore:
     def _load_names(self):
         with self._names_lock:
             if self._names is None:
-                with np.load(os.path.join(self.dir,
-                                          self.manifest["names_file"]),
-                             allow_pickle=True) as f:
+                raw = self.backend.get(self.manifest["names_file"],
+                                       op="meta")
+                with np.load(io.BytesIO(raw), allow_pickle=True) as f:
                     obs = [str(s) for s in f["obs"]]
                     var = [str(s) for s in f["var"]]
                 want = self.manifest.get("names_digest")
@@ -432,8 +491,8 @@ class ShardStore:
                         raise TornShardError(
                             "%s: obs/var names digest mismatch (%s != %s) "
                             "— torn or tampered names file"
-                            % (os.path.join(self.dir,
-                                            self.manifest["names_file"]),
+                            % (self.backend.describe(
+                                self.manifest["names_file"]),
                                got, want))
                 self._names = (obs, var)
         return self._names
@@ -481,18 +540,24 @@ class ShardStore:
         ``shard_read`` chaos clause (``runtime/faults.py``) injects the
         corruption deterministically. ``residency`` (a
         :class:`HostResidency`) is charged with the slab's raw bytes —
-        the caller releases when the buffer is dropped."""
-        from ..parallel.streaming import shard_retries
+        the caller releases when the buffer is dropped.
 
+        On a remote backend this ladder sits ABOVE the transport's own
+        retry/backoff/hedging: a load that already exhausted the
+        network budget raises :class:`RemoteStoreError`, which is NOT
+        in the catch tuple below (re-reading a dead network is not
+        healing) and propagates to the resilience ledger instead."""
         from ..runtime import faults
 
         meta = self.slabs[i]
-        path = os.path.join(self.dir, meta["file"])
-        retries = shard_retries()
+        path = self.backend.describe(meta["file"])
+        retries = shard_reread_retries()
         attempt = 0
+        refresh = False
         while True:
             try:
-                arrays = self._load_arrays(path)
+                arrays = self._load_arrays(meta["file"], refresh=refresh,
+                                           events=events)
                 if faults.maybe_shard_read(context="slab:%d" % i):
                     # injected torn read: damage what we just loaded so
                     # the digest check below must catch it
@@ -509,6 +574,10 @@ class ShardStore:
             except (TornShardError, OSError, ValueError, KeyError,
                     zipfile.BadZipFile) as exc:
                 attempt += 1
+                # a failed validation must re-read AUTHORITATIVE bytes:
+                # bypass the read-through cache from here on (a fetched
+                # clean copy re-lands in the cache, healing it)
+                refresh = True
                 if events is not None:
                     try:
                         events.emit("fault", kind="shard_read_torn",
@@ -535,8 +604,10 @@ class ShardStore:
                 shape=(rows, self.n_genes))
         return arrays[0]
 
-    def _load_arrays(self, path):
-        with np.load(path, allow_pickle=False) as f:
+    def _load_arrays(self, name, refresh=False, events=None):
+        raw = self.backend.get(name, op="slab", refresh=refresh,
+                               events=events)
+        with np.load(io.BytesIO(raw), allow_pickle=False) as f:
             if self.format == "csr":
                 return (np.asarray(f["data"]), np.asarray(f["indices"]),
                         np.asarray(f["indptr"]))
@@ -642,15 +713,24 @@ class SlabCursor:
 # open / probe
 # ---------------------------------------------------------------------------
 
-def open_shard_store(store_dir) -> ShardStore:
+def open_shard_store(store_dir, backend=None, events=None) -> ShardStore:
     """Open + validate a store's manifest; :class:`TornShardError` on any
-    structural defect (slab digests are verified lazily per read)."""
+    structural defect (slab digests are verified lazily per read). Slab
+    presence is checked against ONE backend listing — no per-slab
+    filesystem probes, so remote stores validate without a filesystem
+    (and local opens do strictly fewer stat calls than before)."""
     store_dir = os.fspath(store_dir)
-    path = os.path.join(store_dir, _MANIFEST)
+    if backend is None:
+        backend = resolve_backend(store_dir)
+    path = backend.describe(_MANIFEST)
     try:
-        with open(path) as f:
-            manifest = json.load(f)
-    except (OSError, json.JSONDecodeError) as exc:
+        manifest = json.loads(
+            backend.get(_MANIFEST, op="manifest",
+                        events=events).decode("utf-8"))
+    except (OSError, ValueError) as exc:
+        # FileNotFoundError (local or HTTP 404) and JSONDecodeError both
+        # land here; RemoteStoreError deliberately does NOT — a down
+        # remote must fail loudly by name, not read as "no store"
         raise TornShardError(f"{path}: unreadable manifest ({exc})")
     if int(manifest.get("schema", -1)) != STORE_SCHEMA:
         raise TornShardError(
@@ -664,6 +744,7 @@ def open_shard_store(store_dir) -> ShardStore:
         raise TornShardError(
             f"{path}: unknown slab format {manifest['format']!r}")
     n = int(manifest["shape"][0])
+    present = set(backend.list(events=events))
     prev = 0
     for s in manifest["slabs"]:
         if int(s["row0"]) != prev or int(s["row1"]) <= int(s["row0"]):
@@ -671,24 +752,30 @@ def open_shard_store(store_dir) -> ShardStore:
                 f"{path}: slab row ranges are not a contiguous partition "
                 f"(slab {s.get('i')}: [{s.get('row0')}, {s.get('row1')}))")
         prev = int(s["row1"])
-        if not os.path.exists(os.path.join(store_dir, s["file"])):
+        if s["file"] not in present:
             raise TornShardError(
                 f"{path}: slab file {s['file']!r} is missing")
     if prev != n and not (n == 0 and not manifest["slabs"]):
         raise TornShardError(
             f"{path}: slabs cover {prev} rows, manifest says {n}")
-    return ShardStore(store_dir, manifest)
+    return ShardStore(store_dir, manifest, backend=backend)
 
 
-def probe_shard_store(store_dir):
+def probe_shard_store(store_dir, events=None):
     """``(store, None)`` when present AND valid, ``(None, 'missing')``
     when absent, else ``(None, reason)`` — callers treat anything
     non-valid as "no store" (the h5ad path still exists on the default
-    double-write mode)."""
+    double-write mode). A remote endpoint that is DOWN (vs merely
+    holding no store) raises :class:`RemoteStoreError` instead — an
+    operator who configured ``CNMF_TPU_STORE_URI`` gets a named
+    transport failure, never a silent fallback (the exists() probe
+    itself degrades to the local cache when one is warm)."""
     store_dir = os.fspath(store_dir)
-    if not os.path.exists(os.path.join(store_dir, _MANIFEST)):
+    backend = resolve_backend(store_dir)
+    if not backend.exists(_MANIFEST, events=events):
         return None, "missing"
     try:
-        return open_shard_store(store_dir), None
+        return open_shard_store(store_dir, backend=backend,
+                                events=events), None
     except TornShardError as exc:
         return None, str(exc)

@@ -1,0 +1,628 @@
+"""Shard-store transport backends: local POSIX vs remote object store.
+
+ROADMAP item 3(b)'s last gap: ``ShardStore`` (``utils/shardstore.py``)
+reads slabs with raw ``open``/``np.load`` on joined paths, so
+prepare-once-read-anywhere only works over a shared filesystem.
+Production atlases live in object stores, where the dominant failure
+mode is not a torn file but a flaky network — the distributed-ingest
+setting of arXiv 2202.09518 and the data-distribution layer MPI-FAUN
+assumes (arXiv 1609.09154). This module is the transport seam:
+
+  * :class:`StoreBackend` — the five-verb contract (``get``/``put``/
+    ``exists``/``list``/``delete``) the shard store reads and writes
+    through. Digest validation, the manifest-last protocol, and
+    torn-read healing all stay ABOVE this seam, unchanged.
+  * :class:`LocalBackend` — today's POSIX paths, byte-for-byte: reads
+    are plain ``open``, writes land via ``atomic_artifact``. With
+    ``CNMF_TPU_STORE_URI`` unset this is the only code that runs.
+  * :class:`RemoteBackend` — HTTP GET/PUT/HEAD/DELETE against an
+    object-store endpoint (the in-repo ``utils/netstore.py`` fixture
+    stands in for GCS). Robustness is the headline: per-operation-class
+    timeouts, bounded exponential backoff with DETERMINISTIC jitter
+    (chaos runs replay exactly), hedged reads for tail latency
+    (``CNMF_TPU_STORE_HEDGE_S``), and a crash-safe read-through local
+    slab cache (LRU under ``CNMF_TPU_STORE_CACHE_BYTES``, entries landed
+    via ``atomic_artifact`` + sha1 sidecar, revalidated on every hit).
+
+Degradation contract: transient faults heal invisibly (telemetry
+``fault`` events, kind ``store_net``); a fully-down remote serves
+digest-valid cached objects with a LOUD once-per-run warning; an
+object that can neither be fetched nor served from cache raises
+:class:`RemoteStoreError` — deliberately a ``RuntimeError`` and NOT an
+``OSError``, so it escapes the shard reader's torn-read retry ladder
+(those re-reads would hit the same dead network) and propagates to the
+resilience ledger / launcher respawn like ``TornShardError`` does.
+
+Stdlib-only (urllib, no jax/numpy) so IO-layer callers import it for
+free, matching ``shardstore.py``'s own constraint.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+import warnings
+
+from .anndata_lite import atomic_artifact
+from .envknobs import env_float, env_int, env_str
+
+__all__ = [
+    "STORE_URI_ENV",
+    "STORE_RETRIES_ENV",
+    "STORE_BACKOFF_ENV",
+    "STORE_TIMEOUT_ENV",
+    "STORE_HEDGE_ENV",
+    "STORE_CACHE_ENV",
+    "RemoteStoreError",
+    "StoreBackend",
+    "LocalBackend",
+    "RemoteBackend",
+    "resolve_backend",
+    "store_cache_dir",
+    "backend_counter_snapshot",
+    "backoff_delay",
+    "store_retries",
+    "store_backoff_s",
+    "store_timeout_s",
+    "store_hedge_s",
+    "store_cache_bytes",
+]
+
+STORE_URI_ENV = "CNMF_TPU_STORE_URI"
+STORE_RETRIES_ENV = "CNMF_TPU_STORE_RETRIES"
+STORE_BACKOFF_ENV = "CNMF_TPU_STORE_BACKOFF_S"
+STORE_TIMEOUT_ENV = "CNMF_TPU_STORE_TIMEOUT_S"
+STORE_HEDGE_ENV = "CNMF_TPU_STORE_HEDGE_S"
+STORE_CACHE_ENV = "CNMF_TPU_STORE_CACHE_BYTES"
+
+
+class RemoteStoreError(RuntimeError):
+    """A remote store operation failed after exhausting its retry budget
+    and no digest-valid cached copy could serve it. NOT an ``OSError``:
+    the shard reader's torn-read ladder must not burn its disk-reread
+    budget against a dead network — this propagates to the resilience
+    ledger (kind ``remote_store``) and the launcher respawn instead."""
+
+
+def store_retries() -> int:
+    """Network-transport retry budget per store operation
+    (``CNMF_TPU_STORE_RETRIES``, default 3; 0 disables). Distinct from
+    the shard-layer ``CNMF_TPU_SHARD_RETRIES``."""
+    return env_int(STORE_RETRIES_ENV, 3, lo=0)
+
+
+def store_backoff_s() -> float:
+    """Backoff base seconds (``CNMF_TPU_STORE_BACKOFF_S``, default
+    0.05): attempt N waits ``base * 2^(N-1) * (1 + 0.5*jitter)``."""
+    return env_float(STORE_BACKOFF_ENV, 0.05, lo=0.0)
+
+
+def store_timeout_s() -> float:
+    """Per-request socket timeout for slab transfers
+    (``CNMF_TPU_STORE_TIMEOUT_S``, default 30); metadata operations use
+    the tighter ``max(1, timeout/4)``."""
+    return env_float(STORE_TIMEOUT_ENV, 30.0, lo=0.001)
+
+
+def store_hedge_s() -> float:
+    """Hedged-read trigger (``CNMF_TPU_STORE_HEDGE_S``): a GET still
+    unanswered after this many seconds issues a second identical
+    request and the first valid response wins. 0 (default) = off."""
+    return env_float(STORE_HEDGE_ENV, 0.0, lo=0.0)
+
+
+def store_cache_bytes() -> int:
+    """Read-through cache budget (``CNMF_TPU_STORE_CACHE_BYTES``,
+    default 1 GiB; 0 disables caching entirely)."""
+    return env_int(STORE_CACHE_ENV, 1 << 30, lo=0)
+
+
+def backoff_delay(name: str, attempt: int, base: float | None = None) -> float:
+    """Delay before retry ``attempt`` (1-based) of an operation on
+    ``name``: exponential in the attempt with a DETERMINISTIC jitter
+    derived from ``(name, attempt)`` — different objects decorrelate
+    (no thundering herd against a recovering endpoint) while any given
+    chaos run replays with identical timing."""
+    if base is None:
+        base = store_backoff_s()
+    seed = hashlib.sha1(("%s:%d" % (name, attempt)).encode()).digest()
+    jitter = int.from_bytes(seed[:4], "big") / 2.0 ** 32
+    return float(base) * (2.0 ** (attempt - 1)) * (1.0 + 0.5 * jitter)
+
+
+class _Counters:
+    """Thread-safe per-backend operation counters, folded into
+    ``StreamStats`` (``parallel/streaming.py``) and the telemetry
+    Ingestion table by snapshot-before/delta-after around each
+    streaming pass."""
+
+    FIELDS = ("retries", "healed", "hedges", "hedges_won",
+              "cache_hits", "cache_misses", "degraded_reads")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        for f in self.FIELDS:
+            setattr(self, f, 0)
+
+    def bump(self, key: str, n: int = 1):
+        with self._lock:
+            setattr(self, key, getattr(self, key) + int(n))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {f: int(getattr(self, f)) for f in self.FIELDS}
+
+
+def backend_counter_snapshot(obj):
+    """Counter snapshot of a store's backend when it is remote, else
+    None — the ``StreamStats.fold_store_counters`` input. Accepts a
+    ``ShardStore`` (has ``.backend``) or a backend directly."""
+    bk = getattr(obj, "backend", obj)
+    if bk is None or getattr(bk, "kind", "local") != "remote":
+        return None
+    return bk.counters.snapshot()
+
+
+class StoreBackend:
+    """Transport contract the shard store reads/writes through. Object
+    names are flat (``manifest.json``, ``names.npz``, ``slab_*.npz``);
+    the ``op`` hints (``slab``/``meta``/``manifest``) select the
+    timeout class on remote transports and are ignored locally."""
+
+    kind = "abstract"
+
+    def __init__(self):
+        self.counters = _Counters()
+
+    def get(self, name, *, op="slab", refresh=False, events=None) -> bytes:
+        raise NotImplementedError
+
+    def put(self, name, data, *, op="slab", events=None) -> None:
+        raise NotImplementedError
+
+    def exists(self, name, *, events=None) -> bool:
+        raise NotImplementedError
+
+    def list(self, *, events=None) -> list:
+        raise NotImplementedError
+
+    def delete(self, name, *, events=None) -> None:
+        raise NotImplementedError
+
+    def describe(self, name) -> str:
+        """Human-readable location of ``name`` for error messages."""
+        raise NotImplementedError
+
+
+class LocalBackend(StoreBackend):
+    """Today's POSIX store directory, byte-for-byte: ``get`` is a plain
+    read (the shard reader's digest/retry ladder above handles torn
+    reads exactly as before), ``put`` lands via ``atomic_artifact``."""
+
+    kind = "local"
+
+    def __init__(self, root: str):
+        super().__init__()
+        self.root = os.fspath(root)
+
+    def get(self, name, *, op="slab", refresh=False, events=None) -> bytes:
+        with open(os.path.join(self.root, name), "rb") as f:
+            return f.read()
+
+    def put(self, name, data, *, op="slab", events=None) -> None:
+        os.makedirs(self.root, exist_ok=True)
+        path = os.path.join(self.root, name)
+        with atomic_artifact(path) as tmp:
+            with open(tmp, "wb") as f:
+                f.write(bytes(data))
+
+    def exists(self, name, *, events=None) -> bool:
+        return os.path.exists(os.path.join(self.root, name))
+
+    def list(self, *, events=None) -> list:
+        if not os.path.isdir(self.root):
+            return []
+        return sorted(os.listdir(self.root))
+
+    def delete(self, name, *, events=None) -> None:
+        try:
+            os.unlink(os.path.join(self.root, name))
+        except FileNotFoundError:
+            pass
+
+    def describe(self, name) -> str:
+        return os.path.join(self.root, name)
+
+
+# once-per-run degraded-service warning, keyed by endpoint: a down
+# remote serving from cache must be LOUD exactly once, not once per slab
+_degraded_lock = threading.Lock()
+_degraded_warned: set = set()
+
+
+def _reset_degraded_warnings():
+    """Test/smoke hook: re-arm the once-per-run degraded warning."""
+    with _degraded_lock:
+        _degraded_warned.clear()
+
+
+class RemoteBackend(StoreBackend):
+    """HTTP object-store transport with fault containment (module
+    docstring has the full contract). ``base`` is the object prefix URL
+    (no trailing slash); ``cache_dir`` hosts the read-through cache
+    (None or ``CNMF_TPU_STORE_CACHE_BYTES=0`` disables it)."""
+
+    kind = "remote"
+
+    def __init__(self, base: str, cache_dir: str | None = None):
+        super().__init__()
+        self.base = base.rstrip("/")
+        self.cache_dir = None if cache_dir is None else os.fspath(cache_dir)
+
+    # -- request plumbing ----------------------------------------------
+
+    def _url(self, name) -> str:
+        return self.base + "/" + urllib.parse.quote(str(name))
+
+    def _timeout(self, op: str) -> float:
+        t = store_timeout_s()
+        # metadata (manifest/HEAD/LIST) answers in one RTT — a down
+        # remote should be detected at metadata speed, not slab speed
+        return t if op == "slab" else min(t, max(1.0, t / 4.0))
+
+    def _request(self, method, name, url, data=None, op="slab") -> bytes:
+        from ..runtime import faults
+
+        action = faults.maybe_netfault(op=method.lower(), context=str(name))
+        req = urllib.request.Request(url, data=data, method=method)
+        if data is not None:
+            req.add_header("Content-Type", "application/octet-stream")
+        with urllib.request.urlopen(req, timeout=self._timeout(op)) as resp:
+            body = resp.read()
+        if action == "tear" and body:
+            # injected torn response: flip one mid-body byte so the
+            # shard reader's content-digest validation must catch it
+            torn = bytearray(body)
+            torn[len(torn) // 2] ^= 0xFF
+            body = bytes(torn)
+        return body
+
+    def _emit_fault(self, events, context: dict):
+        if events is None:
+            return
+        try:
+            events.emit("fault", kind="store_net", context=context)
+        except Exception:
+            pass
+
+    def _warn_degraded(self, detail: str):
+        with _degraded_lock:
+            if self.base in _degraded_warned:
+                return
+            _degraded_warned.add(self.base)
+        warnings.warn(
+            "remote store %s is unreachable after retries; DEGRADED to "
+            "the local read-through cache (%s). Served objects are "
+            "digest-validated, but writes and uncached reads will fail "
+            "until the endpoint recovers" % (self.base, detail),
+            RuntimeWarning, stacklevel=3)
+
+    def _with_retries(self, fn, *, op, name, events=None):
+        retries = store_retries()
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                out = fn()
+            except urllib.error.HTTPError as exc:
+                # HTTPError FIRST (it is an OSError subclass): 404 is an
+                # answer, not a fault — no retry, caller semantics decide
+                if exc.code == 404:
+                    raise FileNotFoundError(
+                        "%s: object %r not found (HTTP 404)"
+                        % (self.base, str(name)))
+                err = exc
+            except (TimeoutError, OSError) as exc:
+                err = exc
+            else:
+                if attempt > 1:
+                    # transient fault healed invisibly — count it and
+                    # leave telemetry evidence (report: "recovered")
+                    self.counters.bump("healed")
+                    self._emit_fault(events, {
+                        "op": str(op), "object": str(name),
+                        "attempt": attempt, "healed": True})
+                return out
+            self._emit_fault(events, {
+                "op": str(op), "object": str(name),
+                "attempt": attempt, "error": str(err)})
+            if attempt > retries:
+                raise RemoteStoreError(
+                    "%s: %s %r failed after %d attempt(s): %s — remote "
+                    "store unreachable (tune %s / %s, or unset %s to go "
+                    "back to local paths)"
+                    % (self.base, str(op), str(name), attempt, err,
+                       STORE_RETRIES_ENV, STORE_TIMEOUT_ENV,
+                       STORE_URI_ENV)) from err
+            self.counters.bump("retries")
+            time.sleep(backoff_delay(str(name), attempt))
+
+    def _fetch(self, name, op) -> bytes:
+        """One GET, hedged: if the primary request is still unanswered
+        after ``CNMF_TPU_STORE_HEDGE_S``, race a second identical
+        request and take the first completion (on a failure, wait for
+        the other — a flaky primary must not waste a healthy hedge).
+        Requests run on ephemeral daemon threads; an abandoned loser
+        drains into an unreferenced queue and exits — nothing lingers,
+        nothing blocks interpreter shutdown."""
+        hedge = store_hedge_s()
+        url = self._url(name)
+        if hedge <= 0.0:
+            return self._request("GET", name, url, op=op)
+        import queue
+
+        results: queue.Queue = queue.Queue()
+
+        def _run(tag):
+            try:
+                results.put((tag, True,
+                             self._request("GET", name, url, op=op)))
+            except BaseException as exc:
+                results.put((tag, False, exc))
+
+        threading.Thread(target=_run, args=("primary",),
+                         name="cnmf-store-get", daemon=True).start()
+        try:
+            tag, ok, val = results.get(timeout=hedge)
+        except queue.Empty:
+            self.counters.bump("hedges")
+            threading.Thread(target=_run, args=("hedge",),
+                             name="cnmf-store-hedge", daemon=True).start()
+            tag, ok, val = results.get()
+            if not ok:
+                tag2, ok2, val2 = results.get()
+                if ok2:
+                    tag, ok, val = tag2, ok2, val2
+            if ok and tag == "hedge":
+                self.counters.bump("hedges_won")
+        if not ok:
+            raise val
+        return val
+
+    # -- the five verbs ------------------------------------------------
+
+    def _cache_on(self) -> bool:
+        return self.cache_dir is not None and store_cache_bytes() > 0
+
+    def get(self, name, *, op="slab", refresh=False, events=None) -> bytes:
+        """Read-through: a digest-valid cached entry serves without
+        touching the network; misses fetch (with retries + hedging) and
+        land in the cache. ``refresh=True`` bypasses the cache — the
+        shard reader sets it after a digest mismatch, so a poisoned
+        cache entry heals from the remote instead of looping."""
+        cache_on = self._cache_on()
+        if cache_on and not refresh:
+            data = self._cache_get(name)
+            if data is not None:
+                self.counters.bump("cache_hits")
+                with _degraded_lock:
+                    endpoint_down = self.base in _degraded_warned
+                if endpoint_down:
+                    # the endpoint already proved unreachable this run:
+                    # cache hits are now degraded service, not luck —
+                    # the report's "degraded reads" must count them
+                    self.counters.bump("degraded_reads")
+                return data
+            self.counters.bump("cache_misses")
+        try:
+            data = self._with_retries(
+                lambda: self._fetch(name, op),
+                op="get", name=name, events=events)
+        except RemoteStoreError:
+            if cache_on and not refresh:
+                # a copy may have landed since the miss (another worker
+                # shares the cache dir) — last chance before failing
+                data = self._cache_get(name)
+                if data is not None:
+                    self.counters.bump("degraded_reads")
+                    self._warn_degraded("read %r from cache" % str(name))
+                    self._emit_fault(events, {
+                        "op": "get", "object": str(name), "degraded": True})
+                    return data
+            raise
+        if cache_on:
+            self._cache_put(name, data)
+        return data
+
+    def put(self, name, data, *, op="slab", events=None) -> None:
+        """Retried PUT. Deliberately NOT write-through: reads must
+        exercise (and be accounted against) the network path, and the
+        cache only ever holds bytes the remote actually served."""
+        data = bytes(data)
+        self._with_retries(
+            lambda: self._request("PUT", name, self._url(name),
+                                  data=data, op=op),
+            op="put", name=name, events=events)
+
+    def exists(self, name, *, events=None) -> bool:
+        try:
+            self._with_retries(
+                lambda: self._request("HEAD", name, self._url(name),
+                                      op="meta"),
+                op="head", name=name, events=events)
+            return True
+        except FileNotFoundError:
+            return False
+        except RemoteStoreError:
+            if self._cache_on() and self._cache_get(name) is not None:
+                self._warn_degraded("presence of %r from cache" % str(name))
+                self._emit_fault(events, {
+                    "op": "head", "object": str(name), "degraded": True})
+                return True
+            raise
+
+    def list(self, *, events=None) -> list:
+        try:
+            body = self._with_retries(
+                lambda: self._request("GET", "list", self.base + "/?list=1",
+                                      op="meta"),
+                op="list", name="list", events=events)
+            return sorted(str(s) for s in json.loads(body.decode("utf-8")))
+        except RemoteStoreError:
+            if self._cache_on() and os.path.isdir(self.cache_dir):
+                names = sorted(
+                    urllib.parse.unquote(fn)
+                    for fn in os.listdir(self.cache_dir)
+                    if not fn.endswith(".sha1") and ".tmp-" not in fn)
+                self._warn_degraded("listing cached objects only")
+                self._emit_fault(events, {"op": "list", "degraded": True})
+                return names
+            raise
+
+    def delete(self, name, *, events=None) -> None:
+        try:
+            self._with_retries(
+                lambda: self._request("DELETE", name, self._url(name),
+                                      op="meta"),
+                op="delete", name=name, events=events)
+        except FileNotFoundError:
+            pass
+        finally:
+            self._cache_drop(name)
+
+    def describe(self, name) -> str:
+        return self._url(name)
+
+    # -- crash-safe read-through cache ---------------------------------
+    #
+    # one file per object under cache_dir (URL-quoted name) plus a
+    # ``.sha1`` sidecar holding the content digest. Both land via
+    # atomic_artifact, so a crash mid-write leaves only pid-suffixed
+    # temps (swept by --clean / the fresh-run orphan sweep) — a hit
+    # recomputes the sha1 and discards any entry that disagrees with
+    # its sidecar (partial write, bit rot, tampering), so the cache can
+    # NEVER serve bytes the remote did not once serve.
+
+    def _cache_path(self, name) -> str:
+        return os.path.join(self.cache_dir,
+                            urllib.parse.quote(str(name), safe=""))
+
+    def _cache_get(self, name):
+        path = self._cache_path(name)
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+            with open(path + ".sha1") as f:
+                want = f.read().strip()
+        except OSError:
+            return None
+        if hashlib.sha1(data).hexdigest() != want:
+            self._cache_drop(name)
+            return None
+        try:
+            os.utime(path)  # LRU recency bump
+        except OSError:
+            pass
+        return data
+
+    def _cache_put(self, name, data: bytes):
+        try:
+            os.makedirs(self.cache_dir, exist_ok=True)
+            path = self._cache_path(name)
+            with atomic_artifact(path + ".sha1") as tmp:
+                with open(tmp, "w") as f:
+                    f.write(hashlib.sha1(data).hexdigest())
+            with atomic_artifact(path) as tmp:
+                with open(tmp, "wb") as f:
+                    f.write(data)
+            self._evict(keep=os.path.basename(path))
+        except OSError:
+            # the cache is an optimization: a full/read-only disk must
+            # never fail the read that was trying to populate it
+            pass
+
+    def _cache_drop(self, name):
+        if self.cache_dir is None:
+            return
+        path = self._cache_path(name)
+        for p in (path, path + ".sha1"):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    def _evict(self, keep: str):
+        """LRU sweep to the byte budget (entry bytes; sidecars ride
+        along), oldest-read first, never evicting ``keep`` (the entry
+        just written must survive its own landing)."""
+        budget = store_cache_bytes()
+        entries = []
+        total = 0
+        for fn in os.listdir(self.cache_dir):
+            if fn.endswith(".sha1") or ".tmp-" in fn:
+                continue
+            p = os.path.join(self.cache_dir, fn)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            total += st.st_size
+            entries.append((st.st_mtime, st.st_size, fn))
+        if total <= budget:
+            return
+        for _, size, fn in sorted(entries):
+            if fn == keep:
+                continue
+            p = os.path.join(self.cache_dir, fn)
+            for victim in (p, p + ".sha1"):
+                try:
+                    os.unlink(victim)
+                except OSError:
+                    pass
+            total -= size
+            if total <= budget:
+                return
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+def store_cache_dir(store_dir) -> str:
+    """The read-through cache directory for a store path: beside it,
+    ``<store>.cache`` — matched by the launcher ``--clean`` sweep and
+    worker 0's fresh-run orphan sweep."""
+    return os.fspath(store_dir) + ".cache"
+
+
+def resolve_backend(store_dir, uri: str | None = None) -> StoreBackend:
+    """Backend for ``store_dir`` from the store URI (argument wins, else
+    ``CNMF_TPU_STORE_URI``): empty → :class:`LocalBackend` on the path
+    itself (byte-for-byte today's behavior); ``file:///base`` relocates
+    the store under ``base/<leaf>``; ``http(s)://host[:port]/prefix`` →
+    :class:`RemoteBackend` under ``prefix/<leaf>`` with the cache beside
+    ``store_dir``. ``<leaf>`` is the store directory's basename, so
+    multiple stores (a run's main store, the serving tier's second
+    open) namespace apart under one endpoint."""
+    store_dir = os.fspath(store_dir)
+    raw = env_str(STORE_URI_ENV, "") if uri is None else uri
+    raw = (raw or "").strip()
+    if not raw:
+        return LocalBackend(store_dir)
+    parts = urllib.parse.urlsplit(raw)
+    scheme = parts.scheme.lower()
+    leaf = os.path.basename(os.path.normpath(store_dir)) or "store"
+    if scheme == "file":
+        return LocalBackend(os.path.join(parts.path or "/", leaf))
+    if scheme in ("http", "https"):
+        base = raw.rstrip("/") + "/" + urllib.parse.quote(leaf)
+        return RemoteBackend(base, cache_dir=store_cache_dir(store_dir))
+    raise ValueError(
+        "%s=%r: expected empty (local paths), file:///base/dir, or "
+        "http(s)://host[:port]/prefix" % (STORE_URI_ENV, raw))

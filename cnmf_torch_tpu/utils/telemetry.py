@@ -80,7 +80,9 @@ EVENT_TYPES = {
     # from/to device counts), worker_steal (the fleet adopted a dead
     # worker's shard), straggler (deadline containment) — with the
     # (k, iter, seed, attempt) / (path, reason) / (context, task) /
-    # topology context needed to audit a degraded run
+    # topology context needed to audit a degraded run. ISSUE 15 adds
+    # store_net — remote object-store transport faults, whose context
+    # carries the op/object plus healed/degraded outcome flags
     "fault": {"kind", "context"},
     # mid-run checkpoint lifecycle (runtime/checkpoint.py): action in
     # {write, resume, discard} with the replicate identity + pass cursor
@@ -228,6 +230,10 @@ class EventLog:
         if not self.enabled or stats is None:
             return
         disk_s = float(getattr(stats, "disk_s", 0.0))
+        # remote-store transport counters (ISSUE 15) ride the same stream
+        # event, present only when the slabs travelled over the network
+        # backend — absence means the run never left the local filesystem
+        remote = bool(getattr(stats, "store_remote", False))
         self.emit(
             "stream", context=context, wall_s=round(stats.wall_s, 4),
             host_prep_s=round(stats.host_prep_s, 4),
@@ -242,7 +248,17 @@ class EventLog:
                            if disk_s > 0 else None),
             host_peak_bytes=(int(stats.host_peak_bytes)
                              if getattr(stats, "host_peak_bytes", 0) > 0
-                             else None))
+                             else None),
+            store_remote=(True if remote else None),
+            store_retries=(int(stats.store_retries) if remote else None),
+            store_hedges=(int(stats.store_hedges) if remote else None),
+            store_hedges_won=(int(stats.store_hedges_won)
+                              if remote else None),
+            store_cache_hits=(int(stats.store_cache_hits)
+                              if remote else None),
+            store_cache_misses=(int(stats.store_cache_misses)
+                                if remote else None),
+            store_degraded=(int(stats.store_degraded) if remote else None))
 
     # -- internals -----------------------------------------------------
 
@@ -565,10 +581,11 @@ def summarize_events(events: list[dict]) -> dict:
                      and e.get("decision") == "shard_store_write"), None)
     ooc_ev = next((e for e in events if e["t"] == "dispatch"
                    and e.get("decision") == "ooc_ingest"), None)
-    if disk_streams or store_ev or ooc_ev:
+    remote_streams = [e for e in streams if e.get("store_remote")]
+    if disk_streams or store_ev or ooc_ev or remote_streams:
         ing: dict = {}
         ctx = (ooc_ev or store_ev or {}).get("context") or {}
-        for key in ("slabs", "store_bytes", "format", "rows"):
+        for key in ("slabs", "store_bytes", "format", "rows", "backend"):
             if ctx.get(key) is not None:
                 ing[key] = ctx[key]
         if disk_streams:
@@ -587,6 +604,23 @@ def summarize_events(events: list[dict]) -> dict:
                      for e in disk_streams]
             if any(peaks):
                 ing["host_peak_bytes"] = max(peaks)
+        # remote-store transport health (ISSUE 15): transport retries,
+        # hedge engagement, read-through cache hit rate and degraded
+        # (cache-served-while-remote-down) reads, summed across every
+        # stream that rode the network backend
+        if remote_streams:
+            rem = {out: sum(int(e.get(field) or 0) for e in remote_streams)
+                   for out, field in (
+                       ("retries", "store_retries"),
+                       ("hedges", "store_hedges"),
+                       ("hedges_won", "store_hedges_won"),
+                       ("cache_hits", "store_cache_hits"),
+                       ("cache_misses", "store_cache_misses"),
+                       ("degraded_reads", "store_degraded"))}
+            looked = rem["cache_hits"] + rem["cache_misses"]
+            rem["cache_hit_rate"] = (round(rem["cache_hits"] / looked, 3)
+                                     if looked else 0.0)
+            ing["remote"] = rem
         if ing:
             summary["ingestion"] = ing
 
@@ -641,6 +675,7 @@ def summarize_events(events: list[dict]) -> dict:
     # carries the attempt's health) and the checkpoint lifecycle
     fault_by_kind: dict = {}
     retried = recovered = quarantined_n = 0
+    net_recovered = net_degraded = 0
     for e in events:
         if e["t"] != "fault":
             continue
@@ -653,10 +688,24 @@ def summarize_events(events: list[dict]) -> dict:
                 recovered += 1
         elif kind == "quarantine":
             quarantined_n += 1
+        elif kind == "store_net":
+            # remote-store transport outcomes (ISSUE 15): a retry ladder
+            # that eventually succeeded marks the event healed; a read
+            # served from the local cache with the remote down marks it
+            # degraded — plain store_net events are in-flight attempts
+            ctx = e.get("context")
+            if isinstance(ctx, dict):
+                if ctx.get("healed"):
+                    net_recovered += 1
+                if ctx.get("degraded"):
+                    net_degraded += 1
     if fault_by_kind:
         summary["faults"] = {"by_kind": dict(sorted(fault_by_kind.items())),
                              "retried": retried, "recovered": recovered,
                              "quarantined": quarantined_n}
+        if fault_by_kind.get("store_net"):
+            summary["faults"]["store_net_recovered"] = net_recovered
+            summary["faults"]["store_net_degraded"] = net_degraded
     ckpt_actions: dict = {}
     max_resume_pass = None
     for e in events:
@@ -879,6 +928,9 @@ def render_report(run_dir: str) -> str:
                 f"  {'store size':<28s} {_fmt_bytes(ing['store_bytes']):>10s}"
                 f"  ({ing.get('slabs', '?')} slab(s), "
                 f"{ing.get('format', '?')}, {ing.get('rows', '?')} rows)")
+        if ing.get("backend") is not None:
+            lines.append(f"  {'store backend':<28s}"
+                         f" {str(ing['backend']):>10s}")
         elif ing.get("slabs") is not None:
             lines.append(f"  {'slabs':<28s} {ing['slabs']:>10d}")
         if ing.get("disk_read_nbytes") is not None:
@@ -893,6 +945,18 @@ def render_report(run_dir: str) -> str:
             lines.append(
                 f"  {'host slab residency peak':<28s}"
                 f" {_fmt_bytes(ing['host_peak_bytes']):>10s}")
+        rem = ing.get("remote")
+        if rem:
+            lines.append(f"  {'remote cache hit rate':<28s}"
+                         f" {rem.get('cache_hit_rate', 0.0):>10.1%}")
+            lines.append(f"  {'remote transport retries':<28s}"
+                         f" {rem.get('retries', 0):>10d}")
+            lines.append(
+                f"  {'remote hedges won':<28s}"
+                f" {rem.get('hedges_won', 0):>10d}"
+                f"  (of {rem.get('hedges', 0)} hedged)")
+            lines.append(f"  {'remote degraded reads':<28s}"
+                         f" {rem.get('degraded_reads', 0):>10d}")
 
     if summary.get("collectives"):
         lines.append("")
@@ -956,6 +1020,11 @@ def render_report(run_dir: str) -> str:
                 "  retried %d (recovered %d), quarantined %d"
                 % (faults.get("retried", 0), faults.get("recovered", 0),
                    faults.get("quarantined", 0)))
+            if by_kind.get("store_net"):
+                lines.append(
+                    "  store_net: recovered %d, degraded reads %d"
+                    % (faults.get("store_net_recovered", 0),
+                       faults.get("store_net_degraded", 0)))
         ckpts = summary.get("checkpoints")
         if ckpts:
             actions = ckpts.get("actions", {})

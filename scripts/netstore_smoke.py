@@ -1,0 +1,267 @@
+"""Tier-1 remote-store network-fault smoke gate (scripts/verify_tier1.sh).
+
+Runs the mini pipeline against the in-repo HTTP object store
+(``utils/netstore.ObjectStoreServer``) with ``CNMF_TPU_STORE_URI``
+pointed at it, under each injected network fault class
+(``runtime/faults.py``), and pins the containment contract:
+
+  * ``netflake`` (transient connection failures): the transport retry
+    ladder heals invisibly — the run completes BIT-identical to the
+    local-store run, with ``store_net`` fault events (``healed``) on
+    the record;
+  * ``netslow`` (a stalled GET): the hedged second request wins — the
+    staging stream event reports ``store_hedges_won`` >= 1 and the run
+    stays bit-identical;
+  * ``netdown`` with a WARM cache: consensus completes served from the
+    digest-validated read-through cache, bit-identical, with exactly
+    one loud DEGRADED warning and ``degraded`` fault events;
+  * ``netdown`` with a COLD cache: factorize fails with the NAMED
+    ``RemoteStoreError`` (not a hang, not a generic crash), the
+    resilience ledger records kind ``remote_store``, and no transport
+    threads linger behind the failure;
+  * every emitted event validates against the telemetry schema.
+
+Exits nonzero on any violation, failing the gate.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import warnings
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["CNMF_TPU_TELEMETRY"] = "1"
+
+_KNOBS = ("CNMF_TPU_OOC", "CNMF_TPU_OOC_BUDGET_BYTES",
+          "CNMF_TPU_OOC_SLAB_ROWS", "CNMF_TPU_FAULT_SPEC",
+          "CNMF_TPU_STORE_URI", "CNMF_TPU_STORE_RETRIES",
+          "CNMF_TPU_STORE_BACKOFF_S", "CNMF_TPU_STORE_TIMEOUT_S",
+          "CNMF_TPU_STORE_HEDGE_S", "CNMF_TPU_STORE_CACHE_BYTES")
+
+N_CELLS, N_GENES_HV = 450, 100
+
+# every remote run streams from the store (slab rows pinned to the
+# refit chunk; 450/64 leaves a ragged 2-row final slab) with a tight
+# transport budget so injected faults resolve in seconds, not minutes
+_OOC_ENV = {"CNMF_TPU_OOC": "1", "CNMF_TPU_OOC_SLAB_ROWS": "64",
+            "CNMF_TPU_STORE_BACKOFF_S": "0.02",
+            "CNMF_TPU_STORE_TIMEOUT_S": "10"}
+
+
+class _Env:
+    """Save/patch/restore the knob environment around one scenario."""
+
+    def __init__(self, env: dict):
+        self.env = env
+
+    def __enter__(self):
+        self.prior = {k: os.environ.get(k) for k in _KNOBS}
+        for k in _KNOBS:
+            os.environ.pop(k, None)
+        os.environ.update(self.env)
+
+    def __exit__(self, *exc):
+        for k, v in self.prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        return False
+
+
+def _counts_df(workdir: str) -> str:
+    import numpy as np
+    import pandas as pd
+
+    from cnmf_torch_tpu.utils import save_df_to_npz
+
+    rng = np.random.default_rng(3)
+    usage = rng.dirichlet(np.ones(5) * 0.3, size=N_CELLS)
+    spectra = rng.gamma(0.3, 1.0, size=(5, 130)) * 40.0 / 130
+    counts = rng.poisson(usage @ spectra * 300.0).astype(np.float64)
+    counts[counts.sum(axis=1) == 0, 0] = 1.0
+    df = pd.DataFrame(counts, index=[f"c{i}" for i in range(N_CELLS)],
+                      columns=[f"g{j}" for j in range(130)])
+    fn = os.path.join(workdir, "counts.df.npz")
+    save_df_to_npz(df, fn)
+    return fn
+
+
+def _make_obj(workdir: str):
+    from cnmf_torch_tpu import cNMF
+
+    obj = cNMF(output_dir=workdir, name="net")
+    obj.prepare(_counts_df(workdir), components=[3], n_iter=4, seed=7,
+                num_highvar_genes=N_GENES_HV, batch_size=64)
+    return obj
+
+
+def _pipeline(workdir: str, env: dict, mid_spec: str | None = None):
+    """prepare → factorize → combine → consensus under ``env``;
+    ``mid_spec`` installs a fault spec AFTER prepare (the writes go
+    through clean; the fault hits the read path)."""
+    with _Env(env):
+        obj = _make_obj(workdir)
+        if mid_spec is not None:
+            os.environ["CNMF_TPU_FAULT_SPEC"] = mid_spec
+        obj.factorize(rowshard=True)
+        obj.combine()
+        obj.consensus(k=3, density_threshold=2.0, show_clustering=False)
+    return obj
+
+
+def _load(obj, key, *fmt):
+    import numpy as np
+
+    return np.load(obj.paths[key] % fmt, allow_pickle=True)["data"]
+
+
+def _assert_parity(base, other, label):
+    import numpy as np
+
+    for key, fmt in (("merged_spectra", (3,)),
+                     ("consensus_spectra", (3, "2_0")),
+                     ("consensus_usages", (3, "2_0"))):
+        a, b = _load(base, key, *fmt), _load(other, key, *fmt)
+        assert np.array_equal(a, b), \
+            f"{label}: {key} is not bit-identical to the local-store run"
+
+
+def _events(workdir: str) -> list:
+    from cnmf_torch_tpu.utils.telemetry import (read_events,
+                                                validate_events_file)
+
+    path = os.path.join(workdir, "net", "cnmf_tmp", "net.events.jsonl")
+    validate_events_file(path)
+    return list(read_events(path))
+
+
+def main() -> int:
+    from cnmf_torch_tpu.utils.netstore import ObjectStoreServer
+    from cnmf_torch_tpu.utils.shardstore import (RemoteStoreError,
+                                                 open_shard_store)
+    from cnmf_torch_tpu.utils.storebackend import _reset_degraded_warnings
+
+    dirs = [tempfile.mkdtemp(prefix="netstore_smoke_%s_" % tag)
+            for tag in ("local", "flaky", "slow", "warm", "cold")]
+    d_local, d_flaky, d_slow, d_warm, d_cold = dirs
+    try:
+        # the local-store reference every remote scenario must match
+        base = _pipeline(d_local, dict(_OOC_ENV))
+
+        # -- 1. flaky network: transient faults heal via retries -------
+        _reset_degraded_warnings()
+        with ObjectStoreServer() as srv:
+            flaky = _pipeline(
+                d_flaky, dict(_OOC_ENV, CNMF_TPU_STORE_URI=srv.url + "/s1"),
+                mid_spec="netflake:context=get:slab")
+        _assert_parity(base, flaky, "netflake")
+        evs = _events(d_flaky)
+        net = [e for e in evs if e["t"] == "fault"
+               and e.get("kind") == "store_net"]
+        assert any(isinstance(e.get("context"), dict)
+                   and e["context"].get("healed") for e in net), \
+            "no healed store_net fault event after netflake"
+        assert any(e["t"] == "dispatch" and e.get("decision") == "ooc_ingest"
+                   and (e.get("context") or {}).get("backend") == "remote"
+                   for e in evs), "ooc_ingest did not record a remote backend"
+        print("[netstore_smoke] netflake: healed by transport retries, "
+              "bit-identical ... ok")
+
+        # -- 2. slow network: the hedged read wins the stall -----------
+        _reset_degraded_warnings()
+        with ObjectStoreServer() as srv:
+            slow = _pipeline(
+                d_slow, dict(_OOC_ENV, CNMF_TPU_STORE_URI=srv.url + "/s2",
+                             CNMF_TPU_STORE_HEDGE_S="0.2"),
+                mid_spec="netslow:context=get:slab,seconds=1.5")
+        _assert_parity(base, slow, "netslow")
+        hedged = [e for e in _events(d_slow) if e["t"] == "stream"
+                  and int(e.get("store_hedges_won") or 0) > 0]
+        assert hedged, "no stream event recorded a won hedge"
+        print("[netstore_smoke] netslow: hedge won the stalled read, "
+              "bit-identical ... ok")
+
+        # -- 3. remote down, WARM cache: degraded completion -----------
+        _reset_degraded_warnings()
+        with ObjectStoreServer() as srv:
+            env = dict(_OOC_ENV, CNMF_TPU_STORE_URI=srv.url + "/s3",
+                       CNMF_TPU_STORE_RETRIES="1")
+            with _Env(env):
+                warm = _make_obj(d_warm)
+                warm.factorize(rowshard=True)
+                warm.combine()
+                # pre-warm every object the degraded phase will need:
+                # slabs + names land in the read-through cache (the
+                # manifest was cached when factorize opened the store)
+                st = open_shard_store(warm.paths["shard_store"])
+                for i in range(len(st.slabs)):
+                    st.read_slab(i)
+                st.obs_names()
+                os.environ["CNMF_TPU_FAULT_SPEC"] = "netdown:context=get:"
+                with warnings.catch_warnings(record=True) as caught:
+                    warnings.simplefilter("always")
+                    warm.consensus(k=3, density_threshold=2.0,
+                                   show_clustering=False)
+        _assert_parity(base, warm, "netdown-warm")
+        loud = [w for w in caught if issubclass(w.category, RuntimeWarning)
+                and "DEGRADED" in str(w.message)]
+        assert len(loud) == 1, \
+            "expected exactly one degraded-service warning, got %d" \
+            % len(loud)
+        evs = _events(d_warm)
+        assert any(e["t"] == "fault" and e.get("kind") == "store_net"
+                   and isinstance(e.get("context"), dict)
+                   and e["context"].get("degraded") for e in evs), \
+            "no degraded store_net fault event on the record"
+        print("[netstore_smoke] netdown warm-cache: consensus served from "
+              "cache, one loud warning, bit-identical ... ok")
+
+        # -- 4. remote down, COLD cache: loud named failure ------------
+        _reset_degraded_warnings()
+        with ObjectStoreServer() as srv:
+            env = dict(_OOC_ENV, CNMF_TPU_STORE_URI=srv.url + "/s4",
+                       CNMF_TPU_STORE_RETRIES="1",
+                       CNMF_TPU_STORE_CACHE_BYTES="0")
+            with _Env(env):
+                cold = _make_obj(d_cold)
+                os.environ["CNMF_TPU_FAULT_SPEC"] = \
+                    "netdown:context=get:slab"
+                try:
+                    cold.factorize(rowshard=True)
+                except RemoteStoreError as exc:
+                    assert "CNMF_TPU_STORE_RETRIES" in str(exc), \
+                        "RemoteStoreError does not name the retry knob"
+                else:
+                    raise AssertionError(
+                        "cold-cache netdown factorize should raise "
+                        "RemoteStoreError")
+        import json
+
+        ledger_path = cold.paths["resilience_ledger"] % 0
+        assert os.path.exists(ledger_path), "no resilience ledger persisted"
+        with open(ledger_path) as f:
+            ledger = json.load(f)
+        kinds = [r.get("kind") for r in ledger.get("shard_faults", [])]
+        assert "remote_store" in kinds, \
+            f"ledger shard_faults {kinds} missing kind remote_store"
+        lingering = [t for t in threading.enumerate()
+                     if t.name.startswith("cnmf-store")
+                     or (not t.daemon and t is not threading.main_thread())]
+        assert not lingering, f"threads survived the failure: {lingering}"
+        print("[netstore_smoke] netdown cold-cache: named RemoteStoreError, "
+              "ledger kind remote_store, no lingering threads ... ok")
+        return 0
+    finally:
+        for d in dirs:
+            shutil.rmtree(d, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

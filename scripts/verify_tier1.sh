@@ -92,6 +92,24 @@ if [ "$rc" -eq 0 ]; then
   fi
 fi
 
+# netstore smoke: mini pipeline against the in-repo HTTP object store
+# under each injected network fault class (scripts/netstore_smoke.py) —
+# netflake heals via transport retries (bit-identical), netslow's
+# stalled read is won by the hedged request, netdown with a warm
+# read-through cache completes degraded (one loud warning, bit-identical),
+# and netdown with a cold cache fails fast with the named
+# RemoteStoreError, ledger kind remote_store, no lingering threads
+if [ "$rc" -eq 0 ]; then
+  echo "[tier1] netstore smoke (remote store: netflake/netslow/netdown warm+cold) ..."
+  if timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      python scripts/netstore_smoke.py; then
+    echo NETSTORE_SMOKE=ok
+  else
+    echo NETSTORE_SMOKE=fail
+    exit 1
+  fi
+fi
+
 # accel parity smoke: a mini sweep under each solver recipe (plain MU /
 # accelerated-MU / Diagonalized-Newton KL / HALS) asserting matched
 # final objectives within tolerance and schema-valid dispatch +

@@ -1,0 +1,410 @@
+"""Remote object-store ShardStore backend (ISSUE 15): local-vs-remote
+staging bit-parity (dense / CSR / ELL, ragged final slab), the transport
+retry/backoff/hedge ladder under injected network faults, the crash-safe
+read-through cache (LRU eviction, digest revalidation, partial-write
+recovery), URI dispatch, and the degradation contract (warm cache serves
+a down remote loudly; a cold miss raises ``RemoteStoreError``).
+
+The remote endpoint is the in-repo stdlib fixture
+(``utils/netstore.ObjectStoreServer``); network faults are injected
+client-side via ``CNMF_TPU_FAULT_SPEC`` (``runtime/faults.py``), so the
+same server serves every scenario.
+"""
+
+import os
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from cnmf_torch_tpu.utils.netstore import ObjectStoreServer
+from cnmf_torch_tpu.utils.shardstore import (
+    RemoteStoreError,
+    TornShardError,
+    open_shard_store,
+    probe_shard_store,
+    write_shard_store,
+)
+from cnmf_torch_tpu.utils.storebackend import (
+    STORE_URI_ENV,
+    LocalBackend,
+    RemoteBackend,
+    _reset_degraded_warnings,
+    backend_counter_snapshot,
+    backoff_delay,
+    resolve_backend,
+    store_cache_dir,
+    store_retries,
+)
+
+
+def _dense(n=219, g=37, seed=0):
+    return np.abs(np.random.default_rng(seed).random((n, g))
+                  ).astype(np.float32)
+
+
+def _csr(n=219, g=37, seed=1, density=0.15):
+    X = sp.random(n, g, density=density, format="lil", random_state=seed)
+    X[40:60, :] = 0.0
+    X[n - 1, :] = 0.0
+    return sp.csr_matrix(X).astype(np.float32)
+
+
+def _set_spec(monkeypatch, spec):
+    """Install a fault spec with a parse-cache flush first: the cache is
+    keyed on the raw env value, so re-using a spec string from an earlier
+    test would otherwise inherit its exhausted fire counters."""
+    from cnmf_torch_tpu.runtime import faults
+
+    monkeypatch.setenv("CNMF_TPU_FAULT_SPEC", "")
+    faults.maybe_netfault(op="flush", context="flush")
+    monkeypatch.setenv("CNMF_TPU_FAULT_SPEC", spec)
+
+
+@pytest.fixture()
+def srv():
+    with ObjectStoreServer() as s:
+        yield s
+
+
+@pytest.fixture()
+def remote_env(srv, monkeypatch):
+    monkeypatch.setenv(STORE_URI_ENV, srv.url + "/t")
+    monkeypatch.setenv("CNMF_TPU_STORE_BACKOFF_S", "0.01")
+    monkeypatch.delenv("CNMF_TPU_FAULT_SPEC", raising=False)
+    _reset_degraded_warnings()
+    yield srv
+    _reset_degraded_warnings()
+
+
+# ---------------------------------------------------------------------------
+# local-vs-remote bit parity
+# ---------------------------------------------------------------------------
+
+def _write_both(tmp_path, monkeypatch, X, slab_rows=50):
+    monkeypatch.delenv(STORE_URI_ENV, raising=False)
+    write_shard_store(tmp_path / "local.store", X, slab_rows=slab_rows,
+                      obs_names=[f"c{i}" for i in range(X.shape[0])],
+                      var_names=[f"g{i}" for i in range(X.shape[1])])
+    local = open_shard_store(tmp_path / "local.store")
+    monkeypatch.setenv(STORE_URI_ENV, os.environ["_TEST_STORE_URL"])
+    write_shard_store(tmp_path / "remote.store", X, slab_rows=slab_rows,
+                      obs_names=[f"c{i}" for i in range(X.shape[0])],
+                      var_names=[f"g{i}" for i in range(X.shape[1])])
+    remote = open_shard_store(tmp_path / "remote.store")
+    assert remote.backend.kind == "remote"
+    return local, remote
+
+
+@pytest.fixture()
+def both_env(remote_env, monkeypatch):
+    monkeypatch.setenv("_TEST_STORE_URL", remote_env.url + "/t")
+    yield
+
+
+def test_remote_bit_parity_dense_ragged(tmp_path, monkeypatch, both_env):
+    X = _dense()  # 219 rows at 50/slab: ragged 19-row final slab
+    local, remote = _write_both(tmp_path, monkeypatch, X)
+    assert len(remote.slabs) == 5
+    assert local.manifest["store_digest"] == remote.manifest["store_digest"]
+    for i in range(len(local.slabs)):
+        assert np.array_equal(np.asarray(local.read_slab(i)),
+                              np.asarray(remote.read_slab(i)))
+    assert local.obs_names() == remote.obs_names()
+    assert local.var_names() == remote.var_names()
+
+
+def test_remote_bit_parity_csr_zero_slab(tmp_path, monkeypatch, both_env):
+    X = _csr()
+    local, remote = _write_both(tmp_path, monkeypatch, X, slab_rows=20)
+    assert remote.slabs[2]["nnz"] == 0  # the all-zero row band
+    for i in range(len(local.slabs)):
+        a, b = local.read_slab(i), remote.read_slab(i)
+        assert np.array_equal(np.asarray(a.todense()),
+                              np.asarray(b.todense()))
+
+
+def test_remote_staging_bit_parity(tmp_path, monkeypatch, both_env):
+    """The staged device arrays — dense rows and the ELL sparse layout —
+    are bit-identical whether the slabs came over HTTP or from disk."""
+    import jax
+    from jax.sharding import Mesh
+
+    from cnmf_torch_tpu.parallel.rowshard import (stream_ell_to_mesh,
+                                                  stream_rows_to_mesh)
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("cells",))
+    X = _csr()
+    local, remote = _write_both(tmp_path, monkeypatch, X, slab_rows=60)
+    A, pad_a = stream_rows_to_mesh(local, mesh, "cells")
+    B, pad_b = stream_rows_to_mesh(remote, mesh, "cells")
+    assert pad_a == pad_b
+    assert np.array_equal(np.asarray(A), np.asarray(B))
+    E1, pad1 = stream_ell_to_mesh(local, mesh, "cells")
+    E2, pad2 = stream_ell_to_mesh(remote, mesh, "cells")
+    assert pad1 == pad2 and E1.width == E2.width
+    for leaf in ("vals", "cols", "rows_t", "perm_t"):
+        assert np.array_equal(np.asarray(getattr(E1, leaf)),
+                              np.asarray(getattr(E2, leaf)))
+
+
+# ---------------------------------------------------------------------------
+# retry / backoff / hedging
+# ---------------------------------------------------------------------------
+
+def test_backoff_delay_deterministic():
+    a1 = backoff_delay("slab_00001.npz", 1, base=0.1)
+    assert a1 == backoff_delay("slab_00001.npz", 1, base=0.1)
+    # exponential in the attempt, decorrelated across objects
+    assert backoff_delay("slab_00001.npz", 3, base=0.1) > a1
+    assert a1 != backoff_delay("slab_00002.npz", 1, base=0.1)
+    # jitter bounded: base * 2^(n-1) <= delay < 1.5x that
+    assert 0.1 <= a1 < 0.15
+
+
+def test_netflake_heals_with_retry(tmp_path, monkeypatch, remote_env):
+    monkeypatch.setenv("CNMF_TPU_OOC_SLAB_ROWS", "32")
+    X = _dense(100, 20)
+    write_shard_store(tmp_path / "st", X)
+    _set_spec(monkeypatch, "netflake:context=get:slab")
+    store = open_shard_store(tmp_path / "st")
+    (block,) = store._load_arrays(store.slabs[0]["file"], refresh=True)
+    assert np.array_equal(block, X[:32])
+    snap = backend_counter_snapshot(store)
+    assert snap["retries"] >= 1 and snap["healed"] >= 1
+
+
+def test_netdown_exhausts_budget_with_named_error(tmp_path, monkeypatch,
+                                                  remote_env):
+    monkeypatch.setenv("CNMF_TPU_STORE_RETRIES", "2")
+    monkeypatch.setenv("CNMF_TPU_STORE_CACHE_BYTES", "0")
+    X = _dense(64, 10)
+    write_shard_store(tmp_path / "st", X)
+    store = open_shard_store(tmp_path / "st")
+    _set_spec(monkeypatch, "netdown:context=get:slab")
+    with pytest.raises(RemoteStoreError) as ei:
+        store.read_slab(0)
+    msg = str(ei.value)
+    # actionable: names the retry/timeout/URI knobs and the attempt count
+    assert "CNMF_TPU_STORE_RETRIES" in msg
+    assert "CNMF_TPU_STORE_URI" in msg
+    assert "3 attempt(s)" in msg
+    # NOT an OSError: must escape the shard reader's disk-reread ladder
+    assert not isinstance(ei.value, OSError)
+
+
+def test_hedge_wins_against_slow_primary(tmp_path, monkeypatch, remote_env):
+    monkeypatch.setenv("CNMF_TPU_OOC_SLAB_ROWS", "32")
+    monkeypatch.setenv("CNMF_TPU_STORE_HEDGE_S", "0.1")
+    X = _dense(64, 10)
+    write_shard_store(tmp_path / "st", X)
+    store = open_shard_store(tmp_path / "st")
+    # only the FIRST slab GET stalls (netslow default limit is one
+    # firing); the hedge issued after 0.1 s answers at full speed
+    _set_spec(monkeypatch, "netslow:context=get:slab,seconds=3")
+    t0 = time.perf_counter()
+    raw = store.backend.get(store.slabs[0]["file"], refresh=True)
+    waited = time.perf_counter() - t0
+    assert raw and waited < 2.0  # did not sit out the 3 s stall
+    snap = backend_counter_snapshot(store)
+    assert snap["hedges"] == 1 and snap["hedges_won"] == 1
+
+
+def test_nettorn_response_healed_by_reread(tmp_path, monkeypatch,
+                                           remote_env):
+    monkeypatch.setenv("CNMF_TPU_OOC_SLAB_ROWS", "32")
+    X = _dense(64, 10)
+    write_shard_store(tmp_path / "st", X)
+    store = open_shard_store(tmp_path / "st")
+    _set_spec(monkeypatch, "nettorn:context=get:slab")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        got = np.asarray(store.read_slab(0))
+    assert np.array_equal(got, X[:32])
+    assert any("re-reading" in str(x.message) for x in w)
+
+
+def test_404_is_file_not_found_without_retry(remote_env, tmp_path):
+    bk = RemoteBackend(remote_env.url + "/empty")
+    with pytest.raises(FileNotFoundError):
+        bk.get("nope.npz")
+    assert backend_counter_snapshot(bk)["retries"] == 0
+    assert bk.exists("nope.npz") is False
+
+
+# ---------------------------------------------------------------------------
+# read-through cache
+# ---------------------------------------------------------------------------
+
+def _cached_backend(srv, tmp_path, prefix="c"):
+    return RemoteBackend(srv.url + "/" + prefix,
+                         cache_dir=str(tmp_path / "cache"))
+
+
+def test_cache_hit_skips_network(tmp_path, remote_env):
+    bk = _cached_backend(remote_env, tmp_path)
+    bk.put("a", b"payload-a")
+    assert bk.get("a") == b"payload-a"     # miss -> fetch -> cache
+    with remote_env.lock:
+        remote_env.objects.clear()         # remote forgets the object
+    assert bk.get("a") == b"payload-a"     # served from cache
+    snap = backend_counter_snapshot(bk)
+    assert snap["cache_hits"] == 1 and snap["cache_misses"] == 1
+
+
+def test_cache_lru_eviction(tmp_path, remote_env, monkeypatch):
+    monkeypatch.setenv("CNMF_TPU_STORE_CACHE_BYTES", "256")
+    bk = _cached_backend(remote_env, tmp_path)
+    for i in range(4):
+        bk.put("o%d" % i, bytes([i]) * 100)
+        bk.get("o%d" % i)
+        time.sleep(0.02)  # distinct mtimes order the LRU sweep
+    entries = [fn for fn in os.listdir(tmp_path / "cache")
+               if not fn.endswith(".sha1")]
+    # 4 x 100 B against a 256 B budget: oldest evicted, newest survives
+    assert len(entries) <= 2 and "o3" in entries
+    assert "o0" not in entries
+
+
+def test_cache_digest_revalidation_discards_corruption(tmp_path,
+                                                       remote_env):
+    bk = _cached_backend(remote_env, tmp_path)
+    bk.put("a", b"good-bytes")
+    bk.get("a")
+    entry = os.path.join(tmp_path / "cache", "a")
+    with open(entry, "wb") as f:
+        f.write(b"rotten-bytes")          # flip the entry, keep the sidecar
+    assert bk.get("a") == b"good-bytes"   # mismatch -> drop -> refetch
+    snap = backend_counter_snapshot(bk)
+    assert snap["cache_hits"] == 0 and snap["cache_misses"] == 2
+
+
+def test_cache_partial_write_is_a_miss(tmp_path, remote_env):
+    """A crash mid-landing leaves an entry without its sidecar (or the
+    sidecar without its entry): both shapes read as a miss, never as
+    unvalidated bytes."""
+    bk = _cached_backend(remote_env, tmp_path)
+    bk.put("a", b"remote-truth")
+    os.makedirs(tmp_path / "cache", exist_ok=True)
+    with open(os.path.join(tmp_path / "cache", "a"), "wb") as f:
+        f.write(b"orphan-no-sidecar")
+    assert bk.get("a") == b"remote-truth"
+    assert backend_counter_snapshot(bk)["cache_misses"] == 1
+
+
+def test_crash_temps_swept(tmp_path, monkeypatch, remote_env):
+    from cnmf_torch_tpu.utils.shardstore import sweep_store_temps
+
+    monkeypatch.setenv("CNMF_TPU_OOC_SLAB_ROWS", "32")
+    store_dir = tmp_path / "st"
+    write_shard_store(store_dir, _dense(64, 10))
+    cache_dir = store_cache_dir(store_dir)
+    os.makedirs(cache_dir, exist_ok=True)
+    orphan = os.path.join(cache_dir, "slab_00000.npz.tmp-12345")
+    with open(orphan, "wb") as f:
+        f.write(b"partial")
+    swept = sweep_store_temps(store_dir)
+    assert not os.path.exists(orphan) and swept >= 1
+
+
+# ---------------------------------------------------------------------------
+# degradation contract
+# ---------------------------------------------------------------------------
+
+def test_down_remote_serves_warm_cache_loudly(tmp_path, monkeypatch,
+                                              remote_env):
+    monkeypatch.setenv("CNMF_TPU_OOC_SLAB_ROWS", "32")
+    monkeypatch.setenv("CNMF_TPU_STORE_RETRIES", "1")
+    X = _dense(100, 20)
+    write_shard_store(tmp_path / "st", X)
+    warm = open_shard_store(tmp_path / "st")
+    ref = [np.asarray(warm.read_slab(i)) for i in range(len(warm.slabs))]
+    warm.obs_names()
+    _set_spec(monkeypatch, "netdown:context=get:")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        store = open_shard_store(tmp_path / "st")
+        got = [np.asarray(store.read_slab(i))
+               for i in range(len(store.slabs))]
+    for a, b in zip(got, ref):
+        assert np.array_equal(a, b)
+    snap = backend_counter_snapshot(store)
+    assert snap["degraded_reads"] >= 1
+    loud = [x for x in w if issubclass(x.category, RuntimeWarning)
+            and "DEGRADED" in str(x.message)]
+    assert len(loud) == 1  # once per run, not once per slab
+
+
+def test_probe_missing_vs_down(tmp_path, monkeypatch, remote_env):
+    # absent store probes as a clean miss through the backend
+    store, reason = probe_shard_store(tmp_path / "absent.store")
+    assert store is None and reason == "missing"
+    # a DOWN remote with a cold cache is not "missing": the probe's
+    # exists() raises the named error instead of silently re-preparing
+    monkeypatch.setenv("CNMF_TPU_STORE_RETRIES", "0")
+    monkeypatch.setenv("CNMF_TPU_STORE_CACHE_BYTES", "0")
+    _set_spec(monkeypatch, "netdown:context=head:")
+    with pytest.raises(RemoteStoreError):
+        probe_shard_store(tmp_path / "absent.store")
+
+
+def test_no_lingering_threads_after_failure(tmp_path, monkeypatch,
+                                            remote_env):
+    monkeypatch.setenv("CNMF_TPU_STORE_RETRIES", "0")
+    monkeypatch.setenv("CNMF_TPU_STORE_CACHE_BYTES", "0")
+    monkeypatch.setenv("CNMF_TPU_STORE_HEDGE_S", "0.05")
+    bk = RemoteBackend(remote_env.url + "/z",
+                       cache_dir=str(tmp_path / "cache"))
+    bk.put("a", b"x")
+    _set_spec(monkeypatch, "netslow:context=get:a,seconds=1")
+    assert bk.get("a", refresh=True) == b"x"  # hedge wins the stall
+    time.sleep(1.2)  # let the abandoned primary drain
+    lingering = [t for t in threading.enumerate()
+                 if t.name.startswith("cnmf-store")]
+    assert not lingering
+
+
+# ---------------------------------------------------------------------------
+# dispatch + knob validation
+# ---------------------------------------------------------------------------
+
+def test_uri_dispatch(tmp_path, monkeypatch):
+    sd = str(tmp_path / "x.store")
+    monkeypatch.delenv(STORE_URI_ENV, raising=False)
+    bk = resolve_backend(sd)
+    assert isinstance(bk, LocalBackend) and bk.root == sd
+    # file:// relocates the store under <base>/<leaf>
+    bk = resolve_backend(sd, uri="file://%s/alt" % tmp_path)
+    assert isinstance(bk, LocalBackend)
+    assert bk.root == os.path.join(str(tmp_path), "alt", "x.store")
+    # http(s) namespaces by leaf and hangs the cache beside the store
+    bk = resolve_backend(sd, uri="http://h:9/pfx")
+    assert isinstance(bk, RemoteBackend)
+    assert bk.base == "http://h:9/pfx/x.store"
+    assert bk.cache_dir == sd + ".cache"
+    # env fallback
+    monkeypatch.setenv(STORE_URI_ENV, "https://h:9/p")
+    assert resolve_backend(sd).kind == "remote"
+    with pytest.raises(ValueError, match="CNMF_TPU_STORE_URI"):
+        resolve_backend(sd, uri="s3://unsupported")
+
+
+def test_knob_validation_one_line_errors(monkeypatch):
+    monkeypatch.setenv("CNMF_TPU_STORE_RETRIES", "many")
+    with pytest.raises(ValueError, match="CNMF_TPU_STORE_RETRIES"):
+        store_retries()
+    monkeypatch.setenv("CNMF_TPU_STORE_RETRIES", "-1")
+    with pytest.raises(ValueError, match="CNMF_TPU_STORE_RETRIES"):
+        store_retries()
+
+
+def test_remote_knobs_registered():
+    from cnmf_torch_tpu.utils.envknobs import REGISTRY
+
+    for knob in ("CNMF_TPU_STORE_URI", "CNMF_TPU_STORE_RETRIES",
+                 "CNMF_TPU_STORE_BACKOFF_S", "CNMF_TPU_STORE_TIMEOUT_S",
+                 "CNMF_TPU_STORE_HEDGE_S", "CNMF_TPU_STORE_CACHE_BYTES"):
+        assert knob in REGISTRY
