@@ -1,13 +1,22 @@
 """Trace-safety rules: nothing host-side may hide inside a traced scope.
 
 A traced scope is a function the XLA tracer will run: decorated with
-``@jit``/``@partial(jax.jit, ...)``/``@shard_map``, or passed (by name or
-as an inline lambda) to a tracer call — ``jax.jit``, ``vmap``/``pmap``,
+``@jit``/``@partial(jax.jit, ...)``/``@shard_map``, or passed (by name,
+as an inline lambda, or wrapped in ``functools.partial``) to a tracer
+call — ``jax.jit``, ``vmap``/``pmap``,
 ``lax.while_loop``/``fori_loop``/``scan``/``cond``/``switch``/``map``,
-``shard_map``, ``checkpoint``/``remat``, ``grad``. Detection is lexical
-and per-file (a helper that is only ever traced via an import in another
-module is out of reach — the rule is a tripwire for the patterns that
-actually bite, not a whole-program dataflow analysis).
+``shard_map``, ``pl.pallas_call``, ``checkpoint``/``remat``, ``grad``.
+Detection is lexical and per-file (a helper that is only ever traced via
+an import in another module is out of reach — the rule is a tripwire for
+the patterns that actually bite, not a whole-program dataflow analysis).
+
+Pallas kernel bodies (the function handed to ``pl.pallas_call``) are
+traced scopes like any other: the host-sync and nondet rules apply
+inside them — a ``.item()`` or ``time.time()`` in a kernel body is just
+as wrong as in a jitted solver. ``pl.load``/``pl.store`` are explicitly
+exempt from the scatter/host-access heuristics (see
+``PALLAS_REF_CALLS``): they are in-kernel VMEM ref accesses — part of
+the traced program itself — not device->host traffic.
 
   * ``trace-host-sync`` — ``.item()``/``.tolist()``/
     ``.block_until_ready()``, ``np.asarray``/``np.array``,
@@ -44,8 +53,16 @@ TRACER_CALLS = {
     "jax.lax.while_loop", "jax.lax.fori_loop", "jax.lax.scan",
     "jax.lax.cond", "jax.lax.switch", "jax.lax.map",
     "jax.experimental.shard_map.shard_map",
+    "jax.experimental.pallas.pallas_call",
     "jax.checkpoint", "jax.remat", "jax.grad", "jax.value_and_grad",
 }
+
+# in-kernel VMEM ref accesses (``pl.load(ref, idx)`` / ``pl.store(ref,
+# idx, val)``): deliberately exempt from the host-sync and any future
+# scatter heuristics — a ref access inside a Pallas kernel body IS the
+# traced program, not device->host traffic
+PALLAS_REF_CALLS = {"jax.experimental.pallas.load",
+                    "jax.experimental.pallas.store"}
 
 HOST_SYNC_ATTRS = {"item", "tolist", "block_until_ready",
                    "copy_to_host_async"}
@@ -64,8 +81,10 @@ def _tracer_name(ctx: FileContext, node: ast.AST) -> bool:
     if name in TRACER_CALLS:
         return True
     # the package re-exports shard_map through utils.jax_compat's version
-    # shim — any import path whose leaf is shard_map is the tracer
-    return name is not None and name.split(".")[-1] == "shard_map"
+    # shim, and pallas is imported under an alias (``import pallas as
+    # pl``) — any import path with either leaf is the tracer
+    return name is not None and name.split(".")[-1] in ("shard_map",
+                                                        "pallas_call")
 
 
 def _partial_tracer(ctx: FileContext, call: ast.Call) -> bool:
@@ -134,6 +153,15 @@ def _collect_traced_scopes(ctx: FileContext) -> dict[ast.AST, set[str]]:
                     target = arg
                 elif isinstance(arg, ast.Name) and arg.id in defs:
                     target = defs[arg.id]
+                elif isinstance(arg, ast.Call) \
+                        and ctx.resolve_call(arg) in ("functools.partial",
+                                                      "partial") \
+                        and arg.args \
+                        and isinstance(arg.args[0], ast.Name) \
+                        and arg.args[0].id in defs:
+                    # pallas_call(functools.partial(body, k=k), ...) —
+                    # the kernel-body idiom binds statics via partial
+                    target = defs[arg.args[0].id]
                 if target is not None:
                     scopes.setdefault(target, set()).update(
                         _static_names_from_call(statics_call, target))
@@ -223,6 +251,8 @@ def _check_node(ctx: FileContext, node: ast.AST, traced_params: set[str],
                 statics: set[str]) -> Finding | None:
     if isinstance(node, ast.Call):
         resolved = ctx.resolve_call(node)
+        if resolved in PALLAS_REF_CALLS:
+            return None
         # host syncs
         if isinstance(node.func, ast.Attribute) \
                 and node.func.attr in HOST_SYNC_ATTRS:

@@ -1053,6 +1053,9 @@ class cNMF:
         from ..utils.autotune import maybe_autotune_rho
 
         maybe_autotune_rho(beta=beta_val)
+        from ..ops.nmf import resolve_bf16_ratio as _rb
+        from ..ops.pallas import kernel_label, resolve_pallas
+
         use_ell = False
         if (sp.issparse(norm_counts.X) and beta_val in (1.0, 0.0)
                 and _nmf_kwargs.get("init", "random") == "random"
@@ -1064,11 +1067,19 @@ class cNMF:
             density = norm_counts.X.nnz / max(n_c * g_c, 1)
             use_ell = resolve_sparse_beta(beta_val, density=density,
                                           width=ell_w, g=g_c)
+            # engaged inner-loop kernel (ISSUE 16): which statistics
+            # implementation the sweeps will run — the fused Pallas
+            # kernels only on the ELL β=1 lane with the knob engaged
+            _kern = kernel_label(
+                bool(use_ell),
+                bool(use_ell and beta_val == 1.0 and resolve_pallas()),
+                _rb(beta_val, _nmf_kwargs.get("mode", "online")))
             self._events.emit(
                 "dispatch", decision="ell_vs_dense",
                 context={"use_ell": bool(use_ell), "beta": float(beta_val),
                          "density": round(float(density), 4),
-                         "ell_width": int(ell_w), "genes": int(g_c)})
+                         "ell_width": int(ell_w), "genes": int(g_c),
+                         "kernel": _kern})
 
         if use_ell and packed:
             # fail BEFORE the CSR->ELL conversion and host->HBM staging
@@ -1230,6 +1241,15 @@ class cNMF:
             packed = False
         self._events.emit("dispatch", decision="solver_recipe",
                           context=recipe.as_context())
+        # the ENGAGED kernel label (ISSUE 16) — recipe-gated, so a sketch
+        # recipe (whose scatter keeps the jnp chain) records ell-jnp even
+        # under CNMF_TPU_PALLAS=1; authoritative over the pre-recipe
+        # ell_vs_dense event's knob-level label
+        _kern = kernel_label(
+            bool(use_ell),
+            bool(use_ell and beta_val == 1.0
+                 and recipe.algo != "sketch" and resolve_pallas()),
+            _rb(beta_val, _nmf_kwargs.get("mode", "online")))
         self._save_factorize_provenance(
             "batched-packed" if packed else
             ("batched-ell" if use_ell else "batched"), worker_i,
@@ -1237,7 +1257,7 @@ class cNMF:
                  online_h_tol=_h_tol_eff, n_passes=_n_passes_eff,
                  online_h_tol_start=_h_tol_start,
                  sparse_path=("ell" if use_ell else "dense"),
-                 solver_recipe=recipe.label,
+                 solver_recipe=recipe.label, kernel=_kern,
                  inner_repeats=int(recipe.inner_repeats),
                  kl_newton=bool(recipe.kl_newton),
                  mesh_devices=(1 if mesh is None
@@ -1456,6 +1476,7 @@ class cNMF:
                           mode=payload["mode"], cap=int(payload["cap"]),
                           cadence=payload["cadence"],
                           recipe=payload.get("recipe"),
+                          kernel=payload.get("kernel"),
                           records=replicate_records(payload))
 
     def _write_iter_spectra(self, k, it, spectrum, columns):
@@ -1694,6 +1715,18 @@ class cNMF:
                   default=None))
         self._events.emit("dispatch", decision="solver_recipe",
                           context=recipe.as_context())
+        # engaged inner-loop kernel (ISSUE 16): the fused Pallas kernels
+        # run only on ELL β=1 shards (the grid2d layout stages dense
+        # stripes, so its label is the literal dense chain); the label
+        # rides the provenance record and, when the kernels engage, the
+        # checkpoint identity below
+        from ..ops.pallas import resolve_pallas as _resolve_pallas
+
+        rs_use_pallas = bool(
+            not grid and isinstance(Xd, _EllMatrix) and rs_beta == 1.0
+            and recipe.algo != "sketch" and _resolve_pallas())
+        rs_kernel = ("dense-jnp" if grid or not isinstance(Xd, _EllMatrix)
+                     else ("ell-pallas" if rs_use_pallas else "ell-jnp"))
         from ..parallel.grid2d import grid_blocks as _grid_blocks
         from ..parallel.grid2d import grid_overlap_enabled as _grid_ovl
 
@@ -1718,6 +1751,7 @@ class cNMF:
              "alpha_W": nmf_kwargs.get("alpha_W", 0.0),
              "alpha_H": nmf_kwargs.get("alpha_H", 0.0),
              "solver_recipe": recipe.label,
+             "kernel": rs_kernel,
              "kl_newton": bool(recipe.kl_newton),
              "mesh_devices": int(np.prod(mesh.devices.shape)),
              "ooc_ingest": (None if store is None else
@@ -1818,9 +1852,15 @@ class cNMF:
             # statistics contractions over the gene axis — resuming a
             # 1-D rowshard cursor under --mesh-grid2d (or vice versa)
             # would splice two solvers' trajectories
-            return repr(sorted(dict(params_base, ingest_tier=tier,
-                                    layout=("grid2d" if grid
-                                            else "rowshard")).items()))
+            params = dict(params_base, ingest_tier=tier,
+                          layout=("grid2d" if grid else "rowshard"))
+            if rs_use_pallas:
+                # engaged-kernel identity (ISSUE 16): the fused kernels
+                # change accumulation order vs the jnp chain, so a resume
+                # across a CNMF_TPU_PALLAS flip restarts; default-path
+                # signatures stay byte-identical to pre-Pallas builds
+                params["recipe"] = recipe.signature(kernel=rs_kernel)
+            return repr(sorted(params.items()))
 
         def _make_ckpt(k_c, it_c, seed_c, attempt=0, force_resume=False):
             """Checkpoint policy for one (k, iter) solve. Retry attempts

@@ -46,6 +46,7 @@ import numpy as np
 import scipy.sparse as sp
 
 from ..utils.jax_compat import assert_threefry_partitionable, enable_x64
+from .pallas import resolve_pallas
 from .sparse import (
     EllMatrix,
     csr_to_ell,
@@ -229,14 +230,21 @@ _DENSE_ERR_ELEMS = 1 << 22
 _HI = jax.lax.Precision.HIGHEST
 
 
-@functools.partial(jax.jit, static_argnames=("beta",))
-def beta_divergence(X, H, W, beta: float = 2.0):
+@functools.partial(jax.jit, static_argnames=("beta", "use_pallas"))
+def beta_divergence(X, H, W, beta: float = 2.0,
+                    use_pallas: bool = False):
     """D_beta(X || HW). For beta=2 on large shapes uses the trace identity —
     no cells x genes buffer is materialized. ``X`` may be a fixed-width
     :class:`~cnmf_torch_tpu.ops.sparse.EllMatrix` for beta in {1, 0}: the
     KL objective is then evaluated on the nonzeros only (plus the k-sized
-    ``sum WH`` term), matching the dense cancellation-safe form exactly."""
+    ``sum WH`` term), matching the dense cancellation-safe form exactly.
+    ``use_pallas`` (static, ELL beta=1 only) evaluates the nonzero terms
+    with the fused kernel (``ops/pallas_kl.py``, f32-tolerance parity)."""
     if isinstance(X, EllMatrix):
+        if use_pallas and beta == 1.0:
+            from .pallas_kl import pallas_kl_beta_err
+
+            return pallas_kl_beta_err(X, H, W)
         return ell_beta_err(X, H, W, beta)
     if beta == 2.0:
         if X.shape[0] * X.shape[1] <= _DENSE_ERR_ELEMS:
@@ -389,14 +397,24 @@ def _apply_rate_sketched(W, numer, denom, l1, l2):
 
 
 def _update_H(X, H, W, beta: float, l1: float, l2: float,
-              bf16_ratio: bool = False, w_table=None, w_colsum=None):
+              bf16_ratio: bool = False, w_table=None, w_colsum=None,
+              use_pallas: bool = False):
     if isinstance(X, EllMatrix):
         # sparsity-aware path (ops/sparse.py): nonzero-only numerator
         # statistics from the fixed-width ELL encoding; the bf16 ratio
         # chain composes (bf16 values/gathers, f32 accumulation).
         # ``w_table``: pre-gathered W slabs for fixed-W inner loops.
+        # ``use_pallas`` (static): the fused one-pass kernel for the
+        # beta=1 statistics (ops/pallas_kl.py; the kernel re-gathers
+        # its slab table in VMEM, so no host-side w_table is needed).
         if beta == 1.0:
-            numer, denom = ell_kl_h_stats(X, H, W, bf16_ratio, w_table)
+            if use_pallas:
+                from .pallas_kl import pallas_kl_h_stats
+
+                numer, denom = pallas_kl_h_stats(X, H, W, bf16_ratio)
+            else:
+                numer, denom = ell_kl_h_stats(X, H, W, bf16_ratio,
+                                              w_table)
         elif beta == 0.0:
             numer, denom = ell_is_h_stats(X, H, W, bf16_ratio, w_table)
         else:
@@ -422,11 +440,15 @@ def _update_H(X, H, W, beta: float, l1: float, l2: float,
         numer = jnp.matmul(ratio, wb.T, preferred_element_type=jnp.float32)
         denom = jnp.broadcast_to(W.sum(axis=1)[None, :], H.shape)
     elif beta == 1.0:
-        # measured on v5e: this chain is HBM-roofline-bound, and XLA's
-        # fusion of the batched (vmapped) form already matches a
+        # measured on v5e: this DENSE chain is HBM-roofline-bound, and
+        # XLA's fusion of the batched (vmapped) form already matches a
         # hand-fused Pallas one-pass kernel (ratio+both matmuls in VMEM
-        # tiles) — the kernel won 3x single-replicate but 0x under vmap,
-        # so the plain jnp form stays (bench.py mfu tier tracks this).
+        # tiles) — that kernel won 3x single-replicate but 0x under
+        # vmap, so the dense lane keeps the plain jnp form. That verdict
+        # is dense-only: the ELL lane above, where XLA's gather chains
+        # (not the matmuls) dominate, dispatches fused Pallas kernels
+        # through CNMF_TPU_PALLAS (ops/pallas_kl.py; bench.py mfu tier
+        # tracks both lanes with per-kernel labels).
         # ``w_colsum``: the serving tier's resident loop-invariant KL
         # denominator (ISSUE 12) — W is fixed across every request, so
         # the daemon computes the sum once at reference staging (the
@@ -460,10 +482,17 @@ def _update_H(X, H, W, beta: float, l1: float, l2: float,
 
 
 def _update_W(X, H, W, beta: float, l1: float, l2: float,
-              bf16_ratio: bool = False, w_table=None):
+              bf16_ratio: bool = False, w_table=None,
+              use_pallas: bool = False):
     if isinstance(X, EllMatrix):
         if beta == 1.0:
-            numer, denom = ell_kl_w_stats(X, H, W, bf16_ratio, w_table)
+            if use_pallas:
+                from .pallas_kl import pallas_kl_w_stats
+
+                numer, denom = pallas_kl_w_stats(X, H, W, bf16_ratio)
+            else:
+                numer, denom = ell_kl_w_stats(X, H, W, bf16_ratio,
+                                              w_table)
         elif beta == 0.0:
             numer, denom = ell_is_w_stats(X, H, W, bf16_ratio)
         else:
@@ -508,7 +537,8 @@ def _update_W(X, H, W, beta: float, l1: float, l2: float,
 # Diagonalized Newton (β=1) steps — the 'dna' recipe (arXiv:1301.3389)
 # ---------------------------------------------------------------------------
 
-def _kl_row_obj(X, C, W, l1, l2, w_table=None):
+def _kl_row_obj(X, C, W, l1, l2, w_table=None,
+                use_pallas: bool = False):
     """Per-row KL objective of candidate usages ``C`` against fixed ``W``,
     up to X-only constants (identical across candidates, so they cancel
     in the lane selection): ``C @ W.sum(1) - Σ_g X log(max(CW, EPS))``
@@ -520,7 +550,12 @@ def _kl_row_obj(X, C, W, l1, l2, w_table=None):
     mass, which the linear term carries in full)."""
     lin = C @ W.sum(axis=1)
     if isinstance(X, EllMatrix):
-        wh = ell_wh_at_nz(X, C, W, w_table)
+        if use_pallas:
+            from .pallas_kl import pallas_wh_at_nz
+
+            wh = pallas_wh_at_nz(X, C, W)
+        else:
+            wh = ell_wh_at_nz(X, C, W, w_table)
         data = -jnp.sum(X.vals * jnp.log(jnp.maximum(wh, EPS)), axis=-1)
     else:
         data = -jnp.sum(X * jnp.log(jnp.maximum(C @ W, EPS)), axis=-1)
@@ -544,7 +579,8 @@ def _kl_col_obj(X, H, C, l1, l2):
     return obj
 
 
-def _dna_h_step(X, H, W, l1, l2, w_table=None):
+def _dna_h_step(X, H, W, l1, l2, w_table=None,
+                use_pallas: bool = False):
     """One Diagonalized-Newton KL H step with the per-row monotone MU
     fallback lane (Van hamme, arXiv:1301.3389; ISSUE 9).
 
@@ -564,7 +600,12 @@ def _dna_h_step(X, H, W, l1, l2, w_table=None):
     """
     s = W.sum(axis=1)[None, :]
     if isinstance(X, EllMatrix):
-        numer, denom, hess = ell_kl_h_newton_stats(X, H, W, w_table)
+        if use_pallas:
+            from .pallas_kl import pallas_kl_h_newton_stats
+
+            numer, denom, hess = pallas_kl_h_newton_stats(X, H, W)
+        else:
+            numer, denom, hess = ell_kl_h_newton_stats(X, H, W, w_table)
     else:
         WH = jnp.maximum(H @ W, EPS)
         ratio = X / WH
@@ -574,8 +615,8 @@ def _dna_h_step(X, H, W, l1, l2, w_table=None):
     H_mu = _apply_rate(H, numer, denom, l1, l2)
     grad = s - numer + l1 + l2 * H
     H_nt = jnp.maximum(H - grad / jnp.maximum(hess + l2, EPS), 0.0)
-    o_nt = _kl_row_obj(X, H_nt, W, l1, l2, w_table)
-    o_mu = _kl_row_obj(X, H_mu, W, l1, l2, w_table)
+    o_nt = _kl_row_obj(X, H_nt, W, l1, l2, w_table, use_pallas)
+    o_mu = _kl_row_obj(X, H_mu, W, l1, l2, w_table, use_pallas)
     take_nt = (o_nt < o_mu)[..., None]
     H_new = jnp.where(take_nt, H_nt, H_mu)
     return H_new, 1.0 - jnp.mean(take_nt.astype(jnp.float32))
@@ -649,14 +690,16 @@ def _trace_init(err0, with_inner: bool = False,
     jax.jit,
     static_argnames=("beta", "max_iter", "update_W_flag", "l1_H", "l2_H",
                      "l1_W", "l2_W", "telemetry", "inner_repeats",
-                     "kl_newton", "sketch_dim", "sketch_exact_every"),
+                     "kl_newton", "sketch_dim", "sketch_exact_every",
+                     "use_pallas"),
 )
 def nmf_fit_batch(X, H0, W0, beta: float = 2.0, tol: float = 1e-4,
                   max_iter: int = 200, l1_H: float = 0.0, l2_H: float = 0.0,
                   l1_W: float = 0.0, l2_W: float = 0.0,
                   update_W_flag: bool = True, telemetry: bool = False,
                   inner_repeats: int = 1, kl_newton: bool = False,
-                  sketch_dim: int = 0, sketch_exact_every: int = 1):
+                  sketch_dim: int = 0, sketch_exact_every: int = 1,
+                  use_pallas: bool = False):
     """Alternating MU until the relative objective decrease over an
     ``EVAL_EVERY``-iteration window falls below ``tol`` (sklearn-style
     criterion) or ``max_iter``. Returns ``(H, W, err)``.
@@ -698,9 +741,19 @@ def nmf_fit_batch(X, H0, W0, beta: float = 2.0, tol: float = 1e-4,
     iteration to control subsampling bias. Numerator and denominator
     come from the same subsample, so the MU rate's n/m scale cancels;
     the objective evaluations (and the stopping rule) stay exact.
+
+    ``use_pallas`` (STATIC; default ``False`` is byte-identical): ELL
+    β=1 statistics and objective evaluate through the fused Pallas
+    kernels (``ops/pallas_kl.py``, CNMF_TPU_PALLAS) — the kernels
+    re-gather their slab table in VMEM per tile, so the host-side
+    ``ell_w_table`` hoist is skipped. Defined only for the ELL KL lane;
+    anything else (dense, β≠1, the sketch recipe's scatter) quietly
+    keeps the jnp path.
     """
     inner_repeats = int(inner_repeats)
     sketch_dim = int(sketch_dim)
+    use_pallas = (bool(use_pallas) and isinstance(X, EllMatrix)
+                  and beta == 1.0 and not sketch_dim)
     if kl_newton and beta != 1.0:
         raise ValueError(
             f"kl_newton is the beta=1 (KL) Newton recipe, got beta={beta}")
@@ -718,7 +771,7 @@ def nmf_fit_batch(X, H0, W0, beta: float = 2.0, tol: float = 1e-4,
         n_total = int(X.vals.shape[0] if isinstance(X, EllMatrix)
                       else X.shape[0])
         sketch_dim = min(sketch_dim, n_total)
-    err0 = beta_divergence(X, H0, W0, beta=beta)
+    err0 = beta_divergence(X, H0, W0, beta=beta, use_pallas=use_pallas)
 
     # accelerated recipes on ELL input share ONE pre-gathered W slab
     # table per outer iteration (H sub-iterations, newton stats, both dna
@@ -731,16 +784,21 @@ def nmf_fit_batch(X, H0, W0, beta: float = 2.0, tol: float = 1e-4,
     def h_step(H, W, table):
         """One recipe H step: ``(H_new, inner_count, fallback | None)``."""
         if kl_newton:
-            H_new, fb = _dna_h_step(X, H, W, l1_H, l2_H, w_table=table)
+            H_new, fb = _dna_h_step(X, H, W, l1_H, l2_H, w_table=table,
+                                    use_pallas=use_pallas)
             return H_new, jnp.int32(1), fb
         if inner_repeats <= 1:
-            return (_update_H(X, H, W, beta, l1_H, l2_H),
+            return (_update_H(X, H, W, beta, l1_H, l2_H,
+                              use_pallas=use_pallas),
                     jnp.int32(1), None)
         # accelerated MU: hoist the loop-invariant W products out of the
-        # repeat loop (this is where the per-repeat cost collapses)
+        # repeat loop (this is where the per-repeat cost collapses);
+        # under the Pallas kernels the hoist is the kernel's own VMEM
+        # slab gather — the repeats re-enter it with W still on-chip
         if isinstance(X, EllMatrix):
             def one(h):
-                return _update_H(X, h, W, beta, l1_H, l2_H, w_table=table)
+                return _update_H(X, h, W, beta, l1_H, l2_H,
+                                 w_table=table, use_pallas=use_pallas)
         elif beta == 2.0:
             numer0 = X @ W.T
             WWT = W @ W.T
@@ -779,7 +837,8 @@ def nmf_fit_batch(X, H0, W0, beta: float = 2.0, tol: float = 1e-4,
             # subsample noise reads as convergence and stops the solve
             # tens of iterations early (measured on the sparse fixture)
             def _exact(_):
-                return _update_W(X, H, W, beta, l1_W, l2_W)
+                return _update_W(X, H, W, beta, l1_W, l2_W,
+                                 use_pallas=use_pallas)
 
             def _sketched(_):
                 idx = jax.random.randint(
@@ -813,7 +872,8 @@ def nmf_fit_batch(X, H0, W0, beta: float = 2.0, tol: float = 1e-4,
         if table is not None:
             return _update_W(X, H, W, beta, l1_W, l2_W,
                              w_table=table), None
-        return _update_W(X, H, W, beta, l1_W, l2_W), None
+        return _update_W(X, H, W, beta, l1_W, l2_W,
+                         use_pallas=use_pallas), None
 
     def active_of(err_prev, err, it):
         not_converged = (err_prev - err) / jnp.maximum(err0, EPS) >= tol
@@ -831,7 +891,8 @@ def nmf_fit_batch(X, H0, W0, beta: float = 2.0, tol: float = 1e-4,
         else:
             H, W, err_prev, err, it = carry
         table = (ell_w_table(W, X.cols)
-                 if accel and isinstance(X, EllMatrix) else None)
+                 if accel and isinstance(X, EllMatrix) and not use_pallas
+                 else None)
         H, inner_n, fb_h = h_step(H, W, table)
         W, fb_w = w_step(H, W, table, it)
         if fb_h is not None and fb_w is not None:
@@ -841,7 +902,8 @@ def nmf_fit_batch(X, H0, W0, beta: float = 2.0, tol: float = 1e-4,
         it = it + 1
 
         def with_err(_):
-            return beta_divergence(X, H, W, beta=beta)
+            return beta_divergence(X, H, W, beta=beta,
+                                   use_pallas=use_pallas)
 
         err_new = jax.lax.cond(it % EVAL_EVERY == 0, with_err,
                                lambda _: err, operand=None)
@@ -1212,7 +1274,7 @@ def _chunk_h_hals_solve(x, h, W, WWT, l1, l2, max_iter, h_tol):
 def _chunk_h_solve(x, h, W, WWT, beta, l1, l2, max_iter, h_tol,
                    bf16_ratio: bool = False, w_table=None,
                    kl_newton: bool = False, return_resid: bool = False,
-                   w_colsum=None):
+                   w_colsum=None, use_pallas: bool = False):
     """Inner MU loop on one chunk's usage block with W fixed.
 
     Semantics of ``fit_H_online``'s per-chunk loop (cnmf.py:350-381):
@@ -1239,12 +1301,15 @@ def _chunk_h_solve(x, h, W, WWT, beta, l1, l2, max_iter, h_tol,
     callers' W steps compute — see ``nmf_fit_batch``/``nmf_fit_online``
     and ``parallel/rowshard.py:_rowsharded_pass``.
     """
+    use_pallas = bool(use_pallas and isinstance(x, EllMatrix)
+                      and beta == 1.0)
     if kl_newton and beta == 1.0:
-        if isinstance(x, EllMatrix) and w_table is None:
+        if isinstance(x, EllMatrix) and w_table is None and not use_pallas:
             w_table = ell_w_table(W, x.cols)
 
         def step(h):
-            h_new, _ = _dna_h_step(x, h, W, l1, l2, w_table=w_table)
+            h_new, _ = _dna_h_step(x, h, W, l1, l2, w_table=w_table,
+                                   use_pallas=use_pallas)
             return h_new
     elif beta == 2.0:
         numer0 = x @ W.T
@@ -1258,12 +1323,13 @@ def _chunk_h_solve(x, h, W, WWT, beta, l1, l2, max_iter, h_tol,
     else:
         bf16 = bool(bf16_ratio) and beta in (1.0, 0.0)
         x_cast = x.astype(jnp.bfloat16) if bf16 else x
-        if isinstance(x, EllMatrix) and w_table is None:
+        if isinstance(x, EllMatrix) and w_table is None and not use_pallas:
             w_table = ell_w_table(W, x.cols, bf16=bf16)
 
         def step(h):
             return _update_H(x_cast, h, W, beta, l1, l2, bf16_ratio=bf16,
-                             w_table=w_table, w_colsum=w_colsum)
+                             w_table=w_table, w_colsum=w_colsum,
+                             use_pallas=use_pallas)
 
     def body(carry):
         h, _, it = carry
@@ -1294,7 +1360,7 @@ def _chunk_h_solve(x, h, W, WWT, beta, l1, l2, max_iter, h_tol,
     static_argnames=("beta", "chunk_max_iter", "n_passes", "l1_H", "l2_H",
                      "l1_W", "l2_W", "h_tol_start", "algo", "bf16_ratio",
                      "telemetry", "kl_newton", "sketch_dim",
-                     "sketch_exact_every"),
+                     "sketch_exact_every", "use_pallas"),
 )
 def nmf_fit_online(Xc, Hc0, W0, beta: float = 2.0, tol: float = 1e-4,
                    h_tol: float = 1e-3, chunk_max_iter: int = 1000,
@@ -1303,7 +1369,8 @@ def nmf_fit_online(Xc, Hc0, W0, beta: float = 2.0, tol: float = 1e-4,
                    h_tol_start: float | None = None, algo: str = "mu",
                    bf16_ratio: bool = False, telemetry: bool = False,
                    kl_newton: bool = False, sketch_dim: int = 0,
-                   sketch_exact_every: int = 1):
+                   sketch_exact_every: int = 1,
+                   use_pallas: bool = False):
     """Streamed MU over pre-chunked inputs.
 
     ``Xc``: (n_chunks, chunk, genes) row-chunked data (zero-padded rows are
@@ -1361,6 +1428,10 @@ def nmf_fit_online(Xc, Hc0, W0, beta: float = 2.0, tol: float = 1e-4,
             raise ValueError("the sketch recipe is exclusive with dna")
     bf16_ratio = (bool(bf16_ratio) and beta in (1.0, 0.0)
                   and not kl_newton and not sketch_dim)
+    # fused Pallas kernels (STATIC; ELL beta=1 only — the sketch lane's
+    # sampled-row scatter and every dense/IS chunk keep the jnp path)
+    use_pallas = (bool(use_pallas) and isinstance(Xc, EllMatrix)
+                  and beta == 1.0 and not sketch_dim)
     if algo not in ("mu", "halsvar"):
         raise ValueError(f"unknown online algo {algo!r}")
     if algo == "halsvar" and beta != 2.0:
@@ -1422,15 +1493,25 @@ def nmf_fit_online(Xc, Hc0, W0, beta: float = 2.0, tol: float = 1e-4,
                     # whole inner solve AND the chunk's W step (W only
                     # moves after both); objective stays f32 nonzero-only
                     # (the pass stopping rule keeps production precision
-                    # even when the update chain runs bf16)
-                    table = ell_w_table(W, x.cols, bf16=bf16_ratio)
+                    # even when the update chain runs bf16). Under the
+                    # fused kernels the table lives in VMEM inside each
+                    # kernel instead — no host-side gather to share.
+                    table = (None if use_pallas
+                             else ell_w_table(W, x.cols, bf16=bf16_ratio))
                     h = _chunk_h_solve(x, h, W, None, beta, l1_H, l2_H,
                                        chunk_max_iter, h_tol_p,
                                        bf16_ratio=bf16_ratio,
-                                       w_table=table, kl_newton=kl_newton)
-                    err_c = ell_beta_err(x, h, W, beta)
+                                       w_table=table, kl_newton=kl_newton,
+                                       use_pallas=use_pallas)
+                    if use_pallas:
+                        from .pallas_kl import pallas_kl_beta_err
+
+                        err_c = pallas_kl_beta_err(x, h, W)
+                    else:
+                        err_c = ell_beta_err(x, h, W, beta)
                     W = _update_W(x, h, W, beta, l1_W, l2_W,
-                                  bf16_ratio=bf16_ratio, w_table=table)
+                                  bf16_ratio=bf16_ratio, w_table=table,
+                                  use_pallas=use_pallas)
                     return (W, err_acc + err_c), h
                 h = _chunk_h_solve(x, h, W, None, beta, l1_H, l2_H,
                                    chunk_max_iter, h_tol_p,
@@ -1595,7 +1676,8 @@ def nmf_fit_online(Xc, Hc0, W0, beta: float = 2.0, tol: float = 1e-4,
     # with one extra err-only scan (matches nmf_fit_batch's final recompute)
     def err_chunk(acc, xc_hc):
         x, h = xc_hc
-        return acc + beta_divergence(x, h, W, beta=beta), None
+        return acc + beta_divergence(x, h, W, beta=beta,
+                                     use_pallas=use_pallas), None
 
     err, _ = jax.lax.scan(err_chunk, jnp.float32(0.0), (Xc, Hc))
     if telemetry:
@@ -1610,14 +1692,16 @@ def nmf_fit_online(Xc, Hc0, W0, beta: float = 2.0, tol: float = 1e-4,
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.jit,
-                   static_argnames=("beta", "chunk_max_iter", "l1_H", "l2_H"))
+                   static_argnames=("beta", "chunk_max_iter", "l1_H", "l2_H",
+                                    "use_pallas"))
 def _fit_h_chunked(Xc, Hc0, W, beta: float, chunk_max_iter: int, h_tol: float,
-                   l1_H: float, l2_H: float):
+                   l1_H: float, l2_H: float, use_pallas: bool = False):
     WWT = W @ W.T if beta == 2.0 else None
 
     def scan_chunk(_, xc_hc):
         x, h = xc_hc
-        h = _chunk_h_solve(x, h, W, WWT, beta, l1_H, l2_H, chunk_max_iter, h_tol)
+        h = _chunk_h_solve(x, h, W, WWT, beta, l1_H, l2_H, chunk_max_iter,
+                           h_tol, use_pallas=use_pallas)
         return None, h
 
     _, Hc = jax.lax.scan(scan_chunk, None, (Xc, Hc0))
@@ -1773,8 +1857,13 @@ def fit_h(X, W, H_init=None, chunk_size: int = 5000, chunk_max_iter: int = 200,
             H = jnp.pad(H, ((0, 0), (0, k_solve - H.shape[1])))
     chunk_size = int(min(chunk_size, n))
     Xc, Hc, pad = _chunk_rows(X, H, chunk_size)
+    # fused Pallas kernels for the ELL KL refit (CNMF_TPU_PALLAS;
+    # default 0 keeps the jnp program byte-identical)
+    use_pallas = (isinstance(X, EllMatrix) and float(beta) == 1.0
+                  and resolve_pallas())
     Hc = _fit_h_chunked(Xc, Hc, W, float(beta), int(chunk_max_iter),
-                        float(h_tol), float(l1_reg_H), float(l2_reg_H))
+                        float(h_tol), float(l1_reg_H), float(l2_reg_H),
+                        use_pallas=use_pallas)
     H = Hc.reshape(-1, k_solve)
     if pad:
         H = H[:n]
@@ -2070,6 +2159,10 @@ def run_nmf(X, n_components: int, init: str = "random",
         raise ValueError(
             f"recipe {recipe.label!r} requires beta=1 (KL), got "
             f"beta_loss={beta_loss!r}")
+    # fused Pallas kernels (CNMF_TPU_PALLAS, ISSUE 16): ELL beta=1 only;
+    # the sketch recipe's sampled-row scatter keeps the jnp path
+    use_pallas = (use_ell and beta == 1.0 and recipe.algo != "sketch"
+                  and resolve_pallas())
     k = int(n_components)
     l1_W, l2_W = split_regularization(alpha_W, l1_ratio_W)
     l1_H, l2_H = split_regularization(alpha_H, l1_ratio_H)
@@ -2117,7 +2210,8 @@ def run_nmf(X, n_components: int, init: str = "random",
                 inner_repeats=int(recipe.inner_repeats),
                 kl_newton=bool(recipe.kl_newton),
                 sketch_dim=int(recipe.sketch_dim),
-                sketch_exact_every=int(recipe.sketch_exact_every))
+                sketch_exact_every=int(recipe.sketch_exact_every),
+                use_pallas=use_pallas)
     elif mode == "online":
         chunk = int(min(online_chunk_size, n))
         Xc, Hc, pad = _chunk_rows(X, H0, chunk)
@@ -2132,7 +2226,8 @@ def run_nmf(X, n_components: int, init: str = "random",
             bf16_ratio=resolve_bf16_ratio(beta, mode),
             kl_newton=bool(recipe.kl_newton),
             sketch_dim=int(recipe.sketch_dim),
-            sketch_exact_every=int(recipe.sketch_exact_every))
+            sketch_exact_every=int(recipe.sketch_exact_every),
+            use_pallas=use_pallas)
         H = Hc.reshape(-1, k)[:n]
     else:
         raise ValueError(f"unknown mode {mode!r}")

@@ -1,0 +1,139 @@
+"""Pallas dispatch guard for the fused sparse-KL kernels (ISSUE 16).
+
+The fused kernels themselves live in ``ops/pallas_kl.py`` (which imports
+``jax.experimental.pallas`` at module top); THIS package is the thin
+guard the dispatch sites consult so the rest of the codebase never
+imports Pallas directly:
+
+  * :func:`resolve_pallas` — the one resolution of the
+    ``CNMF_TPU_PALLAS`` knob (``0`` | ``1`` | ``auto``, house style per
+    ``CNMF_TPU_ACCEL``): ``0`` (default) pins the jnp ELL path — the
+    compiled programs are byte-identical to a build without the kernel
+    layer; ``1`` forces the fused kernels wherever defined (off-TPU they
+    run in interpret mode — correct, slow, CI-testable); ``auto``
+    engages them only when the default backend is a real TPU. If Pallas
+    itself cannot be imported the resolver degrades to the jnp path with
+    one loud announcement instead of failing.
+  * :func:`pallas_interpret` — whether ``pallas_call`` must run in
+    interpret mode (any non-TPU backend: the kernels are written against
+    the TPU lowering; interpret mode is the portable reference).
+  * :func:`kernel_label` — the one spelling of the engaged-kernel label
+    that telemetry dispatch events, provenance, the checkpoint identity,
+    and ``bench.py --tier mfu`` all share (``ell-pallas`` / ``ell-jnp``
+    / ``vmapped-bf16`` / ``vmapped``).
+
+The kernels cover the ELL β=1 (KL) statistics only: the IS (β=0) chain
+is a hybrid with a dense WH matmul (no one-pass nonzero traversal to
+fuse) and the sketch recipe's row-subsampled W update needs a scatter
+the transpose index set cannot serve — both keep the jnp path
+regardless of the knob, as does every dense lane (the old dense-Pallas
+experiment lost under vmap; see ``ops/nmf.py:_update_H``).
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["PALLAS_ENV", "pallas_available", "pallas_interpret",
+           "resolve_pallas", "kernel_label"]
+
+PALLAS_ENV = "CNMF_TPU_PALLAS"
+
+_OFF_WORDS = ("", "0", "off", "false", "no")
+_ON_WORDS = ("1", "on", "true", "yes", "force")
+
+_pallas_import_ok: bool | None = None
+_announced = False
+_state_lock = threading.Lock()
+
+
+def pallas_available() -> bool:
+    """Whether ``jax.experimental.pallas`` imports at all (cached). The
+    repo supports jax>=0.4.36, where it does — this guards exotic
+    builds stripped of the experimental tree."""
+    global _pallas_import_ok
+    if _pallas_import_ok is None:
+        try:
+            from jax.experimental import pallas  # noqa: F401
+
+            ok = True
+        except Exception:
+            ok = False
+        with _state_lock:
+            if _pallas_import_ok is None:
+                _pallas_import_ok = ok
+    return _pallas_import_ok
+
+
+def pallas_interpret() -> bool:
+    """True when ``pallas_call`` must run in interpret mode: any backend
+    that is not a real TPU. Interpret mode executes the kernel body as
+    plain jax ops — the CPU tier-1 suite tests the whole dispatch
+    surface with it."""
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def _announce(msg: str) -> None:
+    global _announced
+    with _state_lock:
+        first = not _announced
+        _announced = True
+    if first:
+        print(msg)
+
+
+def resolve_pallas(override=None) -> bool:
+    """Resolve the ``CNMF_TPU_PALLAS`` knob to an engage/don't bool.
+
+    An explicit ``override`` wins (same precedence contract as
+    ``resolve_bf16_ratio``). The word semantics mirror
+    ``CNMF_TPU_ACCEL``: off-words pin the jnp path, on-words force the
+    fused kernels (interpret mode off-TPU), ``auto`` engages only on a
+    TPU backend. Unknown words raise at resolution time with a one-line
+    message naming the knob. The first engagement per process is
+    announced on stdout — the kernels change accumulation order vs the
+    jnp chain (f32-tolerance parity, not bit parity), and
+    parity-sensitive users should find the opt-out without reading
+    this docstring."""
+    if override is not None:
+        want = bool(override)
+    else:
+        from ...utils.envknobs import env_str
+
+        raw = env_str(PALLAS_ENV, "0").strip().lower()
+        if raw in _OFF_WORDS:
+            return False
+        if raw in _ON_WORDS:
+            want = True
+        elif raw == "auto":
+            want = not pallas_interpret()
+        else:
+            raise ValueError(
+                f"{PALLAS_ENV}={raw!r}: expected 0, 1, or auto")
+    if not want:
+        return False
+    if not pallas_available():
+        _announce(
+            "cnmf-tpu: CNMF_TPU_PALLAS requested but jax.experimental."
+            "pallas is unavailable in this jax build - degrading to the "
+            "jnp ELL path.")
+        return False
+    _announce(
+        "cnmf-tpu: fused Pallas KL kernels active for ELL beta=1 solves"
+        + (" (interpret mode: non-TPU backend - parity-testable, "
+           "not a perf configuration)." if pallas_interpret()
+           else " (set CNMF_TPU_PALLAS=0 for the jnp-parity path)."))
+    return True
+
+
+def kernel_label(use_ell: bool, use_pallas: bool = False,
+                 bf16_ratio: bool = False) -> str:
+    """The engaged inner-loop kernel label shared by telemetry dispatch
+    events, provenance, checkpoint identity, and ``bench.py --tier
+    mfu``: ``ell-pallas`` (fused kernels), ``ell-jnp`` (gather-based jnp
+    ELL path), ``vmapped-bf16`` / ``vmapped`` (dense chains)."""
+    if use_ell:
+        return "ell-pallas" if use_pallas else "ell-jnp"
+    return "vmapped-bf16" if bf16_ratio else "vmapped"

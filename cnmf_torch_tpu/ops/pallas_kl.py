@@ -1,0 +1,327 @@
+"""Fused Pallas kernels for the ELL sparse-KL inner loop (ISSUE 16).
+
+BENCH_r03-r05 put the dense Frobenius lanes at 36-42% MFU and the KL
+lane at 2-3.4%: as XLA emits it, the ELL chain is a gather (slab table)
+-> elementwise ratio -> reduce pipeline that re-reads HBM between every
+stage. Each kernel here is ONE traversal of the stored nonzeros with the
+full (k, g) W table resident in VMEM: the slab gathers, the WH
+reconstruction, the ratio, and the f32 statistic reductions all happen
+on the same (BLOCK_N x width) tile without round-tripping HBM — and
+under PR 8's inner-repeat hoist the repeats re-enter the kernel with W
+still on-chip. Math mirrors the jnp oracles in ``ops/sparse.py``
+(``ell_kl_h_stats`` / ``ell_kl_h_newton_stats`` / ``ell_kl_w_stats`` /
+``ell_beta_err``) to f32 tolerance — accumulation ORDER differs (block
+tiles vs one flat reduce), bit parity is not claimed. bf16 value
+storage with f32 accumulators follows the same ``resolve_bf16_ratio``
+rules as the jnp chain.
+
+Kernel inventory (all β=1/KL; the IS hybrid and the sketch scatter stay
+jnp — see ``ops/pallas/__init__``):
+
+  * :func:`pallas_wh_at_nz`        — SDDMM: WH at the stored coords;
+  * :func:`pallas_kl_h_stats`      — MU H numerator (+ broadcast denom);
+  * :func:`pallas_kl_h_newton_stats` — MU numerator + Diagonalized-
+    Newton diagonal Hessian (arXiv 1301.3389) in the SAME pass;
+  * :func:`pallas_kl_w_numer` / :func:`pallas_kl_w_stats` — W-side
+    statistics as two passes: a fused ratio kernel over row tiles, then
+    a transpose-side reduce over gene tiles through the precomputed
+    ``rows_t``/``perm_t`` index set (a single fused kernel would need a
+    cross-tile barrier: every row's ratio must exist before any gene
+    reduces it);
+  * :func:`pallas_kl_beta_err`     — the nonzero-supported KL objective
+    contribution (per-tile partials; the k-sized ``Σ WH`` term is jnp).
+
+Grid strategy: row-side kernels tile the rows ((BLOCK_N, width) blocks,
+W resident via a constant index map); the transpose kernel tiles the
+genes with the flat ratio buffer and H fully resident. Inputs are
+zero-PADDED up to the tile multiple in the host wrappers rather than
+masked in-kernel: interpret mode implements block indexing with clamped
+dynamic slices, so boundary tiles OVERLAP rows and in-kernel row-index
+masks are unsound — while by the ELL conventions (value 0 / column 0 /
+zero H rows / ``perm_t`` sentinel -> appended zero) padded rows and
+genes contribute exact +0.0 to every statistic and to the objective.
+
+Off-TPU the wrappers run ``interpret=True`` (plain-jax reference
+semantics, vmap/jit/shard_map composable) — that is how the CPU tier-1
+suite tests this whole surface; on TPU they lower natively. Import this
+module only behind ``ops.pallas.resolve_pallas`` so builds without
+``jax.experimental.pallas`` never touch it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .pallas import pallas_interpret
+from .sparse import EPS, EllMatrix
+
+__all__ = ["pallas_wh_at_nz", "pallas_kl_h_stats",
+           "pallas_kl_h_newton_stats", "pallas_kl_w_numer",
+           "pallas_kl_w_stats", "pallas_kl_beta_err",
+           "BLOCK_N", "BLOCK_G"]
+
+# row/gene tile sizes: multiples of the f32 sublane tile (8) with room
+# for the (tile x width) slab working set in VMEM at single-cell widths
+BLOCK_N = 128
+BLOCK_G = 128
+
+
+def _interp(interpret) -> bool:
+    return pallas_interpret() if interpret is None else bool(interpret)
+
+
+def _ceil_to(n: int, m: int) -> int:
+    return -(-int(n) // m) * m
+
+
+def _pad_rows(a, n_pad: int):
+    n = a.shape[0]
+    if n == n_pad:
+        return a
+    return jnp.pad(a, ((0, n_pad - n),) + ((0, 0),) * (a.ndim - 1))
+
+
+def _row_specs(w: int, k: int, g: int):
+    """BlockSpecs for the row-side kernels: (vals, cols, H) row tiles +
+    the full W resident in every grid step."""
+    return [pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0)),
+            pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0)),
+            pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0)),
+            pl.BlockSpec((k, g), lambda i: (0, 0))]
+
+
+def _gather_slabs(wt, cols, k: int):
+    """The in-kernel slab table: one VMEM gather of W's row c at the
+    tile's stored columns, per component — gathered ONCE per tile and
+    reused by WH, the ratio statistics, and the squared-slab Hessian
+    (the fusion the jnp chain cannot express across its HBM stages)."""
+    return [jnp.take(wt[c], cols, mode="clip") for c in range(k)]
+
+
+def _wh_from_slabs(h, slabs, k: int):
+    acc = h[:, 0:1] * slabs[0]
+    for c in range(1, k):
+        acc = acc + h[:, c:c + 1] * slabs[c]
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# kernel bodies (traced scopes: interpret mode runs them as plain jax)
+# ---------------------------------------------------------------------------
+
+def _wh_body(vals_ref, cols_ref, h_ref, w_ref, o_ref, *, k):
+    del vals_ref  # SDDMM needs the coordinates only; shared specs
+    cols = cols_ref[...]
+    slabs = _gather_slabs(w_ref[...], cols, k)
+    o_ref[...] = _wh_from_slabs(h_ref[...], slabs, k)
+
+
+def _h_stats_body(vals_ref, cols_ref, h_ref, w_ref, numer_ref, *,
+                  k, bf16):
+    vals, h, wt = vals_ref[...], h_ref[...], w_ref[...]
+    if bf16:
+        vals = vals.astype(jnp.bfloat16)
+        h = h.astype(jnp.bfloat16)
+        wt = wt.astype(jnp.bfloat16)
+    slabs = _gather_slabs(wt, cols_ref[...], k)
+    wh = _wh_from_slabs(h, slabs, k)
+    ratio = vals / jnp.maximum(wh, jnp.asarray(EPS, wh.dtype))
+    numer_ref[...] = jnp.stack(
+        [jnp.sum((ratio * slabs[c]).astype(jnp.float32), axis=-1)
+         for c in range(k)], axis=-1)
+
+
+def _h_newton_body(vals_ref, cols_ref, h_ref, w_ref, numer_ref,
+                   hess_ref, *, k):
+    vals = vals_ref[...]
+    slabs = _gather_slabs(w_ref[...], cols_ref[...], k)
+    wh = _wh_from_slabs(h_ref[...], slabs, k)
+    whm = jnp.maximum(wh, jnp.asarray(EPS, wh.dtype))
+    ratio = vals / whm
+    r2 = ratio / whm
+    numer_ref[...] = jnp.stack(
+        [jnp.sum((ratio * slabs[c]).astype(jnp.float32), axis=-1)
+         for c in range(k)], axis=-1)
+    hess_ref[...] = jnp.stack(
+        [jnp.sum((r2 * slabs[c] * slabs[c]).astype(jnp.float32), axis=-1)
+         for c in range(k)], axis=-1)
+
+
+def _ratio_body(vals_ref, cols_ref, h_ref, w_ref, o_ref, *, k, bf16):
+    vals, h, wt = vals_ref[...], h_ref[...], w_ref[...]
+    if bf16:
+        vals = vals.astype(jnp.bfloat16)
+        h = h.astype(jnp.bfloat16)
+        wt = wt.astype(jnp.bfloat16)
+    slabs = _gather_slabs(wt, cols_ref[...], k)
+    wh = _wh_from_slabs(h, slabs, k)
+    o_ref[...] = vals / jnp.maximum(wh, jnp.asarray(EPS, wh.dtype))
+
+
+def _obj_body(vals_ref, cols_ref, h_ref, w_ref, o_ref, *, k):
+    vals = vals_ref[...]
+    slabs = _gather_slabs(w_ref[...], cols_ref[...], k)
+    wh = _wh_from_slabs(h_ref[...], slabs, k)
+    # kl_nz_term (ops/sparse.py) inlined on the tile: both regimes of
+    # the cancellation-safe form, minus the nonzero WH term
+    xp = jnp.maximum(vals, jnp.float32(EPS))
+    whs = jnp.maximum(wh, jnp.float32(EPS))
+    ratio = whs / xp
+    u = ratio - 1.0
+    stable = u - jnp.log1p(jnp.maximum(u, -1.0 + EPS))
+    tiny = u + jnp.log(xp) - jnp.log(whs)
+    term = xp * jnp.where(ratio < 1e-6, tiny, stable)
+    nz = jnp.where(vals > 0, term - wh, 0.0)
+    o_ref[...] = jnp.sum(nz).reshape((1,))
+
+
+def _w_numer_body(rows_t_ref, perm_t_ref, rflat_ref, h_ref, o_ref, *, k):
+    rows_t = rows_t_ref[...]                      # (BLOCK_G, wt)
+    r_t = jnp.take(rflat_ref[...], perm_t_ref[...], mode="clip")
+    h = h_ref[...]                                # (n, k) resident
+    o_ref[...] = jnp.stack(
+        [jnp.sum((r_t * jnp.take(h[:, c], rows_t, mode="clip")).astype(
+            jnp.float32), axis=-1) for c in range(k)], axis=0)
+
+
+# ---------------------------------------------------------------------------
+# host wrappers (jit-traceable; vmap adds a leading grid dim per the
+# pallas_call batching rule, which is how the replicate sweeps hit them)
+# ---------------------------------------------------------------------------
+
+def _row_call(body, x: EllMatrix, H, W, out_shapes, out_specs,
+              interpret, **static):
+    n, w = x.cols.shape
+    k, g = W.shape
+    n_pad = _ceil_to(n, BLOCK_N)
+    return pl.pallas_call(
+        functools.partial(body, k=k, **static),
+        out_shape=out_shapes,
+        grid=(n_pad // BLOCK_N,),
+        in_specs=_row_specs(w, k, g),
+        out_specs=out_specs,
+        interpret=_interp(interpret),
+    )(_pad_rows(x.vals, n_pad), _pad_rows(x.cols, n_pad),
+      _pad_rows(H, n_pad), W), n_pad
+
+
+def pallas_wh_at_nz(x: EllMatrix, H, W, interpret=None):
+    """Fused SDDMM: ``wh[i, j] = H[i, :] @ W[:, cols[i, j]]`` in one
+    traversal. Parity oracle: ``ops.sparse.ell_wh_at_nz``."""
+    n = x.cols.shape[0]
+    dt = jnp.result_type(H.dtype, W.dtype)
+    n_pad = _ceil_to(n, BLOCK_N)
+    out, _ = _row_call(
+        _wh_body, x, H.astype(dt), W.astype(dt),
+        jax.ShapeDtypeStruct((n_pad, x.width), dt),
+        pl.BlockSpec((BLOCK_N, x.width), lambda i: (i, 0)), interpret)
+    return out[:n]
+
+
+def pallas_kl_h_stats(x: EllMatrix, H, W, bf16_ratio: bool = False,
+                      interpret=None):
+    """KL H-update statistics in one fused pass (parity oracle:
+    ``ops.sparse.ell_kl_h_stats``). The data-independent broadcast
+    ``W.sum(axis=1)`` denominator never touches X and stays jnp —
+    bitwise the oracle's."""
+    n, k = H.shape
+    n_pad = _ceil_to(n, BLOCK_N)
+    numer, _ = _row_call(
+        _h_stats_body, x, H, W,
+        jax.ShapeDtypeStruct((n_pad, k), jnp.float32),
+        pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0)), interpret,
+        bf16=bool(bf16_ratio))
+    denom = jnp.broadcast_to(W.sum(axis=1)[None, :], H.shape)
+    return numer[:n], denom
+
+
+def pallas_kl_h_newton_stats(x: EllMatrix, H, W, interpret=None):
+    """MU numerator + Diagonalized-Newton diagonal Hessian in the SAME
+    nonzero traversal (the jnp chain walks the gathers twice; arXiv
+    1301.3389's statistics share every operand with the ratio). Strict
+    f32, like the oracle ``ops.sparse.ell_kl_h_newton_stats``."""
+    n, k = H.shape
+    n_pad = _ceil_to(n, BLOCK_N)
+    (numer, hess), _ = _row_call(
+        _h_newton_body, x, H, W,
+        (jax.ShapeDtypeStruct((n_pad, k), jnp.float32),
+         jax.ShapeDtypeStruct((n_pad, k), jnp.float32)),
+        (pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0)),
+         pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0))), interpret)
+    denom = jnp.broadcast_to(W.sum(axis=1)[None, :], H.shape)
+    return numer[:n], denom, hess[:n]
+
+
+def pallas_kl_w_numer(x: EllMatrix, H, W, bf16_ratio: bool = False,
+                      interpret=None):
+    """KL W-update numerator ``H^T @ (X/WH)`` as two fused passes: the
+    row-tile ratio kernel, then the gene-tile transpose reduce through
+    ``rows_t``/``perm_t`` (parity oracle: ``ops.sparse.ell_kl_w_numer``).
+    Padding genes carry the ``perm_t`` sentinel ``n*w`` -> the appended
+    zero ratio slot, an exact +0.0."""
+    if x.rows_t is None:
+        raise ValueError(
+            "this EllMatrix has no transpose index set (rows_t/perm_t); "
+            "encode with csr_to_ell(transpose=True) / ell_chunk_rows "
+            "for W-side updates")
+    n, w = x.cols.shape
+    k = H.shape[-1]
+    rdt = jnp.bfloat16 if bf16_ratio else jnp.result_type(
+        x.vals.dtype, H.dtype, W.dtype)
+    n_pad = _ceil_to(n, BLOCK_N)
+    ratio, _ = _row_call(
+        _ratio_body, x, H, W,
+        jax.ShapeDtypeStruct((n_pad, w), rdt),
+        pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0)), interpret,
+        bf16=bool(bf16_ratio))
+    r_flat = jnp.concatenate(
+        [ratio[:n].reshape(-1), jnp.zeros((1,), ratio.dtype)])
+    g, wt = x.rows_t.shape
+    g_pad = _ceil_to(g, BLOCK_G)
+    rows_t = _pad_rows(x.rows_t, g_pad)
+    perm_t = x.perm_t if g == g_pad else jnp.pad(
+        x.perm_t, ((0, g_pad - g), (0, 0)), constant_values=n * w)
+    Hc = H.astype(jnp.bfloat16) if bf16_ratio else H
+    numer = pl.pallas_call(
+        functools.partial(_w_numer_body, k=k),
+        out_shape=jax.ShapeDtypeStruct((k, g_pad), jnp.float32),
+        grid=(g_pad // BLOCK_G,),
+        in_specs=[pl.BlockSpec((BLOCK_G, wt), lambda i: (i, 0)),
+                  pl.BlockSpec((BLOCK_G, wt), lambda i: (i, 0)),
+                  pl.BlockSpec((n * w + 1,), lambda i: (0,)),
+                  pl.BlockSpec((n, k), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((k, BLOCK_G), lambda i: (0, i)),
+        interpret=_interp(interpret),
+    )(rows_t, perm_t, r_flat, Hc)
+    return numer[:, :g]
+
+
+def pallas_kl_w_stats(x: EllMatrix, H, W, bf16_ratio: bool = False,
+                      interpret=None):
+    """Full KL W-update statistics (parity oracle:
+    ``ops.sparse.ell_kl_w_stats``); the column-sum denominator is
+    data-independent and stays jnp."""
+    numer = pallas_kl_w_numer(x, H, W, bf16_ratio, interpret)
+    denom = jnp.broadcast_to(H.sum(axis=0)[:, None], W.shape)
+    return numer, denom
+
+
+def pallas_kl_beta_err(x: EllMatrix, H, W, interpret=None):
+    """``D_KL(X || HW)`` from the ELL encoding: the nonzero-supported
+    terms reduce per tile inside the kernel (one (num_tiles,) partial
+    buffer comes back), the k-sized ``Σ WH = H.sum(0)·W.sum(1)`` term is
+    jnp. Parity oracle: ``ops.sparse.ell_beta_err`` at β=1."""
+    n = x.cols.shape[0]
+    n_pad = _ceil_to(n, BLOCK_N)
+    xs = EllMatrix(x.vals.astype(jnp.float32), x.cols, x.g,
+                   x.rows_t, x.perm_t)
+    partials, _ = _row_call(
+        _obj_body, xs, H.astype(jnp.float32), W.astype(jnp.float32),
+        jax.ShapeDtypeStruct((n_pad // BLOCK_N,), jnp.float32),
+        pl.BlockSpec((1,), lambda i: (i,)), interpret)
+    total_wh = jnp.sum(H.sum(axis=0) * W.sum(axis=1))
+    return jnp.sum(partials) + total_wh

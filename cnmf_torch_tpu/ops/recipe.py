@@ -153,16 +153,25 @@ class SolverRecipe:
         return (self.inner_repeats == 1 and not self.kl_newton
                 and self.algo != "sketch")
 
-    def signature(self) -> str:
+    def signature(self, kernel: str | None = None) -> str:
         """Stable string for the checkpoint identity ``params`` field —
         two runs whose signatures differ must not splice trajectories.
         Sketch fields append only when the sketch lane is engaged, so
-        pre-sketch checkpoints keep their identity."""
+        pre-sketch checkpoints keep their identity.
+
+        ``kernel`` (ISSUE 16): the engaged inner-loop kernel label
+        (``ops/pallas/__init__.py:kernel_label``) — passed by callers
+        ONLY when the fused Pallas kernels engage, so default-path
+        checkpoints keep their pre-Pallas identity while a resume
+        across a ``CNMF_TPU_PALLAS`` flip (either direction) restarts
+        instead of splicing two accumulation orders' trajectories."""
         sig = (f"algo={self.algo},rho={int(self.inner_repeats)},"
                f"newton={int(self.kl_newton)}")
         if self.algo == "sketch":
             sig += (f",skdim={int(self.sketch_dim)},"
                     f"skE={int(self.sketch_exact_every)}")
+        if kernel is not None:
+            sig += f",kernel={kernel}"
         return sig
 
     def as_context(self) -> dict:

@@ -871,6 +871,15 @@ def nmf_fit_grid2d(X, k: int, mesh: Mesh, beta_loss="frobenius",
             "the sketch recipe has no (cells x genes) grid lane — run "
             "the 1-D rowshard path, or pin CNMF_TPU_SKETCH=0 for grid2d")
     kl_newton = bool(recipe.kl_newton)
+    # fused Pallas KL kernels (ISSUE 16) have no grid lane: the 2-D grid
+    # stages dense gene stripes (no ELL encoding), so the knob is merely
+    # consulted — bad knob words fail as loudly here as on the ELL paths,
+    # and a forced =1 run still compiles the bit-identical dense pass
+    # programs — and the records carry the literal dense kernel label
+    from ..ops.pallas import resolve_pallas
+
+    resolve_pallas()
+    kernel = "dense-jnp"
 
     key = jax.random.key(int(seed) & 0x7FFFFFFF)
     x_mean = jnp.sum(Xd) / (n_pad * g_pad)
@@ -947,7 +956,7 @@ def nmf_fit_grid2d(X, k: int, mesh: Mesh, beta_loss="frobenius",
             "iters": np.asarray([int(np.asarray(iters_run))]),
             "nonfinite": np.asarray([bool(np.asarray(nonfin_flag))]),
             "errs": np.asarray([err_f], np.float64),
-            "recipe": recipe.label})
+            "recipe": recipe.label, "kernel": kernel})
     if events is not None and getattr(events, "enabled", False):
         n_dev = c_dim * g_dim
         passes_run = (int(np.asarray(iters_run))
@@ -959,6 +968,7 @@ def nmf_fit_grid2d(X, k: int, mesh: Mesh, beta_loss="frobenius",
                      "mesh_shape": [int(c_dim), int(g_dim)],
                      "blocks": [int(nblk_h), int(nblk_w)],
                      "overlap": bool(overlap),
+                     "kernel": kernel,
                      "passes": passes_run},
             wall_s=round(wall, 4),
             nbytes=_coll_bytes_per_pass(rows_loc, g_loc, int(k), beta,

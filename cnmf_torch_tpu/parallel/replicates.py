@@ -42,18 +42,22 @@ from ..ops.nmf import (
     split_regularization,
 )
 from ..ops.nmf import EVAL_EVERY, SolverTelemetry
+from ..ops.pallas import kernel_label, resolve_pallas
 from ..ops.recipe import SolverRecipe, resolve_recipe
 from ..ops.sparse import EllMatrix, ell_device_put
 
 
 def _sweep_telemetry_payload(k, beta, mode, seeds, cap, tm, errs,
-                             recipe: SolverRecipe | None = None):
+                             recipe: SolverRecipe | None = None,
+                             kernel: str | None = None):
     """The dict a sweep's ``telemetry_sink`` receives. Array values are
     DEVICE arrays (one dispatch-ordered fetch per sweep already covers
     them) — callers ``np.asarray`` when they land events, so a
     ``fetch=False`` pipeline keeps its overlap. ``recipe`` labels the
-    engaged solver recipe; the batch solvers' inner-update counts and
-    dna fallback-lane fractions ride along when tracked."""
+    engaged solver recipe; ``kernel`` labels the engaged inner-loop
+    statistics kernel (``ops/pallas/__init__.py:kernel_label``, ISSUE
+    16); the batch solvers' inner-update counts and dna fallback-lane
+    fractions ride along when tracked."""
     out = {
         "k": int(k), "beta": float(beta), "mode": mode,
         "seeds": [int(s) for s in seeds],
@@ -64,6 +68,8 @@ def _sweep_telemetry_payload(k, beta, mode, seeds, cap, tm, errs,
     }
     if recipe is not None:
         out["recipe"] = recipe.label
+    if kernel is not None:
+        out["kernel"] = kernel
     if tm.inner_iters is not None:
         out["inner_iters"] = tm.inner_iters
     if tm.dna_fallback is not None:
@@ -317,6 +323,13 @@ def warm_sweep_programs(n: int, g: int, k_to_count: dict,
 
     def compile_one(spec):
         k, r_pad = spec
+        # the pallas kwarg rides only when the knob engages — same
+        # omit-on-default convention as _recipe_statics, so the warm key
+        # matches replicate_sweep's dispatch key either way (ISSUE 16)
+        pallas_kw = ({"use_pallas": True}
+                     if (ell_dims is not None and beta == 1.0
+                         and per_k_recipe[k].algo != "sketch"
+                         and resolve_pallas()) else {})
         prog = _sweep_program(
             n, g, k, r_pad, init, mode, beta, float(tol),
             float(online_h_tol), int(min(online_chunk_size, n)),
@@ -329,7 +342,8 @@ def warm_sweep_programs(n: int, g: int, k_to_count: dict,
             bf16_ratio=(False if (per_k_recipe[k].kl_newton
                                   or per_k_recipe[k].algo == "sketch")
                         else resolve_bf16_ratio(beta, mode)),
-            telemetry=telem, **_recipe_statics(per_k_recipe[k]))
+            telemetry=telem, **_recipe_statics(per_k_recipe[k]),
+            **pallas_kw)
         if ell_dims is not None:
             w_e, wt_e = int(ell_dims[0]), int(ell_dims[1])
             if mode == "online":
@@ -491,7 +505,7 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
                    bf16_ratio: bool = False, telemetry: bool = False,
                    algo: str = "mu", inner_repeats: int = 1,
                    kl_newton: bool = False, sketch_dim: int = 0,
-                   sketch_exact_every: int = 1):
+                   sketch_exact_every: int = 1, use_pallas: bool = False):
     """Build (once per static configuration) the jitted sweep executable
     ``(X (n,g), seeds (R,)) -> (usages | (0,), spectra (R,k,g), errs (R,))``.
 
@@ -514,6 +528,12 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
     ``nmf_fit_batch``/``nmf_fit_online``. The identity recipe
     ``('mu', 1, False)`` hits the same cache entries (and compiles the
     byte-identical programs) as a build without the recipe layer.
+
+    ``use_pallas`` (ISSUE 16) threads the fused Pallas KL kernel dispatch
+    into the ELL β=1 solvers. Call sites follow the ``_recipe_statics``
+    convention: the kwarg is passed ONLY when the ``CNMF_TPU_PALLAS``
+    knob engages, so the default resolution hits the same lru_cache
+    entry — same program object — as a build without the kernel layer.
 
     ``packed=True`` builds the PACKED K-sweep variant: ``k`` is K_max, the
     program additionally takes the slice's actual component count (a traced
@@ -571,6 +591,8 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
                 kw = ({"sketch_dim": sketch_dim,
                        "sketch_exact_every": sketch_exact_every}
                       if sketch_dim else {})
+                if use_pallas:
+                    kw["use_pallas"] = True
                 return nmf_fit_batch(
                     X, h0, w0, beta=beta, tol=tol, max_iter=batch_max_iter,
                     l1_H=l1_H, l2_H=l2_H, l1_W=l1_W, l2_W=l2_W,
@@ -581,6 +603,8 @@ def _sweep_program(n: int, g: int, k: int, R: int, init: str, mode: str,
             kw = ({"sketch_dim": sketch_dim,
                    "sketch_exact_every": sketch_exact_every}
                   if sketch_dim else {})
+            if use_pallas:
+                kw["use_pallas"] = True
             Xc, Hc, _ = _chunk_rows(X, h0, chunk)
             out = nmf_fit_online(
                 Xc, Hc, w0, beta=beta, tol=tol, h_tol=h_tol,
@@ -801,7 +825,12 @@ def replicate_sweep_packed(X, ks, seeds, beta_loss="frobenius",
                 telemetry_sink(sl_idx, _sweep_telemetry_payload(
                     kv, beta, mode, [seeds[i] for i in sl_idx],
                     n_passes if mode == "online" else batch_max_iter,
-                    _slice_telemetry(out[3], r), err[:r], recipe=recipe))
+                    _slice_telemetry(out[3], r), err[:r], recipe=recipe,
+                    kernel=kernel_label(
+                        False, False,
+                        (False if (recipe.kl_newton
+                                   or recipe.algo == "sketch")
+                         else resolve_bf16_ratio(beta, mode)))))
             if on_slice is not None:
                 on_slice(sl_idx, np.asarray(W[:r]), np.asarray(err[:r]))
                 continue
@@ -987,6 +1016,13 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
             X = jax.device_put(X, target)
 
     want_telem = _telemetry_requested(telemetry_sink)
+    # fused Pallas KL dispatch (ISSUE 16): ELL β=1 sweeps only, and the
+    # kwarg rides only when the knob engages — _recipe_statics convention,
+    # so the default resolution shares the no-kernel-layer cache entry
+    pallas_kw = ({"use_pallas": True}
+                 if (isinstance(X, EllMatrix) and beta == 1.0
+                     and recipe.algo != "sketch" and resolve_pallas())
+                 else {})
     parts = []
     telem_parts = []
     for start, r, r_pad in slices:
@@ -1004,7 +1040,7 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
             bf16_ratio=(False if (recipe.kl_newton
                                   or recipe.algo == "sketch")
                         else resolve_bf16_ratio(beta, mode)),
-            telemetry=want_telem, **_recipe_statics(recipe))
+            telemetry=want_telem, **_recipe_statics(recipe), **pallas_kw)
         # async dispatch: every slice is enqueued before any result is read
         out = prog(X, np.asarray(sl, dtype=np.uint32))
         H, W, err = out[:3]
@@ -1024,7 +1060,11 @@ def replicate_sweep(X, seeds, k: int, beta_loss="frobenius", init: str = "random
         telemetry_sink(_sweep_telemetry_payload(
             k, beta, mode, seeds,
             n_passes if mode == "online" else batch_max_iter,
-            _concat_telemetry(telem_parts), errs_d, recipe=recipe))
+            _concat_telemetry(telem_parts), errs_d, recipe=recipe,
+            kernel=kernel_label(
+                isinstance(X, EllMatrix), bool(pallas_kw),
+                (False if (recipe.kl_newton or recipe.algo == "sketch")
+                 else resolve_bf16_ratio(beta, mode)))))
 
     if not fetch:
         return spectra_d, usages_d, errs_d

@@ -52,6 +52,7 @@ from ..ops.nmf import (
     split_regularization,
 )
 from ..ops.nmf import _apply_rate_sketched
+from ..ops.pallas import resolve_pallas
 from ..ops.sparse import (
     EllMatrix,
     csr_to_ell,
@@ -328,7 +329,7 @@ def prepare_rowsharded(X, mesh: Mesh, stats: StreamStats | None = None,
 
 def _rowsharded_pass(X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
                      l1_H, l2_H, l1_W, l2_W, kl_newton: bool = False,
-                     sketch=None, pass_idx=0):
+                     sketch=None, pass_idx=0, use_pallas: bool = False):
     """One block-coordinate pass on this shard's rows + the global W update.
 
     Runs identically on every device; `psum` makes the W statistics global,
@@ -352,6 +353,12 @@ def _rowsharded_pass(X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
     (``ops/nmf.py:_apply_rate_sketched``). ``pass_idx`` is a traced
     scalar so the per-pass program is compiled once.
 
+    ``use_pallas`` (static; ISSUE 16): the ELL β=1 branch computes the W
+    numerator and the psum'd objective with the fused Pallas kernels
+    (``ops/pallas_kl.py``) — the kernels run per-shard on the local rows
+    BEFORE the psum, so the collective shapes and ICI bytes are
+    unchanged. Default ``False`` traces the jnp chain unchanged.
+
     Returns ``(H_local, W, err, A, B)``. For beta=2, ``(A, B)`` are the
     pass's psum'd sufficient statistics (``H^T X``, ``H^T H``) — already
     computed for the W-subproblem, and exactly what the mid-run
@@ -363,7 +370,8 @@ def _rowsharded_pass(X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
     A = B = None
     WWT = W @ W.T if beta == 2.0 else None
     H_local = _chunk_h_solve(X_local, H_local, W, WWT, beta, l1_H, l2_H,
-                             chunk_max_iter, h_tol, kl_newton=kl_newton)
+                             chunk_max_iter, h_tol, kl_newton=kl_newton,
+                             use_pallas=use_pallas)
     if beta == 2.0:
         A = jax.lax.psum(H_local.T @ X_local, axis)
         B = jax.lax.psum(H_local.T @ H_local, axis)
@@ -425,7 +433,13 @@ def _rowsharded_pass(X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
         # psum'd objects stay the same k x g / k-sized arrays as the dense
         # path, so ICI bytes per pass are unchanged
         if beta == 1.0:
-            numer = jax.lax.psum(ell_kl_w_numer(X_local, H_local, W), axis)
+            if use_pallas:
+                from ..ops.pallas_kl import pallas_kl_w_numer
+
+                numer_l = pallas_kl_w_numer(X_local, H_local, W)
+            else:
+                numer_l = ell_kl_w_numer(X_local, H_local, W)
+            numer = jax.lax.psum(numer_l, axis)
             denom = jnp.broadcast_to(
                 jax.lax.psum(H_local.sum(axis=0), axis)[:, None], W.shape)
         else:  # beta == 0.0 (itakura-saito, hybrid: dense WH denominator)
@@ -433,7 +447,13 @@ def _rowsharded_pass(X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
             numer = jax.lax.psum(numer, axis)
             denom = jax.lax.psum(denom, axis)
         W = _apply_rate(W, numer, denom, l1_W, l2_W, gamma=mu_gamma(beta))
-        err = jax.lax.psum(ell_beta_err(X_local, H_local, W, beta), axis)
+        if use_pallas and beta == 1.0:
+            from ..ops.pallas_kl import pallas_kl_beta_err
+
+            err_l = pallas_kl_beta_err(X_local, H_local, W)
+        else:
+            err_l = ell_beta_err(X_local, H_local, W, beta)
+        err = jax.lax.psum(err_l, axis)
         return H_local, W, err, A, B
     else:
         WH = jnp.maximum(H_local @ W, EPS)
@@ -456,7 +476,8 @@ def _rowsharded_pass(X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
 def _rowsharded_solve_local(X_local, H_local, W, axis, beta, tol, h_tol,
                             n_passes, chunk_max_iter, l1_H, l2_H, l1_W, l2_W,
                             telemetry: bool = False,
-                            kl_newton: bool = False, sketch=None):
+                            kl_newton: bool = False, sketch=None,
+                            use_pallas: bool = False):
     """Per-device block-coordinate solve loop (runs inside ``shard_map``):
     passes of :func:`_rowsharded_pass` until the psum'd objective's relative
     improvement drops below ``tol`` or ``n_passes`` is reached. Shared by the
@@ -477,7 +498,7 @@ def _rowsharded_solve_local(X_local, H_local, W, axis, beta, tol, h_tol,
         H_local, W, err_new, _, _ = _rowsharded_pass(
             X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
             l1_H, l2_H, l1_W, l2_W, kl_newton=kl_newton, sketch=sketch,
-            pass_idx=it)
+            pass_idx=it, use_pallas=use_pallas)
         if telemetry:
             # pass it+1's objective lands at 0-based slot it (slot 0 holds
             # the first pass's err0 from the init below)
@@ -502,7 +523,7 @@ def _rowsharded_solve_local(X_local, H_local, W, axis, beta, tol, h_tol,
     H_local, W, err0, _, _ = _rowsharded_pass(
         X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
         l1_H, l2_H, l1_W, l2_W, kl_newton=kl_newton, sketch=sketch,
-        pass_idx=jnp.int32(0))
+        pass_idx=jnp.int32(0), use_pallas=use_pallas)
     init = (H_local, W, err0 * (1.0 + 2.0 * tol) + 1.0, err0, jnp.int32(1))
     if telemetry:
         init = init + (jnp.full((TRACE_LEN,), jnp.nan,
@@ -520,11 +541,11 @@ def _rowsharded_solve_local(X_local, H_local, W, axis, beta, tol, h_tol,
     jax.jit,
     static_argnames=("mesh", "axis", "beta", "chunk_max_iter",
                      "l1_H", "l2_H", "l1_W", "l2_W", "kl_newton",
-                     "sketch"),
+                     "sketch", "use_pallas"),
 )
 def _rowshard_pass_jit(X, H, W, mesh, axis, beta, h_tol, chunk_max_iter,
                        l1_H, l2_H, l1_W, l2_W, kl_newton: bool = False,
-                       sketch=None, pass_idx=0):
+                       sketch=None, pass_idx=0, use_pallas: bool = False):
     """ONE block-coordinate pass as its own dispatch — the unit of the
     checkpointed host-driven loop (``_fit_rowsharded_checkpointed``). The
     per-device program is exactly the ``_rowsharded_pass`` body the fused
@@ -544,7 +565,7 @@ def _rowshard_pass_jit(X, H, W, mesh, axis, beta, h_tol, chunk_max_iter,
         H_local, W, err, A, B = _rowsharded_pass(
             X_local, H_local, W, axis, beta, h_tol, chunk_max_iter,
             l1_H, l2_H, l1_W, l2_W, kl_newton=kl_newton, sketch=sketch,
-            pass_idx=pass_idx_r)
+            pass_idx=pass_idx_r, use_pallas=use_pallas)
         if with_stats:
             return H_local, W, err[None], A, B
         return H_local, W, err[None]
@@ -561,7 +582,8 @@ def _fit_rowsharded_checkpointed(Xd, H0, W0, mesh, axis, beta, tol, h_tol,
                                  n_passes, chunk_max_iter,
                                  l1_H, l2_H, l1_W, l2_W, ckpt,
                                  heartbeat=None, n_orig=None,
-                                 kl_newton: bool = False, sketch=None):
+                                 kl_newton: bool = False, sketch=None,
+                                 use_pallas: bool = False):
     """Host-driven pass loop with mid-run checkpoints — the checkpointed
     twin of :func:`_fit_rowsharded_jit`'s fused while_loop (same per-pass
     program, same f32 convergence test, same stopping rule; the loop
@@ -605,7 +627,7 @@ def _fit_rowsharded_checkpointed(Xd, H0, W0, mesh, axis, beta, tol, h_tol,
         return _rowshard_pass_jit(
             Xd, H, W, mesh, axis, beta, h_tol_j, int(chunk_max_iter),
             l1_H, l2_H, l1_W, l2_W, kl_newton=kl_newton, sketch=sketch,
-            pass_idx=pass_idx)
+            pass_idx=pass_idx, use_pallas=use_pallas)
 
     trace = np.full((TRACE_LEN,), np.nan, np.float32)
     A = B = None
@@ -822,7 +844,7 @@ def _nmf_fit_rowsharded_ooc_entry(store, k, mesh, axis, beta, *, seed, tol,
                 "iters": np.asarray([passes]),
                 "nonfinite": np.asarray([nonfin]),
                 "errs": np.asarray([err], np.float64),
-                "recipe": recipe.label})
+                "recipe": recipe.label, "kernel": "dense-jnp"})
     return (np.asarray(H)[:n_orig], np.asarray(W), float(err))
 
 
@@ -1125,12 +1147,12 @@ def _delete_group(Xg):
     jax.jit,
     static_argnames=("mesh", "axis", "beta", "n_passes", "chunk_max_iter",
                      "l1_H", "l2_H", "l1_W", "l2_W", "telemetry",
-                     "kl_newton", "sketch"),
+                     "kl_newton", "sketch", "use_pallas"),
 )
 def _fit_rowsharded_jit(X, H0, W0, mesh, axis, beta, tol, h_tol, n_passes,
                         chunk_max_iter, l1_H, l2_H, l1_W, l2_W,
                         telemetry: bool = False, kl_newton: bool = False,
-                        sketch=None):
+                        sketch=None, use_pallas: bool = False):
     out_specs = ((P(axis, None), P(), P()) if not telemetry
                  else (P(axis, None), P(), P(), P(), P(), P()))
 
@@ -1143,7 +1165,7 @@ def _fit_rowsharded_jit(X, H0, W0, mesh, axis, beta, tol, h_tol, n_passes,
         out = _rowsharded_solve_local(
             X_local, H_local, W, axis, beta, tol, h_tol, n_passes,
             chunk_max_iter, l1_H, l2_H, l1_W, l2_W, telemetry=telemetry,
-            kl_newton=kl_newton, sketch=sketch)
+            kl_newton=kl_newton, sketch=sketch, use_pallas=use_pallas)
         if telemetry:
             H_local, W, err, trace, passes, nonfin = out
             return (H_local, W, err[None], trace, passes[None],
@@ -1327,6 +1349,15 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
     # each shard samples its share so a d-device mesh still touches
     # ~sketch_dim rows total (min 1 per shard), instead of d times that
     sketch = _per_shard_sketch(recipe, mesh)
+    # fused Pallas KL kernels (ISSUE 16): ELL β=1 shards only; the sketch
+    # recipe's row-subsampled W statistics need a scatter the transpose
+    # index set cannot serve, so it keeps the jnp chain. Default-off
+    # resolution passes False — static default, so the compiled programs
+    # are byte-identical to a build without the kernel layer.
+    use_pallas = (isinstance(Xd, EllMatrix) and beta == 1.0
+                  and recipe.algo != "sketch" and resolve_pallas())
+    kernel = ("dense-jnp" if not isinstance(Xd, EllMatrix)
+              else ("ell-pallas" if use_pallas else "ell-jnp"))
 
     want_telem = False
     if telemetry_sink is not None:
@@ -1338,7 +1369,7 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
             Xd, H0, W0, mesh, axis, beta, float(tol), float(h_tol),
             int(n_passes), int(chunk_max_iter), l1_H, l2_H, l1_W, l2_W,
             checkpoint, heartbeat=heartbeat, n_orig=n_orig,
-            kl_newton=kl_newton, sketch=sketch)
+            kl_newton=kl_newton, sketch=sketch, use_pallas=use_pallas)
         if want_telem:
             telemetry_sink({
                 "k": int(k), "beta": float(beta), "mode": "rowshard",
@@ -1347,12 +1378,13 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
                 "iters": np.asarray([passes]),
                 "nonfinite": np.asarray([nonfin]),
                 "errs": np.asarray([err], np.float64),
-                "recipe": recipe.label})
+                "recipe": recipe.label, "kernel": kernel})
         return (np.asarray(H)[:n_orig], np.asarray(W), float(err))
     out = _fit_rowsharded_jit(
         Xd, H0, W0, mesh, axis, beta, jnp.float32(tol), jnp.float32(h_tol),
         int(n_passes), int(chunk_max_iter), l1_H, l2_H, l1_W, l2_W,
-        telemetry=want_telem, kl_newton=kl_newton, sketch=sketch)
+        telemetry=want_telem, kl_newton=kl_newton, sketch=sketch,
+        use_pallas=use_pallas)
     H, W, err = out[:3]
     if want_telem:
         trace, passes, nonfin = out[3:]
@@ -1361,7 +1393,7 @@ def nmf_fit_rowsharded(X, k: int, mesh: Mesh, beta_loss="frobenius",
             "seeds": [int(seed)], "cap": int(n_passes), "cadence": "pass",
             "trace": trace[None], "iters": passes[None],
             "nonfinite": nonfin[None], "errs": err[None],
-            "recipe": recipe.label})
+            "recipe": recipe.label, "kernel": kernel})
     return (np.asarray(H)[:n_orig], np.asarray(W), float(err))
 
 

@@ -76,6 +76,14 @@ REGISTRY: dict[str, Knob] = _knobs(
          "engages it for batch β∈{1,0} MU solves and derives amu/dna "
          "from β — the chosen recipe lands in telemetry dispatch events, "
          "provenance, and the checkpoint identity"),
+    Knob("CNMF_TPU_PALLAS", "str", "`0`",
+         "fused Pallas kernels for the ELL β=1 (KL) statistics + "
+         "objective (ISSUE 16): `0` pins the jnp ELL path (programs "
+         "byte-identical to a build without the kernel layer), `1` "
+         "forces the fused kernels (interpret mode off-TPU — parity "
+         "runs, not perf), `auto` engages them only on a TPU backend — "
+         "the engaged kernel label lands in telemetry dispatch events, "
+         "provenance, and the checkpoint identity"),
     Knob("CNMF_TPU_INNER_REPEATS", "int", "auto",
          "accelerated-MU ρ (H sub-iterations per W update, arXiv "
          "1107.5194); unset derives ρ from the H-repeat vs W-update "

@@ -1,0 +1,165 @@
+"""Tier-1 Pallas parity smoke (ISSUE 16, wired in verify_tier1.sh).
+
+Runs a mini ELL β=1 replicate sweep with the fused Pallas kernels off
+(knob unset / ``0``) and forced on (``1`` — interpret mode on the CPU
+gate) and asserts:
+
+  * default-off byte-identity: the knob-unset and ``CNMF_TPU_PALLAS=0``
+    sweeps resolve to the SAME cached ``_sweep_program`` entry (the
+    omit-on-default kwarg convention), and ``nmf_fit_batch`` lowers to
+    byte-identical text with the default vs an explicit
+    ``use_pallas=False`` — a build with the kernel layer dormant is the
+    build without it;
+  * the forced-on lowering DIFFERS from the default (engagement is
+    detectable even in interpret mode, where the lowered text contains
+    no "pallas" strings);
+  * objective parity: the Pallas sweep lands within the accel band of
+    the jnp ELL oracle (the kernels change accumulation order — f32
+    tolerance, not bit equality);
+  * the engaged kernel is visible end-to-end: sweep telemetry payloads
+    carry the ``kernel`` label (``ell-jnp`` / ``ell-pallas``) and the
+    emitted dispatch + replicates events validate against the schema;
+  * unknown knob words fail loudly, naming the knob.
+
+Exit 0 on success; any assertion or schema failure exits nonzero and
+fails the gate.
+"""
+
+import os
+import sys
+import tempfile
+
+# package: sys.path[0] is scripts/, the package lives one level up
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["CNMF_TPU_TELEMETRY"] = "1"
+# the mini fixture is 92% sparse but too skinny for the auto width
+# guard (8*width > g) — force the ELL lane; the smoke is ABOUT it
+os.environ["CNMF_TPU_SPARSE_BETA"] = "1"
+os.environ.pop("CNMF_TPU_PALLAS", None)
+
+import numpy as np  # noqa: E402
+
+
+def fixture(n=120, g=96, k=4, seed=3):
+    rng = np.random.default_rng(seed)
+    usage = rng.dirichlet(np.ones(k) * 0.2, size=n)
+    spectra = rng.gamma(0.25, 1.0, size=(k, g)) * 40.0 / g
+    X = rng.poisson(usage @ spectra * 6.0 * 0.04).astype(np.float32)
+    X[X.sum(axis=1) == 0, 0] = 1.0
+    return X
+
+
+def main() -> int:
+    import jax.numpy as jnp
+
+    from cnmf_torch_tpu.ops.nmf import nmf_fit_batch
+    from cnmf_torch_tpu.ops.pallas import PALLAS_ENV, resolve_pallas
+    from cnmf_torch_tpu.ops.sparse import csr_to_ell, ell_device_put
+    from cnmf_torch_tpu.parallel import replicate_sweep
+    from cnmf_torch_tpu.parallel.replicates import _sweep_program
+    from cnmf_torch_tpu.utils.telemetry import (EventLog, replicate_records,
+                                                validate_events_file)
+
+    import scipy.sparse as sp
+
+    X = fixture()
+    density = float((X > 0).mean())
+    assert density < 0.10, density  # the lane's win case, not a dense run
+    Xcsr = sp.csr_matrix(X)  # the sweep builds ELL from sparse input only
+    seeds = [1, 2, 3]
+    tmp = tempfile.mkdtemp(prefix="pallas_smoke_")
+    log = EventLog(os.path.join(tmp, "smoke.events.jsonl"))
+
+    payloads = {}
+
+    def run(label, knob):
+        if knob is None:
+            os.environ.pop(PALLAS_ENV, None)
+        else:
+            os.environ[PALLAS_ENV] = knob
+        sink_box = []
+        _, _, errs = replicate_sweep(Xcsr, seeds, 4, mode="batch",
+                                     beta_loss="kullback-leibler",
+                                     telemetry_sink=sink_box.append)
+        assert np.isfinite(errs).all(), (label, errs)
+        (pay,) = sink_box
+        log.emit("dispatch", decision="pallas_kernel",
+                 context={"kernel": pay.get("kernel"),
+                          PALLAS_ENV: knob if knob is not None else ""})
+        log.emit("replicates", k=pay["k"], beta=pay["beta"],
+                 mode=pay["mode"], cap=int(pay["cap"]),
+                 cadence=pay["cadence"], kernel=pay.get("kernel"),
+                 records=replicate_records(pay))
+        payloads[label] = (np.asarray(errs, np.float64), pay.get("kernel"))
+        print(f"[pallas-smoke] {label:8s} kernel={pay.get('kernel'):10s} "
+              f"errs={np.round(errs, 2)}")
+
+    _sweep_program.cache_clear()
+    run("unset", None)
+    info_unset = _sweep_program.cache_info()
+    run("off", "0")
+    info_off = _sweep_program.cache_info()
+    run("on", "1")
+
+    # knob unset and knob=0 resolve to the SAME cached program entry
+    # (the omit-on-default kwarg convention): byte-identical dispatch
+    assert info_unset.misses == info_off.misses == 1, (info_unset, info_off)
+    assert info_off.hits > info_unset.hits, (info_unset, info_off)
+    np.testing.assert_array_equal(payloads["unset"][0], payloads["off"][0])
+
+    # the engaged kernel is visible in the sweep telemetry payload
+    assert payloads["unset"][1] == "ell-jnp", payloads["unset"][1]
+    assert payloads["off"][1] == "ell-jnp", payloads["off"][1]
+    assert payloads["on"][1] == "ell-pallas", payloads["on"][1]
+
+    # objective parity: the fused kernels solve the same problem to the
+    # same place (accumulation order differs — accel band, not bits)
+    TOL = 2e-2
+    rel = np.abs(payloads["on"][0] - payloads["unset"][0]) \
+        / payloads["unset"][0]
+    assert (rel < TOL).all(), (payloads["on"][0], payloads["unset"][0])
+    print(f"[pallas-smoke] objective parity max rel {rel.max():.2e} "
+          f"(band {TOL})")
+
+    # lowering identity: default == explicit use_pallas=False,
+    # and forced-on differs (engagement detectable in interpret mode,
+    # where the lowered text contains no 'pallas' strings)
+    Xe = ell_device_put(csr_to_ell(X))
+    rng = np.random.default_rng(0)
+    H0 = jnp.asarray(rng.random((X.shape[0], 4), np.float32) + 0.1)
+    W0 = jnp.asarray(rng.random((4, X.shape[1]), np.float32) + 0.1)
+    low = {
+        kw if kw is not None else "default": nmf_fit_batch.lower(
+            Xe, H0, W0, beta=1.0, max_iter=8,
+            **({} if kw is None else {"use_pallas": kw})).as_text()
+        for kw in (None, False, True)
+    }
+    assert low["default"] == low[False], "use_pallas=False must be the default"
+    assert low["default"] != low[True], "forced-on must change the program"
+    print(f"[pallas-smoke] lowering: default==off "
+          f"({len(low['default'])} chars), on differs "
+          f"({len(low[True])} chars)")
+
+    # unknown knob words fail loudly, naming the knob
+    os.environ[PALLAS_ENV] = "bogus"
+    try:
+        resolve_pallas()
+    except ValueError as e:
+        assert PALLAS_ENV in str(e), e
+    else:
+        raise AssertionError("bad knob word must raise")
+    finally:
+        os.environ.pop(PALLAS_ENV, None)
+
+    # schema-valid stream: manifest + 3x(dispatch + replicates)
+    n_events = validate_events_file(log.path)
+    assert n_events >= 7, n_events
+    print(f"[pallas-smoke] OK: {n_events} schema-valid events, kernels "
+          f"{sorted({v[1] for v in payloads.values()})}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

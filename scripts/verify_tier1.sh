@@ -142,6 +142,24 @@ if [ "$rc" -eq 0 ]; then
   fi
 fi
 
+# pallas parity smoke: mini ELL beta=1 sweeps with the fused kernels
+# off/on (interpret mode on this CPU gate) — knob-unset and knob=0 must
+# share one cached program (byte-identical lowering, default == explicit
+# off), forced-on must change the lowering and land within the accel
+# objective band of the jnp ELL oracle, the engaged kernel label must
+# ride schema-valid dispatch + replicates events, and bad knob words
+# must fail loudly (scripts/pallas_smoke.py)
+if [ "$rc" -eq 0 ]; then
+  echo "[tier1] pallas parity smoke (fused ELL KL kernels: off-identity + interpret parity) ..."
+  if timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      python scripts/pallas_smoke.py; then
+    echo PALLAS_SMOKE=ok
+  else
+    echo PALLAS_SMOKE=fail
+    exit 1
+  fi
+fi
+
 # serve smoke: consensus-complete mini run served by the REAL daemon
 # (CLI subprocess on a unix socket) under concurrent clients + one
 # poison tenant — asserts cross-request batching engaged (telemetry

@@ -1,0 +1,316 @@
+"""Fused Pallas kernels for the ELL β=1 inner loop (ISSUE 16).
+
+Parity bars: every fused statistic matches its jnp ELL oracle at f32
+tolerance (the kernels change accumulation order only), the bf16 ratio
+variants stay within the bf16 band, and the default-off knob compiles
+byte-identical programs to a build without the kernel layer. On this
+CPU suite every ``pallas_call`` runs in interpret mode — the same
+dispatch surface a TPU run takes, minus the Mosaic lowering."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from cnmf_torch_tpu.ops import pallas_kl as pk
+from cnmf_torch_tpu.ops.nmf import (_update_H, _update_W, nmf_fit_batch,
+                                    nmf_fit_online)
+from cnmf_torch_tpu.ops.pallas import (PALLAS_ENV, kernel_label,
+                                       pallas_available, pallas_interpret,
+                                       resolve_pallas)
+from cnmf_torch_tpu.ops.recipe import SolverRecipe
+from cnmf_torch_tpu.ops.sparse import (csr_to_ell, ell_beta_err,
+                                       ell_chunk_rows, ell_device_put,
+                                       ell_kl_h_newton_stats,
+                                       ell_kl_h_stats, ell_kl_w_numer,
+                                       ell_kl_w_stats, ell_wh_at_nz)
+
+
+def _fixture(n, g, k, density=0.08, seed=0, zero_rows=0):
+    """Sparse counts + positive factors. ``zero_rows`` leading rows are
+    all-zero (ELL pads them entirely: stored value 0.0, column 0)."""
+    rng = np.random.default_rng(seed)
+    X = sp.random(n, g, density=density, format="csr",
+                  random_state=int(rng.integers(1 << 31)),
+                  data_rvs=lambda s: (rng.gamma(2.0, 1.0, s)
+                                      + 0.1).astype(np.float32))
+    if zero_rows:
+        X = X.tolil()
+        X[:zero_rows, :] = 0.0
+        X = X.tocsr()
+        X.eliminate_zeros()
+    ell = ell_device_put(csr_to_ell(X))
+    H = jnp.asarray(rng.random((n, k), np.float32) + 0.1)
+    W = jnp.asarray(rng.random((k, g), np.float32) + 0.1)
+    return X, ell, H, W
+
+
+# shapes straddle the 128 block: ragged last row slab AND ragged last
+# gene tile, plus an exact-multiple case
+SHAPES = [(130, 100, 5), (256, 128, 4), (97, 61, 3)]
+
+
+# ---------------------------------------------------------------------------
+# kernel-vs-oracle parity (f32 tolerance: same math, different order)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n,g,k", SHAPES)
+def test_wh_at_nz_parity(n, g, k):
+    _, ell, H, W = _fixture(n, g, k)
+    got = pk.pallas_wh_at_nz(ell, H, W)
+    want = ell_wh_at_nz(ell, H, W)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("n,g,k", SHAPES)
+def test_kl_h_stats_parity(n, g, k):
+    _, ell, H, W = _fixture(n, g, k)
+    gn, gd = pk.pallas_kl_h_stats(ell, H, W)
+    wn, wd = ell_kl_h_stats(ell, H, W)
+    np.testing.assert_allclose(gn, wn, rtol=2e-5, atol=1e-6)
+    # the data-independent denominator stays jnp: bitwise the oracle's
+    np.testing.assert_array_equal(gd, wd)
+
+
+@pytest.mark.parametrize("n,g,k", SHAPES)
+def test_kl_h_newton_stats_parity(n, g, k):
+    _, ell, H, W = _fixture(n, g, k)
+    gn, gd, gh = pk.pallas_kl_h_newton_stats(ell, H, W)
+    wn, wd, wh = ell_kl_h_newton_stats(ell, H, W)
+    np.testing.assert_allclose(gn, wn, rtol=2e-5, atol=1e-6)
+    np.testing.assert_array_equal(gd, wd)
+    np.testing.assert_allclose(gh, wh, rtol=2e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("n,g,k", SHAPES)
+def test_kl_w_numer_parity(n, g, k):
+    _, ell, H, W = _fixture(n, g, k)
+    got = pk.pallas_kl_w_numer(ell, H, W)
+    want = ell_kl_w_numer(ell, H, W)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("n,g,k", SHAPES)
+def test_kl_w_stats_parity(n, g, k):
+    _, ell, H, W = _fixture(n, g, k)
+    gn, gd = pk.pallas_kl_w_stats(ell, H, W)
+    wn, wd = ell_kl_w_stats(ell, H, W)
+    np.testing.assert_allclose(gn, wn, rtol=2e-5, atol=1e-6)
+    np.testing.assert_array_equal(gd, wd)
+
+
+@pytest.mark.parametrize("n,g,k", SHAPES)
+def test_kl_beta_err_parity(n, g, k):
+    X, ell, H, W = _fixture(n, g, k)
+    got = float(pk.pallas_kl_beta_err(ell, H, W))
+    want = float(ell_beta_err(ell, H, W, 1.0))
+    assert got == pytest.approx(want, rel=2e-5)
+
+
+def test_all_zero_rows_and_exact_zero_absorption():
+    """Fully padded rows (and the padded slots of every ragged row) must
+    contribute exact +0.0 to every statistic — no NaN from 0*log(0)."""
+    _, ell, H, W = _fixture(96, 64, 4, zero_rows=11, seed=2)
+    numer, _ = pk.pallas_kl_h_stats(ell, H, W)
+    wn = pk.pallas_kl_w_numer(ell, H, W)
+    obj = float(pk.pallas_kl_beta_err(ell, H, W))
+    assert np.isfinite(numer).all() and np.isfinite(wn).all()
+    assert np.isfinite(obj)
+    # a zero row has no nonzero support: its MU numerator is exactly 0
+    np.testing.assert_array_equal(np.asarray(numer)[:11], 0.0)
+    np.testing.assert_allclose(numer, ell_kl_h_stats(ell, H, W)[0],
+                               rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(wn, ell_kl_w_numer(ell, H, W),
+                               rtol=2e-5, atol=1e-6)
+
+
+def test_bf16_ratio_band():
+    """bf16 ratio variants: match the bf16 oracle within the bf16 band
+    and the f32 oracle within the documented few-percent envelope."""
+    _, ell, H, W = _fixture(130, 100, 5, seed=4)
+    gn, _ = pk.pallas_kl_h_stats(ell, H, W, bf16_ratio=True)
+    wn_bf16, _ = ell_kl_h_stats(ell, H, W, bf16_ratio=True)
+    wn_f32, _ = ell_kl_h_stats(ell, H, W)
+    np.testing.assert_allclose(gn, wn_bf16, rtol=2e-2)
+    np.testing.assert_allclose(gn, wn_f32, rtol=5e-2)
+    gw = pk.pallas_kl_w_numer(ell, H, W, bf16_ratio=True)
+    ww = ell_kl_w_numer(ell, H, W, bf16_ratio=True)
+    np.testing.assert_allclose(gw, ww, rtol=2e-2)
+
+
+def test_update_steps_parity():
+    """One full MU H/W step through ops.nmf dispatch: use_pallas=True
+    matches the jnp ELL path at f32 tolerance."""
+    _, ell, H, W = _fixture(130, 100, 5, seed=6)
+    h_j = _update_H(ell, H, W, 1.0, 0.0, 0.0)
+    h_p = _update_H(ell, H, W, 1.0, 0.0, 0.0, use_pallas=True)
+    np.testing.assert_allclose(h_p, h_j, rtol=2e-5, atol=1e-6)
+    w_j = _update_W(ell, H, W, 1.0, 0.0, 0.0)
+    w_p = _update_W(ell, H, W, 1.0, 0.0, 0.0, use_pallas=True)
+    np.testing.assert_allclose(w_p, w_j, rtol=2e-5, atol=1e-6)
+
+
+def test_fit_batch_objective_parity():
+    _, ell, H, W = _fixture(130, 100, 4, seed=8)
+    _, _, err_j = nmf_fit_batch(ell, H, W, beta=1.0, max_iter=25)
+    _, _, err_p = nmf_fit_batch(ell, H, W, beta=1.0, max_iter=25,
+                                use_pallas=True)
+    assert float(err_p) == pytest.approx(float(err_j), rel=1e-4)
+
+
+def test_fit_online_objective_parity():
+    X, _, H, W = _fixture(128, 64, 4, seed=9)
+    chunked, pad = ell_chunk_rows(X, 64)
+    Hc = H.reshape(2, 64, 4)
+    _, _, err_j = nmf_fit_online(chunked, Hc, W, beta=1.0, n_passes=3)
+    _, _, err_p = nmf_fit_online(chunked, Hc, W, beta=1.0, n_passes=3,
+                                 use_pallas=True)
+    assert float(err_p) == pytest.approx(float(err_j), rel=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# knob resolution
+# ---------------------------------------------------------------------------
+
+class TestKnob:
+    def test_words(self, monkeypatch):
+        for w in ("", "0", "off", "false", "no"):
+            monkeypatch.setenv(PALLAS_ENV, w)
+            assert resolve_pallas() is False
+        for w in ("1", "on", "true", "yes", "force"):
+            monkeypatch.setenv(PALLAS_ENV, w)
+            assert resolve_pallas() is True
+        monkeypatch.delenv(PALLAS_ENV, raising=False)
+        assert resolve_pallas() is False  # default off
+
+    def test_auto_is_off_off_tpu(self, monkeypatch):
+        monkeypatch.setenv(PALLAS_ENV, "auto")
+        assert pallas_interpret()  # the suite runs on CPU
+        assert resolve_pallas() is False
+
+    def test_bad_word_names_the_knob(self, monkeypatch):
+        monkeypatch.setenv(PALLAS_ENV, "bogus")
+        with pytest.raises(ValueError, match=PALLAS_ENV):
+            resolve_pallas()
+
+    def test_override_wins(self, monkeypatch):
+        monkeypatch.setenv(PALLAS_ENV, "0")
+        assert resolve_pallas(override=True) is True
+        monkeypatch.delenv(PALLAS_ENV, raising=False)
+        assert resolve_pallas(override=False) is False
+        assert pallas_available()
+
+    def test_kernel_label_spelling(self):
+        assert kernel_label(True, True) == "ell-pallas"
+        assert kernel_label(True, False) == "ell-jnp"
+        assert kernel_label(False, False, True) == "vmapped-bf16"
+        assert kernel_label(False) == "vmapped"
+
+
+# ---------------------------------------------------------------------------
+# default-off byte identity
+# ---------------------------------------------------------------------------
+
+def test_default_off_lowering_identity():
+    """knob=0 IS the pre-Pallas build: the default lowering equals an
+    explicit use_pallas=False, and forced-on differs (engagement stays
+    detectable in interpret mode, where no 'pallas' string survives in
+    the lowered text)."""
+    _, ell, H, W = _fixture(96, 64, 3, seed=1)
+    default = nmf_fit_batch.lower(ell, H, W, beta=1.0,
+                                  max_iter=8).as_text()
+    off = nmf_fit_batch.lower(ell, H, W, beta=1.0, max_iter=8,
+                              use_pallas=False).as_text()
+    on = nmf_fit_batch.lower(ell, H, W, beta=1.0, max_iter=8,
+                             use_pallas=True).as_text()
+    assert default == off
+    assert default != on
+
+
+# ---------------------------------------------------------------------------
+# dispatch through the sharded solvers
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def mesh():
+    return Mesh(np.asarray(jax.devices()[:2]), ("cells",))
+
+
+def _lowrank_csr(n, g, k, seed):
+    rng = np.random.default_rng(seed)
+    usage = rng.dirichlet(np.ones(k) * 0.3, size=n)
+    spectra = rng.gamma(0.3, 1.0, size=(k, g)) * 40.0 / g
+    X = rng.poisson(usage @ spectra * 0.25).astype(np.float32)
+    X[X.sum(axis=1) == 0, 0] = 1.0
+    return sp.csr_matrix(X)
+
+
+def test_rowshard_dispatch_parity(mesh, monkeypatch):
+    """knob 0 vs 1 through the row-sharded solver: matched objectives
+    and the engaged kernel label in the telemetry payload."""
+    from cnmf_torch_tpu.parallel.rowshard import nmf_fit_rowsharded
+
+    monkeypatch.setenv("CNMF_TPU_SPARSE_BETA", "1")
+    monkeypatch.setenv("CNMF_TPU_TELEMETRY", "1")
+    X = _lowrank_csr(96, 48, 3, seed=5)
+    runs = {}
+    for knob in ("0", "1"):
+        monkeypatch.setenv(PALLAS_ENV, knob)
+        sink = []
+        _, W, err = nmf_fit_rowsharded(
+            X, 3, mesh, beta_loss="kullback-leibler", seed=11,
+            n_passes=6, telemetry_sink=sink.append)
+        (pay,) = sink
+        runs[knob] = (W, float(err), pay["kernel"])
+    assert runs["0"][2] == "ell-jnp"
+    assert runs["1"][2] == "ell-pallas"
+    assert runs["1"][1] == pytest.approx(runs["0"][1], rel=1e-4)
+    np.testing.assert_allclose(runs["1"][0], runs["0"][0],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_grid2d_dense_lane_label(monkeypatch):
+    """The 2-D grid runs dense pass programs regardless of the knob —
+    its telemetry carries the literal dense-jnp label, and the knob is
+    still consulted (validated) uniformly."""
+    from cnmf_torch_tpu.parallel.grid2d import nmf_fit_grid2d
+
+    grid = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2),
+                ("cells", "genes"))
+    X = _lowrank_csr(64, 32, 3, seed=7).toarray()
+    monkeypatch.setenv("CNMF_TPU_TELEMETRY", "1")
+    monkeypatch.setenv(PALLAS_ENV, "1")
+    sink = []
+    _, _, err = nmf_fit_grid2d(X, 3, grid,
+                               beta_loss="kullback-leibler", seed=3,
+                               n_passes=4, telemetry_sink=sink.append)
+    assert np.isfinite(err)
+    (pay,) = sink
+    assert pay["kernel"] == "dense-jnp"
+    monkeypatch.setenv(PALLAS_ENV, "bogus")
+    with pytest.raises(ValueError, match=PALLAS_ENV):
+        nmf_fit_grid2d(X, 3, grid, beta_loss="kullback-leibler",
+                       seed=3, n_passes=2)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint identity across a kernel flip
+# ---------------------------------------------------------------------------
+
+def test_signature_kernel_flip_changes_identity():
+    """A CNMF_TPU_PALLAS flip (either direction) must restart, not
+    splice two accumulation orders' trajectories — the kernel label
+    joins the signature ONLY when the kernels engage, so default-path
+    checkpoints keep their pre-Pallas identity."""
+    base = SolverRecipe().signature()
+    assert "kernel=" not in base  # pre-Pallas identity preserved
+    engaged = SolverRecipe().signature(kernel="ell-pallas")
+    assert engaged != base and engaged.endswith(",kernel=ell-pallas")
+    # the flip is visible in BOTH directions and per-label
+    assert SolverRecipe().signature(kernel="ell-jnp") != engaged
+    # sketch fields and kernel compose
+    sk = SolverRecipe("sketch", 1, False, "env", sketch_dim=64)
+    assert sk.signature(kernel="ell-pallas").count("kernel=") == 1
