@@ -59,6 +59,7 @@ RULE_FAMILIES = {
     "knob-raw-env": "knobs",
     "knob-unregistered": "knobs",
     "knob-doc-drift": "knobs",
+    "knob-plan-bypass": "knobs",
     "artifact-nonatomic": "artifact",
     "telemetry-schema": "telemetry",
     "lock-discipline": "concurrency",

@@ -18,6 +18,14 @@ stale.
     both directions, including stale default cells. The canonical table
     is generated (``cnmf-tpu lint --knob-table``), so the fix is a
     regenerate, never a hand-edit.
+  * ``knob-plan-bypass`` (ISSUE 17) — a DISPATCH-class knob (the ones
+    that pick WHICH program runs: encoding/recipe/kernel/layout/
+    streaming/ingest/store/serve — ``runtime/planner.py:DISPATCH_KNOBS``)
+    read through the typed accessors outside the planner-owned files and
+    outside the registered resolver functions (``PLAN_ACCESSORS``). One
+    resolution site per knob is what makes the logged plan THE dispatch
+    rather than a parallel reimplementation that can drift; a new lane
+    must register its resolver in the planner, not scatter a knob read.
 """
 
 from __future__ import annotations
@@ -44,11 +52,57 @@ def _literal_knob(node: ast.AST) -> str | None:
     return None
 
 
+def _module_str_constants(tree: ast.Module) -> dict[str, str]:
+    """Module-level ``NAME = "literal"`` assignments — the ``*_ENV``
+    constant idiom every knob-owning module uses. Lets the plan-bypass
+    rule resolve ``env_str(PALLAS_ENV, ...)``-style reads, not just
+    string literals."""
+    out: dict[str, str] = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) \
+                and isinstance(stmt.value, ast.Constant) \
+                and isinstance(stmt.value.value, str):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = stmt.value.value
+    return out
+
+
+def _knob_arg(node: ast.Call, consts: dict[str, str]) -> str | None:
+    """The knob NAME an accessor call reads: a string literal, or a
+    module-level ``*_ENV`` constant. Unresolvable expressions return
+    None (never a false positive)."""
+    if not node.args:
+        return None
+    lit = _literal_knob(node.args[0])
+    if lit is not None:
+        return lit
+    arg = node.args[0]
+    if isinstance(arg, ast.Name):
+        val = consts.get(arg.id)
+        if val is not None and val.startswith(KNOB_PREFIXES):
+            return val
+    return None
+
+
 def check(ctx: FileContext):
     findings: list[Finding] = []
     if ctx.relpath.replace("\\", "/").endswith(ENV_OWNER):
         return findings
+    from ..runtime.planner import (DISPATCH_KNOBS, PLAN_ACCESSORS,
+                                   PLAN_OWNER_FILES)
     from ..utils.envknobs import REGISTRY
+
+    relpath = ctx.relpath.replace("\\", "/")
+    plan_owner = any(relpath.endswith(sfx) for sfx in PLAN_OWNER_FILES)
+    consts = _module_str_constants(ctx.tree)
+
+    def _in_plan_accessor(node: ast.AST) -> bool:
+        """Whether the call sits (possibly nested) inside one of the
+        registered resolver functions."""
+        return any(isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+                   and a.name in PLAN_ACCESSORS
+                   for a in ctx.ancestors(node))
 
     hint = ("read it through utils/envknobs.py (env_int/env_float/"
             "env_str/env_flag), registering the knob there")
@@ -73,6 +127,18 @@ def check(ctx: FileContext):
                         f"env knob `{knob}` is not declared in the "
                         "utils/envknobs.py registry",
                         "add a Knob(name, kind, default, doc) entry"))
+                    continue
+                plan_knob = _knob_arg(node, consts)
+                if plan_knob in DISPATCH_KNOBS and not plan_owner \
+                        and not _in_plan_accessor(node):
+                    findings.append(ctx.finding(
+                        node, "knob-plan-bypass",
+                        f"dispatch-class knob `{plan_knob}` read outside "
+                        "the execution planner and its registered "
+                        "resolvers (runtime/planner.py:PLAN_ACCESSORS)",
+                        "resolve it inside the owning resolver function "
+                        "(or register a new resolver in PLAN_ACCESSORS) "
+                        "so the logged plan stays THE dispatch"))
                 continue
         elif isinstance(node, ast.Compare) \
                 and any(isinstance(op, (ast.In, ast.NotIn))

@@ -39,12 +39,13 @@ def build_parser() -> argparse.ArgumentParser:
         "command", type=str,
         choices=["prepare", "factorize", "combine", "consensus",
                  "k_selection_plot", "run_parallel", "report", "lint",
-                 "serve"])
+                 "serve", "plan"])
     parser.add_argument(
         "run_dir", type=str, nargs="?", default=None,
-        help="[report|serve] Run directory ([output-dir]/[name]) whose "
-             "telemetry to render / whose consensus reference to serve; "
-             "defaults to --output-dir/--name")
+        help="[report|serve|plan] Run directory ([output-dir]/[name]) "
+             "whose telemetry to render / whose consensus reference to "
+             "serve / whose resolved execution plan to show; defaults to "
+             "--output-dir/--name")
     parser.add_argument("--name", type=str, nargs="?", default="cNMF",
                         help="[all] Name for analysis. All output will be "
                              "placed in [output-dir]/[name]/...")
@@ -151,6 +152,17 @@ def build_parser() -> argparse.ArgumentParser:
                              "by default quick multi-K scans (>=4 Ks, <=32 "
                              "replicates per K) run as one packed K_max "
                              "program with bit-identical spectra")
+    parser.add_argument("--plan", type=str, default=None,
+                        help="[factorize] Replay a dumped execution plan "
+                             "(JSON from a run's `plan` telemetry event or "
+                             "`cnmf-tpu plan <run_dir> --out`): pins the "
+                             "whole dispatch surface — encoding, solver "
+                             "recipe, kernel, streaming, serve buckets — so "
+                             "the run's dispatch reproduces bit-identically "
+                             "(sets CNMF_TPU_PLAN for this run)")
+    parser.add_argument("--out", type=str, default=None,
+                        help="[plan] Also dump the plan JSON to this file "
+                             "(replayable via factorize --plan)")
     parser.add_argument("--store-uri", type=str, default=None,
                         help="[all] Shard-store transport (sets "
                              "CNMF_TPU_STORE_URI for this run and every "
@@ -238,13 +250,49 @@ def main(argv=None):
                      "[paths ...] [--format text|json] [--baseline FILE] "
                      "[--write-baseline] [--knob-table]")
 
-    if args.command not in ("report", "serve") and args.run_dir is not None:
-        # the optional positional exists for `report`/`serve` only; for
-        # every other subcommand a stray positional (e.g. `consensus 9`
-        # meaning `-k 9`) must fail fast, not be silently swallowed
+    if args.command not in ("report", "serve", "plan") \
+            and args.run_dir is not None:
+        # the optional positional exists for `report`/`serve`/`plan`
+        # only; for every other subcommand a stray positional (e.g.
+        # `consensus 9` meaning `-k 9`) must fail fast, not be silently
+        # swallowed
         parser.error(f"unrecognized argument: {args.run_dir!r} "
-                     f"(a positional run directory applies to 'report' "
-                     f"and 'serve' only)")
+                     f"(a positional run directory applies to 'report', "
+                     f"'serve', and 'plan' only)")
+
+    if args.command == "plan":
+        # like `report`: pure host-side rendering of the run's recorded
+        # `plan` telemetry event (runtime/planner.py is stdlib-only at
+        # import), so it works on machines without the run's accelerator
+        from .runtime.planner import (ExecutionPlan, plan_from_run_dir,
+                                      render_plan)
+
+        run_dir = args.run_dir or os.path.join(args.output_dir, args.name)
+        if not os.path.isdir(run_dir):
+            parser.error(f"plan: run directory not found: {run_dir}")
+        plan_dict = plan_from_run_dir(run_dir)
+        if plan_dict is None:
+            parser.error(
+                f"plan: no `plan` event recorded under {run_dir} — run "
+                "factorize with CNMF_TPU_TELEMETRY=1 (only the batched "
+                "resident path records a plan)")
+        print(f"Execution plan — {run_dir}")
+        for line in render_plan(plan_dict):
+            print(line)
+        if args.out:
+            ExecutionPlan.from_dict(plan_dict).save(args.out)
+            print(f"plan JSON written to {args.out} "
+                  f"(replay with: cnmf-tpu factorize --plan {args.out})")
+        return
+
+    if args.command == "factorize" and args.plan:
+        # sugar for the knob: factorize applies CNMF_TPU_PLAN before any
+        # dispatch resolves; validate the file now for a fast usage error
+        from .runtime.planner import PLAN_ENV
+
+        if not os.path.isfile(args.plan):
+            parser.error(f"factorize: plan file not found: {args.plan}")
+        os.environ[PLAN_ENV] = args.plan
 
     if args.command == "report":
         # pure host-side rendering of a run's telemetry (events JSONL from

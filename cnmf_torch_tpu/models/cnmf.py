@@ -690,6 +690,16 @@ class cNMF:
         """
         from ..runtime import faults, resilience
 
+        # declarative plan replay (ISSUE 17, runtime/planner.py):
+        # CNMF_TPU_PLAN=<file> (the CLI's --plan) pins the WHOLE dispatch
+        # surface to a previously dumped plan BEFORE any knob below
+        # resolves — every scattered consumer then reproduces that run's
+        # dispatch bit-identically. A missing or invalid plan file raises
+        # here rather than silently running a different dispatch.
+        from ..runtime.planner import maybe_apply_plan_env
+
+        maybe_apply_plan_env()
+
         # named layout dispatch (ISSUE 13): validated up front, before
         # any ledger/matrix IO — a bad or conflicting layout request
         # must fail in milliseconds, not after loading artifacts
@@ -1041,45 +1051,104 @@ class cNMF:
         # CNMF_TPU_SPARSE_BETA=0 forces dense, =1 forces ELL. The dense
         # path remains the default everywhere else.
         beta_val = beta_loss_to_float(_nmf_kwargs["beta_loss"])
-        # measured-rho startup microbench (ISSUE 11 satellite): when the
-        # accel knobs could engage an amu schedule FOR THIS BETA, make
-        # sure this device's measured cost-ratio cache exists before any
-        # recipe resolves — auto_inner_repeats then reads the measured
-        # scale instead of the CPU-measured static clamp. Cached per
-        # device fingerprint (~1 s once); a no-op whenever accel is off,
-        # rho is pinned, the engaged recipe cannot be amu (sketch/dna),
-        # the pod is multi-host, or the cache already exists.
-        # Best-effort by construction (falls back to the static ratio).
-        from ..utils.autotune import maybe_autotune_rho
+        # measured microbenches: the rho cost-ratio cache (ISSUE 11 —
+        # no-op unless the accel knobs explicitly engage an amu schedule
+        # for this beta) and the plan-point tuner (ISSUE 17 — measures
+        # only under CNMF_TPU_AUTOTUNE=1/force; the auto default consumes
+        # an existing cache without ever paying the bench on a stock run,
+        # so cold-machine dispatch stays deterministic). Both best-effort
+        # by construction: any failure keeps the static heuristics.
+        from ..utils.autotune import maybe_autotune_plan, maybe_autotune_rho
 
         maybe_autotune_rho(beta=beta_val)
-        from ..ops.nmf import resolve_bf16_ratio as _rb
-        from ..ops.pallas import kernel_label, resolve_pallas
+        maybe_autotune_plan()
 
-        use_ell = False
-        if (sp.issparse(norm_counts.X) and beta_val in (1.0, 0.0)
-                and _nmf_kwargs.get("init", "random") == "random"
-                and _nmf_kwargs.get("algo", "mu") == "mu"):
-            from ..ops.sparse import ell_row_width, resolve_sparse_beta
+        if skip_completed_runs and jobs:
+            # sweep-granular resume: a K with ANY incomplete replicate
+            # reruns this worker's whole K group. The vmapped while_loop
+            # steps every lane until the batch's slowest lane converges,
+            # so a lane's result depends on batch composition — rerunning
+            # only the missing lanes would be valid but not bit-identical
+            # to the uninterrupted run. Whole-group reruns make
+            # interrupted+resumed sweeps byte-for-byte reproducible
+            # (kill-resume parity, tests/test_resilience.py) and cost
+            # almost nothing: the batch runs to its slowest lane either
+            # way, and the overwrites are atomic.
+            ks_incomplete = {int(run_params.iloc[i]["n_components"])
+                             for i in jobs}
+            # quarantined lanes stay excluded even when their K is
+            # rerun for other reasons: re-solving a deterministically
+            # divergent lane would burn the whole retry ladder again on
+            # every resume. (In this compound case — quarantine + torn
+            # lane in one K — the rerun batch omits the quarantined
+            # lane, so bit-parity with an uninterrupted run is waived
+            # for that K; validity and determinism of the rerun hold.)
+            expanded = [i for i in my_tasks
+                        if int(run_params.iloc[i]["n_components"])
+                        in ks_incomplete and i not in quarantined_idx]
+            if len(expanded) > len(jobs):
+                print("[Worker %d]. Resume reruns %d replicate(s) (whole-K "
+                      "groups for K=%s) so resumed sweeps are bit-identical "
+                      "to uninterrupted ones."
+                      % (worker_i, len(expanded),
+                         ",".join(str(k) for k in sorted(ks_incomplete))))
+            jobs = expanded
+        _credit_completed(jobs)
+
+        by_k: dict[int, list] = {}
+        for idx in jobs:
+            p = run_params.iloc[idx, :]
+            by_k.setdefault(int(p["n_components"]), []).append(
+                (int(p["iter"]), int(p["nmf_seed"])))
+
+        # the resolved EXECUTION PLAN (ISSUE 17, runtime/planner.py):
+        # every dispatch decision for this factorize — encoding, solver
+        # recipe, kernel, program shape, layout, streaming, ingest tier,
+        # store backend — resolved in ONE call (delegating to the same
+        # registered resolvers the lint gate pins), logged whole as one
+        # `plan` telemetry event, and consumed below instead of
+        # re-resolving per site. Precedence per field: explicit knob /
+        # caller argument > autotuned microbench point > static heuristic.
+        from ..runtime.planner import InputStats, build_plan
+
+        _sparse_in = sp.issparse(norm_counts.X)
+        density = ell_w = None
+        if _sparse_in:
+            from ..ops.sparse import ell_row_width
 
             n_c, g_c = norm_counts.X.shape
             ell_w = ell_row_width(norm_counts.X)
             density = norm_counts.X.nnz / max(n_c * g_c, 1)
-            use_ell = resolve_sparse_beta(beta_val, density=density,
-                                          width=ell_w, g=g_c)
-            # engaged inner-loop kernel (ISSUE 16): which statistics
-            # implementation the sweeps will run — the fused Pallas
-            # kernels only on the ELL β=1 lane with the knob engaged
-            _kern = kernel_label(
-                bool(use_ell),
-                bool(use_ell and beta_val == 1.0 and resolve_pallas()),
-                _rb(beta_val, _nmf_kwargs.get("mode", "online")))
+        plan = build_plan(
+            InputStats(
+                n=int(norm_counts.X.shape[0]),
+                g=int(norm_counts.X.shape[1]), beta=beta_val,
+                mode=_nmf_kwargs.get("mode", "online"),
+                init=_nmf_kwargs.get("init", "random"),
+                algo=_nmf_kwargs.get("algo", "mu"),
+                sparse=_sparse_in, density=density, ell_width=ell_w,
+                k_max=max(by_k) if by_k else None, n_ks=len(by_k),
+                max_replicates=max((len(t) for t in by_k.values()),
+                                   default=0),
+                total_workers=max(1, int(total_workers)),
+                has_store=store is not None),
+            overrides={"packed": packed, "layout": "1d",
+                       "mesh_devices": (1 if mesh is None
+                                        else int(np.prod(
+                                            mesh.devices.shape))),
+                       "ooc_engaged": store is not None})
+        use_ell = plan.use_ell
+        self._events.emit("plan", plan=plan.to_dict(),
+                          signature=plan.signature())
+        if _sparse_in and beta_val in (1.0, 0.0):
+            # knob-level encoding record (pre-dates the plan event; kept
+            # for report/test continuity — the plan event is authoritative)
             self._events.emit(
                 "dispatch", decision="ell_vs_dense",
                 context={"use_ell": bool(use_ell), "beta": float(beta_val),
                          "density": round(float(density), 4),
                          "ell_width": int(ell_w), "genes": int(g_c),
-                         "kernel": _kern})
+                         "kernel": plan.kernel})
 
         if use_ell and packed:
             # fail BEFORE the CSR->ELL conversion and host->HBM staging
@@ -1154,67 +1223,16 @@ class cNMF:
                          "threads": stream_threads(),
                          "depth": stream_depth()})
 
-        if skip_completed_runs and jobs:
-            # sweep-granular resume: a K with ANY incomplete replicate
-            # reruns this worker's whole K group. The vmapped while_loop
-            # steps every lane until the batch's slowest lane converges,
-            # so a lane's result depends on batch composition — rerunning
-            # only the missing lanes would be valid but not bit-identical
-            # to the uninterrupted run. Whole-group reruns make
-            # interrupted+resumed sweeps byte-for-byte reproducible
-            # (kill-resume parity, tests/test_resilience.py) and cost
-            # almost nothing: the batch runs to its slowest lane either
-            # way, and the overwrites are atomic.
-            ks_incomplete = {int(run_params.iloc[i]["n_components"])
-                             for i in jobs}
-            # quarantined lanes stay excluded even when their K is
-            # rerun for other reasons: re-solving a deterministically
-            # divergent lane would burn the whole retry ladder again on
-            # every resume. (In this compound case — quarantine + torn
-            # lane in one K — the rerun batch omits the quarantined
-            # lane, so bit-parity with an uninterrupted run is waived
-            # for that K; validity and determinism of the rerun hold.)
-            expanded = [i for i in my_tasks
-                        if int(run_params.iloc[i]["n_components"])
-                        in ks_incomplete and i not in quarantined_idx]
-            if len(expanded) > len(jobs):
-                print("[Worker %d]. Resume reruns %d replicate(s) (whole-K "
-                      "groups for K=%s) so resumed sweeps are bit-identical "
-                      "to uninterrupted ones."
-                      % (worker_i, len(expanded),
-                         ",".join(str(k) for k in sorted(ks_incomplete))))
-            jobs = expanded
-        _credit_completed(jobs)
-
-        by_k: dict[int, list] = {}
-        for idx in jobs:
-            p = run_params.iloc[idx, :]
-            by_k.setdefault(int(p["n_components"]), []).append(
-                (int(p["iter"]), int(p["nmf_seed"])))
-
-        if packed is None:
-            # auto: packed wins only in the compile-dominated regime (many
-            # Ks x few replicates — quick interactive scans). Measured on
-            # the K=5..13 x 100 production sweep (TPU v5e): packed warm is
-            # ~13% SLOWER (K_max padding isn't free once replicates
-            # amortize X reads) and the per-K programs' concurrent AOT
-            # warming already collapses their compile wall — so production
-            # sweeps keep per-K programs.
-            # the regime test uses LEDGER-wide replicate counts (per-worker
-            # shards of a 100-replicate production sweep must not flip into
-            # the slower packed path just because each worker sees few)
-            # ELL-encoded sweeps always take the per-K path (the packed
-            # program's K_max-padded init is defined on the dense matrix)
-            packed = (not use_ell
-                      and _nmf_kwargs.get("algo", "mu") == "mu"
-                      and _nmf_kwargs["init"] == "random" and len(by_k) >= 4
-                      and max((len(t) for t in by_k.values()), default=0)
-                      * max(1, int(total_workers)) <= 32)
-        elif packed and _nmf_kwargs["init"] != "random":
+        # packed-vs-per-K program shape: resolved by the PLAN above (the
+        # auto regime heuristic — many Ks x few replicates — now lives in
+        # planner._auto_packed; an explicit `packed` argument rode in as
+        # a pin override). Only the argument-validation raise stays here.
+        if packed and _nmf_kwargs["init"] != "random":
             raise ValueError(
                 "packed K-sweeps require init='random' (the nndsvd family's "
                 "SVD base is K-truncated); rerun with packed=False / "
                 "--per-k-programs")
+        packed = plan.packed
 
         # the resolved per-loss online schedule (ops/nmf.py:
         # resolve_online_schedule) is an execution detail the ledger YAML
@@ -1223,33 +1241,17 @@ class cNMF:
             beta_loss_to_float(_nmf_kwargs["beta_loss"]),
             _nmf_kwargs.get("online_h_tol"), _nmf_kwargs.get("n_passes"))
         # solver recipe (ISSUE 9, ops/recipe.py): WHICH convergence math
-        # the sweeps run — resolved once for the whole factorize from the
-        # accel knobs + β/mode/encoding, recorded whole in the dispatch
-        # event + provenance, and threaded into every sweep/warm call so
-        # the AOT warmer keys the exact programs the sweeps dispatch
-        from ..ops.recipe import resolve_recipe
-
-        recipe = resolve_recipe(
-            beta_val, _nmf_kwargs.get("mode", "online"),
-            algo=_nmf_kwargs.get("algo", "mu"), ell=use_ell,
-            n=int(norm_counts.X.shape[0]), g=int(norm_counts.X.shape[1]),
-            k=max(by_k) if by_k else None,
-            ell_width=X.width if use_ell else None)
-        if packed and recipe.algo == "sketch":
-            # the packed K-sweep compiles the exact mu-family programs;
-            # a sketch-lane factorize dispatches per-K sweeps instead
-            packed = False
+        # the sweeps run — resolved once by the plan (same resolve_recipe
+        # call, same precedence), recorded whole in the dispatch event +
+        # provenance, and threaded into every sweep/warm call so the AOT
+        # warmer keys the exact programs the sweeps dispatch
+        recipe = plan.solver_recipe()
         self._events.emit("dispatch", decision="solver_recipe",
                           context=recipe.as_context())
-        # the ENGAGED kernel label (ISSUE 16) — recipe-gated, so a sketch
-        # recipe (whose scatter keeps the jnp chain) records ell-jnp even
-        # under CNMF_TPU_PALLAS=1; authoritative over the pre-recipe
-        # ell_vs_dense event's knob-level label
-        _kern = kernel_label(
-            bool(use_ell),
-            bool(use_ell and beta_val == 1.0
-                 and recipe.algo != "sketch" and resolve_pallas()),
-            _rb(beta_val, _nmf_kwargs.get("mode", "online")))
+        # the ENGAGED kernel label (ISSUE 16) — recipe-gated in the plan,
+        # so a sketch recipe (whose scatter keeps the jnp chain) records
+        # ell-jnp even under CNMF_TPU_PALLAS=1
+        _kern = plan.kernel
         self._save_factorize_provenance(
             "batched-packed" if packed else
             ("batched-ell" if use_ell else "batched"), worker_i,
@@ -1260,6 +1262,7 @@ class cNMF:
                  solver_recipe=recipe.label, kernel=_kern,
                  inner_repeats=int(recipe.inner_repeats),
                  kl_newton=bool(recipe.kl_newton),
+                 plan_signature=plan.signature(),
                  mesh_devices=(1 if mesh is None
                                else int(np.prod(mesh.devices.shape)))))
 
@@ -1853,7 +1856,15 @@ class cNMF:
             # 1-D rowshard cursor under --mesh-grid2d (or vice versa)
             # would splice two solvers' trajectories
             params = dict(params_base, ingest_tier=tier,
-                          layout=("grid2d" if grid else "rowshard"))
+                          layout=("grid2d" if grid else "rowshard"),
+                          # the ENCODING is identity too (ISSUE 17, the
+                          # plan's math-affecting fragment): an ELL vs
+                          # dense flip — e.g. an autotuned density
+                          # crossover moving across runs — changes the
+                          # statistics accumulation structure, so a
+                          # resume across it restarts, never splices
+                          encoding=("ell" if isinstance(
+                              topo["Xd"], _EllMatrix) else "dense"))
             if rs_use_pallas:
                 # engaged-kernel identity (ISSUE 16): the fused kernels
                 # change accumulation order vs the jnp chain, so a resume

@@ -7,13 +7,16 @@ imports Pallas directly:
 
   * :func:`resolve_pallas` — the one resolution of the
     ``CNMF_TPU_PALLAS`` knob (``0`` | ``1`` | ``auto``, house style per
-    ``CNMF_TPU_ACCEL``): ``0`` (default) pins the jnp ELL path — the
+    ``CNMF_TPU_ACCEL``): ``auto`` (default since the execution planner,
+    ISSUE 17) engages the fused kernels only when the default backend is
+    a real TPU, deferring to the measured Pallas-vs-jnp microbench point
+    when the autotune cache holds one; ``0`` pins the jnp ELL path — the
     compiled programs are byte-identical to a build without the kernel
-    layer; ``1`` forces the fused kernels wherever defined (off-TPU they
-    run in interpret mode — correct, slow, CI-testable); ``auto``
-    engages them only when the default backend is a real TPU. If Pallas
-    itself cannot be imported the resolver degrades to the jnp path with
-    one loud announcement instead of failing.
+    layer (the parity escape hatch); ``1`` forces the fused kernels
+    wherever defined (off-TPU they run in interpret mode — correct,
+    slow, CI-testable). If Pallas itself cannot be imported the resolver
+    degrades to the jnp path with one loud announcement instead of
+    failing.
   * :func:`pallas_interpret` — whether ``pallas_call`` must run in
     interpret mode (any non-TPU backend: the kernels are written against
     the TPU lowering; interpret mode is the portable reference).
@@ -102,13 +105,28 @@ def resolve_pallas(override=None) -> bool:
     else:
         from ...utils.envknobs import env_str
 
-        raw = env_str(PALLAS_ENV, "0").strip().lower()
+        raw = env_str(PALLAS_ENV, "auto").strip().lower()
         if raw in _OFF_WORDS:
             return False
         if raw in _ON_WORDS:
             want = True
         elif raw == "auto":
             want = not pallas_interpret()
+            if want:
+                # the planner's measured Pallas-vs-jnp crossover point
+                # (utils/autotune.py, cached per device fingerprint):
+                # auto defers to the measurement when one exists — a TPU
+                # whose jnp ELL chain beats the fused kernels at the
+                # probe shape keeps the jnp path. Best-effort: no cache
+                # (or autotune disabled) keeps the engage-on-TPU default.
+                try:
+                    from ...utils.autotune import cached_plan_point
+
+                    tuned = cached_plan_point("pallas_wins")
+                    if tuned is not None:
+                        want = bool(tuned)
+                except Exception:
+                    pass
         else:
             raise ValueError(
                 f"{PALLAS_ENV}={raw!r}: expected 0, 1, or auto")

@@ -40,12 +40,14 @@ iteration scheme a β-divergence solve runs —
 Resolution order: explicit caller arguments > env knobs > the auto
 heuristic. Knobs (registered in ``utils/envknobs.py``):
 
-  * ``CNMF_TPU_ACCEL``: ``0`` (default) pins plain MU — the compiled
-    programs are byte-identical to a build without this module (same
-    guarantee style as the telemetry flag); ``1`` forces acceleration
-    wherever the recipe is defined; ``auto`` engages it for batch
-    β∈{1,0} MU solves (the lane whose trajectories are NOT pinned
-    bit-exact by the parity suite) and resolves ``amu``/``dna`` from β.
+  * ``CNMF_TPU_ACCEL``: ``auto`` (default since the execution planner,
+    ISSUE 17) engages acceleration for batch β∈{1,0} MU solves (the
+    lane whose trajectories are NOT pinned bit-exact by the parity
+    suite) and resolves ``amu``/``dna`` from β; ``0`` pins plain MU —
+    the compiled programs are byte-identical to a build without this
+    module (same guarantee style as the telemetry flag; the parity
+    escape hatch); ``1`` forces acceleration wherever the recipe is
+    defined.
   * ``CNMF_TPU_INNER_REPEATS``: pins ρ; unset derives it from the
     1107.5194 cost ratio (H-repeat flops vs W-update flops — static in
     n/g/k and the ELL width, :func:`auto_inner_repeats`).
@@ -332,7 +334,16 @@ def resolve_recipe(beta: float, mode: str, *, algo: str = "mu",
             raw_dim = env_str(SKETCH_DIM_ENV, "auto").strip().lower()
             m = 0 if raw_dim in ("", "auto")                 else (env_int(SKETCH_DIM_ENV, 0, lo=0) or 0)
         if not m:
-            m = auto_sketch_rows(n)
+            # measured sketch-dim plan point (utils/autotune.py) wins
+            # over the static n/8 heuristic; env/caller pins above win
+            # outright (precedence pin > autotuned > heuristic)
+            try:
+                from ..utils.autotune import cached_plan_point
+
+                m = cached_plan_point("sketch_dim")
+            except Exception:
+                m = None
+            m = int(m) if m else auto_sketch_rows(n)
         if n:
             m = min(int(m), int(n))
         E = sketch_exact_every
@@ -343,7 +354,11 @@ def resolve_recipe(beta: float, mode: str, *, algo: str = "mu",
                             sketch_dim=int(m), sketch_exact_every=int(E))
 
     if accel is None:
-        accel_raw, source = env_str(ACCEL_ENV, "0"), "env"
+        # default "auto" since the execution planner (ISSUE 17): batch
+        # β∈{1,0} MU solves engage dna/amu out of the box, gated by the
+        # accel parity suites; CNMF_TPU_ACCEL=0 remains the byte-identical
+        # escape hatch (tests pin its lowering equality)
+        accel_raw, source = env_str(ACCEL_ENV, "auto"), "env"
     else:
         accel_raw, source = str(accel), "caller"
     accel_raw = accel_raw.strip().lower()

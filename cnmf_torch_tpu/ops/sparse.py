@@ -318,7 +318,8 @@ def ell_device_put(x: EllMatrix, sharding=None, stats=None) -> EllMatrix:
 
 def resolve_sparse_beta(beta: float, density: float | None = None,
                         width: int | None = None, g: int | None = None,
-                        override=None) -> bool:
+                        override=None,
+                        threshold: float | None = None) -> bool:
     """Should a beta != 2 solve take the ELL path?
 
     Production default: ON for beta in {1, 0} when the matrix is at most
@@ -329,13 +330,17 @@ def resolve_sparse_beta(beta: float, density: float | None = None,
     ``CNMF_TPU_SPARSE_BETA`` env override: ``0`` forces dense, ``1``
     forces ELL (for any beta in {1, 0}), any value in (0, 1) replaces
     the density threshold (the width guard stays). An explicit
-    ``override`` argument wins over the env.
+    ``override`` argument wins over the env. ``threshold`` replaces the
+    static density crossover WITHOUT outranking the env — it is the
+    planner's slot for the measured per-device crossover
+    (``utils/autotune.py``; precedence pin > autotuned > static).
     """
     if beta not in (1.0, 0.0):
         return False
     if override is not None:
         return bool(override)
-    threshold = SPARSE_DENSITY_THRESHOLD
+    threshold = (SPARSE_DENSITY_THRESHOLD if threshold is None
+                 else float(threshold))
     from ..utils.envknobs import env_str
 
     env = env_str("CNMF_TPU_SPARSE_BETA", "")

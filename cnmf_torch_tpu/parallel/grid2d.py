@@ -107,6 +107,17 @@ def grid_blocks(extent: int) -> int:
 
     want = env_int(GRID_BLOCKS_ENV, 0, lo=0)
     if want <= 0:
+        # planner precedence (ISSUE 17): no explicit pin -> the measured
+        # chunk-count point from the autotune cache when one exists for
+        # this device, else the static 4-when-affordable heuristic
+        try:
+            from ..utils.autotune import cached_plan_point
+
+            tuned = cached_plan_point("grid_blocks")
+            want = int(tuned) if tuned else 0
+        except Exception:
+            want = 0
+    if want <= 0:
         want = 4 if extent >= 64 else 1
     want = max(1, min(int(want), max(int(extent), 1)))
     while want > 1 and extent % want:

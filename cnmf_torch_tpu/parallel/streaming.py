@@ -152,12 +152,25 @@ def stream_stall_s() -> float:
 
 def stream_threads() -> int:
     """Host-prep worker count. 0 disables the pipeline (serial staging).
-    Default leaves one core for the caller thread's commit dispatch and
-    the XLA runtime (measured faster than cpu_count workers on small
-    hosts, where an extra worker just contends for memory bandwidth).
-    Negative or non-numeric values reject at parse time."""
-    return _env_int(THREADS_ENV,
-                    max(1, min(4, (os.cpu_count() or 2) - 1)), lo=0)
+    Precedence (ISSUE 17 planner contract): an explicit
+    ``CNMF_TPU_STREAM_THREADS`` pin wins; else the measured staging-
+    throughput point from the autotune cache (``stream_threads``,
+    ``utils/autotune.py``) when one exists for this device; else the
+    static default, which leaves one core for the caller thread's commit
+    dispatch and the XLA runtime (measured faster than cpu_count workers
+    on small hosts, where an extra worker just contends for memory
+    bandwidth). Negative or non-numeric values reject at parse time."""
+    static = max(1, min(4, (os.cpu_count() or 2) - 1))
+    if _env_str(THREADS_ENV, "").strip() == "":
+        try:
+            from ..utils.autotune import cached_plan_point
+
+            tuned = cached_plan_point("stream_threads")
+            if tuned is not None:
+                return max(0, int(tuned))
+        except Exception:
+            pass
+    return _env_int(THREADS_ENV, static, lo=0)
 
 
 def stream_depth(slab_bytes: int | None = None,

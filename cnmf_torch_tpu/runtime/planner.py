@@ -1,0 +1,659 @@
+"""Declarative execution planner — the ONE place dispatch is decided.
+
+Every perf PR so far added a fast lane behind its own knob and its own
+call-site heuristic: dense-vs-ELL encoding (``ops/sparse.py``), the
+solver recipe (``ops/recipe.py``), the fused Pallas kernels
+(``ops/pallas``), packed K-sweeps and the mesh layouts
+(``models/cnmf.py`` + ``parallel/``), streaming transport/depth
+(``parallel/streaming.py``), the OOC ingest tier (``utils/shardstore``),
+the store backend (``utils/storebackend``), and the serve bucket
+schedule (``serving/batcher.py``). The scatter worked while each lane
+shipped off-by-default; honest ``auto`` defaults need the decisions in
+one auditable object. This module provides it:
+
+  * :class:`ExecutionPlan` — the resolved dispatch surface as one flat,
+    JSON-able dataclass, with a per-field ``sources`` map recording WHO
+    decided (``pin`` — an explicit env knob; ``autotuned`` — a measured
+    microbench point from ``utils/autotune.py``; ``heuristic`` — the
+    static shape-driven default). Precedence is exactly that order.
+  * :func:`build_plan` — one call per factorize, from
+    :class:`InputStats` (matrix shape/sparsity/β/mode) and
+    :class:`DeviceInventory` (backend/devices/hosts). It delegates to
+    the SAME registered resolver functions the dispatch sites consume
+    (``resolve_sparse_beta``, ``resolve_recipe``, ``resolve_pallas``,
+    ``stream_threads`` …), so the plan IS the dispatch — not a parallel
+    re-implementation that can drift. The lint rule ``knob-plan-bypass``
+    (``analysis/rules_knobs.py``) pins that property: dispatch-class
+    knob reads outside this module / the allowlisted resolvers fail the
+    gate.
+  * JSON round-trip (:meth:`ExecutionPlan.to_json` / :func:`load_plan`)
+    plus :func:`apply_plan`, which pins the corresponding env knobs so
+    ``cnmf-tpu factorize --plan <file>`` (or ``CNMF_TPU_PLAN=<file>``)
+    reproduces a run's dispatch bit-identically — every scattered
+    consumer resolves the pinned values, and re-building the plan under
+    the pins round-trips to the same plan.
+  * The resolved plan is logged whole as one ``plan`` telemetry event
+    per factorize (``utils/telemetry.py`` schema), rendered by
+    ``cnmf-tpu report`` / ``cnmf-tpu plan <run_dir>``, and its
+    math-affecting fragment (:meth:`ExecutionPlan.identity_fragment`)
+    rides the checkpoint identity — a plan change restarts a mid-run
+    replicate instead of splicing trajectories.
+
+Stdlib-only at import time (jax imports are lazy): the lint engine and
+the CLI's pre-jax paths import this module.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import asdict, dataclass, field, fields
+
+__all__ = [
+    "PLAN_VERSION", "PLAN_ENV", "AUTOTUNE_ENV",
+    "DISPATCH_KNOBS", "PLAN_ACCESSORS", "PLAN_OWNER_FILES",
+    "InputStats", "DeviceInventory", "ExecutionPlan",
+    "build_plan", "resolve_encoding", "apply_plan", "load_plan",
+    "maybe_apply_plan_env", "render_plan", "plan_from_run_dir",
+]
+
+PLAN_VERSION = 1
+PLAN_ENV = "CNMF_TPU_PLAN"
+AUTOTUNE_ENV = "CNMF_TPU_AUTOTUNE"
+
+# the dispatch-class knobs: every env variable that picks WHICH program
+# runs (encoding / recipe / kernel / layout / streaming / ingest tier /
+# store backend / serve schedule) as opposed to capacity or resilience
+# tuning. The `knob-plan-bypass` lint rule fails any read of these
+# outside PLAN_OWNER_FILES that is not inside a PLAN_ACCESSORS resolver.
+DISPATCH_KNOBS = frozenset({
+    "CNMF_TPU_SPARSE_BETA",
+    "CNMF_TPU_ACCEL",
+    "CNMF_TPU_INNER_REPEATS",
+    "CNMF_TPU_KL_NEWTON",
+    "CNMF_TPU_SKETCH",
+    "CNMF_TPU_SKETCH_DIM",
+    "CNMF_TPU_SKETCH_EXACT_EVERY",
+    "CNMF_TPU_PALLAS",
+    "CNMF_TPU_BF16_RATIO",
+    "CNMF_TPU_STREAM_TRANSPORT",
+    "CNMF_TPU_STREAM_THREADS",
+    "CNMF_TPU_STREAM_DEPTH",
+    "CNMF_TPU_GRID_BLOCKS",
+    "CNMF_TPU_GRID_SHAPE",
+    "CNMF_TPU_GRID_OVERLAP",
+    "CNMF_TPU_OOC",
+    "CNMF_TPU_SERVE_BUCKETS",
+    "CNMF_TPU_STORE_URI",
+    "CNMF_TPU_PLAN",
+    "CNMF_TPU_AUTOTUNE",
+})
+
+# the registered resolver functions — the ONLY non-planner code allowed
+# to read a DISPATCH_KNOBS name. One resolution site per knob; dispatch
+# sites call these, never the env accessors directly.
+PLAN_ACCESSORS = frozenset({
+    "resolve_sparse_beta",       # ops/sparse.py       (encoding)
+    "resolve_recipe",            # ops/recipe.py       (solver recipe)
+    "resolve_consensus_sketch",  # ops/sketch.py       (consensus lane)
+    "resolve_pallas",            # ops/pallas          (kernel)
+    "resolve_bf16_ratio",        # ops/nmf.py          (kernel band)
+    "stream_threads",            # parallel/streaming.py
+    "stream_depth",              # parallel/streaming.py
+    "_csr_transport",            # parallel/streaming.py
+    "grid_overlap_enabled",      # parallel/grid2d.py
+    "grid_blocks",               # parallel/grid2d.py
+    "_grid_rc",                  # parallel/grid2d.py
+    "ooc_mode",                  # utils/shardstore.py
+    "resolve_backend",           # utils/storebackend.py
+    "resolve_buckets",           # serving/batcher.py
+})
+
+# files that own dispatch-knob resolution outright (relpath suffixes)
+PLAN_OWNER_FILES = (
+    "runtime/planner.py",
+    "utils/autotune.py",
+    "utils/envknobs.py",
+)
+
+_OFF_WORDS = ("", "0", "off", "false", "no")
+_ON_WORDS = ("1", "on", "true", "yes", "force")
+
+
+# ---------------------------------------------------------------------------
+# inputs
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class InputStats:
+    """The matrix/ledger facts a plan is a function of. All static shape
+    facts — two runs with equal stats (and equal env/autotune state)
+    build equal plans (determinism is pinned by tests/test_planner.py)."""
+
+    n: int
+    g: int
+    beta: float = 1.0
+    mode: str = "online"
+    init: str = "random"
+    algo: str = "mu"
+    sparse: bool = False
+    density: float | None = None
+    ell_width: int | None = None
+    k_max: int | None = None
+    n_ks: int = 1
+    max_replicates: int = 1
+    total_workers: int = 1
+    has_store: bool = False
+
+
+@dataclass(frozen=True)
+class DeviceInventory:
+    """The hardware facts: backend, device kind/count, host count."""
+
+    backend: str = "cpu"
+    device_kind: str = "unknown"
+    n_devices: int = 1
+    n_processes: int = 1
+    cpu_count: int = 1
+
+    @classmethod
+    def probe(cls) -> "DeviceInventory":
+        """Inventory of the live jax runtime (lazy import)."""
+        import jax
+
+        devs = jax.devices()
+        kind = str(getattr(devs[0], "device_kind", "unknown"))
+        return cls(backend=jax.default_backend(),
+                   device_kind=kind.replace(" ", "_"),
+                   n_devices=len(devs),
+                   n_processes=int(jax.process_count()),
+                   cpu_count=os.cpu_count() or 1)
+
+
+# ---------------------------------------------------------------------------
+# the plan
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ExecutionPlan:
+    """The resolved dispatch surface for one factorize. ``sources`` maps
+    field groups to who decided: ``pin`` | ``autotuned`` | ``heuristic``.
+    """
+
+    plan_version: int = PLAN_VERSION
+    package_version: str = ""
+    fingerprint: str = ""
+    beta: float = 1.0
+    mode: str = "online"
+    # encoding
+    use_ell: bool = False
+    density: float | None = None
+    density_threshold: float | None = None
+    ell_width: int | None = None
+    # solver recipe
+    recipe_algo: str = "mu"
+    inner_repeats: int = 1
+    kl_newton: bool = False
+    sketch_dim: int = 0
+    sketch_exact_every: int = 1
+    recipe_label: str = "mu"
+    # kernel
+    use_pallas: bool = False
+    bf16_ratio: bool = False
+    kernel: str = "vmapped"
+    # program shape + layout
+    packed: bool = False
+    layout: str = "1d"
+    mesh_devices: int = 1
+    grid_shape: list | None = None
+    grid_blocks: int | None = None
+    grid_overlap: bool | None = None
+    # streaming
+    stream_transport: str = "auto"
+    stream_threads: int = 1
+    stream_depth: int = 3
+    # ingest tier + store backend
+    ooc_engaged: bool = False
+    store_backend: str = "local"
+    # serve schedule
+    serve_buckets: list = field(default_factory=list)
+    # provenance: field -> "pin" | "autotuned" | "heuristic"
+    sources: dict = field(default_factory=dict)
+
+    # -- serialization --------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    def to_json(self, indent: int | None = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ExecutionPlan":
+        if int(d.get("plan_version", 0)) != PLAN_VERSION:
+            raise ValueError(
+                f"plan_version={d.get('plan_version')!r}: this build "
+                f"understands {PLAN_VERSION}")
+        known = {f.name for f in fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown plan fields {sorted(unknown)}")
+        return cls(**d)
+
+    @classmethod
+    def from_json(cls, text: str) -> "ExecutionPlan":
+        return cls.from_dict(json.loads(text))
+
+    def save(self, path: str) -> None:
+        from ..utils.anndata_lite import atomic_artifact
+
+        with atomic_artifact(path) as tmp:
+            with open(tmp, "w") as f:
+                f.write(self.to_json() + "\n")
+
+    # -- identity -------------------------------------------------------
+
+    def signature(self) -> str:
+        """Stable digest over the dispatch-relevant fields (``sources``
+        and the measured-input ``density`` excluded: two runs that
+        DISPATCH identically share a signature even when one was pinned
+        and the other autotuned its way to the same program)."""
+        d = self.to_dict()
+        d.pop("sources", None)
+        d.pop("density", None)
+        blob = json.dumps(d, sort_keys=True, separators=(",", ":"))
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+    def identity_fragment(self) -> str:
+        """The math-affecting plan fragment carried into the checkpoint
+        identity ``params`` signature: recipe + kernel + encoding. A
+        layout or streaming change replays the same trajectory, so it
+        does NOT restart; a fragment change must (never splice)."""
+        rec = self.solver_recipe()
+        return (rec.signature(kernel=self.kernel if self.use_pallas
+                              else None)
+                + f",enc={'ell' if self.use_ell else 'dense'}")
+
+    def solver_recipe(self):
+        """Rebuild the :class:`~cnmf_torch_tpu.ops.recipe.SolverRecipe`
+        this plan resolved (the object the sweeps are keyed on)."""
+        from ..ops.recipe import SolverRecipe
+
+        return SolverRecipe(
+            self.recipe_algo, int(self.inner_repeats),
+            bool(self.kl_newton),
+            self.sources.get("recipe", "heuristic"),
+            sketch_dim=int(self.sketch_dim),
+            sketch_exact_every=int(self.sketch_exact_every))
+
+
+# ---------------------------------------------------------------------------
+# building
+# ---------------------------------------------------------------------------
+
+def _env_set(*names: str) -> bool:
+    from ..utils.envknobs import env_is_set
+
+    return any(env_is_set(n) for n in names)
+
+
+def _tuned_points() -> dict:
+    """The measured microbench points for this device (empty when the
+    tuner never ran, is disabled, or jax is unavailable)."""
+    try:
+        from ..utils.autotune import cached_plan_points
+
+        return cached_plan_points() or {}
+    except Exception:
+        return {}
+
+
+def resolve_encoding(stats: InputStats,
+                     tuned: dict | None = None) -> tuple[bool, float | None]:
+    """Dense vs ELL for this input — the factorize dispatch site calls
+    THIS (not ``resolve_sparse_beta`` directly) so the measured density
+    crossover is consumed in exactly one place. Returns
+    ``(use_ell, effective_threshold)``; the lane is only defined for
+    sparse random-init plain-MU β∈{1,0} inputs (everything else is
+    dense, as before)."""
+    if not (stats.sparse and stats.beta in (1.0, 0.0)
+            and stats.init == "random" and stats.algo == "mu"):
+        return False, None
+    from ..ops.sparse import SPARSE_DENSITY_THRESHOLD, resolve_sparse_beta
+
+    tuned = _tuned_points() if tuned is None else tuned
+    thr = tuned.get("ell_density_crossover")
+    use_ell = resolve_sparse_beta(stats.beta, density=stats.density,
+                                  width=stats.ell_width, g=stats.g,
+                                  threshold=thr)
+    eff = thr if (thr is not None
+                  and not _env_set("CNMF_TPU_SPARSE_BETA")) \
+        else SPARSE_DENSITY_THRESHOLD
+    return bool(use_ell), float(eff)
+
+
+def _auto_packed(stats: InputStats, use_ell: bool) -> bool:
+    """The packed-K-sweep regime heuristic (measured on the K=5..13 x 100
+    production sweep: packed wins only the compile-dominated many-Ks x
+    few-replicates scans; see models/cnmf.py for the derivation)."""
+    return (not use_ell and stats.algo == "mu" and stats.init == "random"
+            and stats.n_ks >= 4
+            and stats.max_replicates * max(1, stats.total_workers) <= 32)
+
+
+def build_plan(stats: InputStats,
+               inv: DeviceInventory | None = None,
+               overrides: dict | None = None) -> ExecutionPlan:
+    """Resolve the full dispatch surface for one factorize.
+
+    ``overrides`` carries the caller-level facts factorize already
+    resolved from its arguments (they are pins, not heuristics):
+    ``layout`` / ``mesh_devices`` / ``packed`` (tri-state: None = auto)
+    / ``use_ell`` (factorize resolved encoding before staging) /
+    ``ooc_engaged`` / ``serve_chunk``.
+
+    Precedence per field: explicit knob (or caller override) >
+    autotuned microbench point > static heuristic — recorded per field
+    group in ``plan.sources``.
+    """
+    ov = dict(overrides or {})
+    if inv is None:
+        inv = DeviceInventory.probe()
+    try:
+        from ..version import __version__ as pkg_version
+    except Exception:
+        pkg_version = "unknown"
+    try:
+        from ..utils.autotune import device_fingerprint
+
+        fp = device_fingerprint()
+    except Exception:
+        fp = f"{pkg_version}-{inv.backend}-{inv.device_kind}" \
+             f"-x{inv.n_devices}"
+
+    tuned = _tuned_points()
+    sources: dict = {}
+
+    # -- encoding -------------------------------------------------------
+    if "use_ell" in ov:
+        use_ell = bool(ov["use_ell"])
+        _, thr = resolve_encoding(stats, tuned)
+    else:
+        use_ell, thr = resolve_encoding(stats, tuned)
+    sources["encoding"] = (
+        "pin" if _env_set("CNMF_TPU_SPARSE_BETA")
+        else ("autotuned" if "ell_density_crossover" in tuned
+              and stats.sparse else "heuristic"))
+
+    # -- solver recipe --------------------------------------------------
+    from ..ops.recipe import resolve_recipe
+
+    recipe = resolve_recipe(
+        stats.beta, stats.mode, algo=stats.algo, ell=use_ell,
+        n=stats.n, g=stats.g, k=stats.k_max,
+        ell_width=stats.ell_width if use_ell else None)
+    if _env_set("CNMF_TPU_ACCEL", "CNMF_TPU_INNER_REPEATS",
+                "CNMF_TPU_KL_NEWTON", "CNMF_TPU_SKETCH"):
+        sources["recipe"] = "pin"
+    else:
+        sources["recipe"] = "heuristic"
+        if recipe.algo == "amu":
+            # the amu rho schedule consumes the measured cost-ratio
+            # cache (ISSUE 11) when one exists for this device
+            try:
+                from ..utils.autotune import cached_rho_scale
+
+                if cached_rho_scale(stats.beta, use_ell) is not None:
+                    sources["recipe"] = "autotuned"
+            except Exception:
+                pass
+        elif recipe.algo == "sketch" and "sketch_dim" in tuned:
+            sources["recipe"] = "autotuned"
+
+    # -- kernel ---------------------------------------------------------
+    from ..ops.nmf import resolve_bf16_ratio
+    from ..ops.pallas import kernel_label, resolve_pallas
+
+    use_pallas = bool(use_ell and stats.beta == 1.0
+                      and recipe.algo != "sketch" and resolve_pallas())
+    bf16 = bool(resolve_bf16_ratio(stats.beta, stats.mode))
+    kern = kernel_label(use_ell, use_pallas, bf16)
+    sources["kernel"] = (
+        "pin" if _env_set("CNMF_TPU_PALLAS", "CNMF_TPU_BF16_RATIO")
+        else ("autotuned" if "pallas_wins" in tuned and use_ell
+              else "heuristic"))
+
+    # -- program shape --------------------------------------------------
+    packed = ov.get("packed")
+    if packed is None:
+        packed = _auto_packed(stats, use_ell)
+        sources["packed"] = "heuristic"
+    else:
+        packed = bool(packed)
+        sources["packed"] = "pin"
+    if packed and recipe.algo == "sketch":
+        packed = False  # the packed program compiles mu-family only
+
+    # -- layout ---------------------------------------------------------
+    layout = str(ov.get("layout", "1d"))
+    mesh_devices = int(ov.get("mesh_devices", inv.n_devices))
+    grid_shape = grid_blk = grid_ovl = None
+    if layout == "grid2d":
+        from ..parallel.grid2d import (_grid_rc, grid_blocks,
+                                       grid_overlap_enabled)
+
+        r, c = _grid_rc(inv.n_devices, inv.n_processes)
+        grid_shape = [int(r), int(c)]
+        grid_blk = int(grid_blocks(max(1, stats.n // max(r, 1))))
+        grid_ovl = bool(grid_overlap_enabled())
+        sources["grid"] = (
+            "pin" if _env_set("CNMF_TPU_GRID_BLOCKS",
+                              "CNMF_TPU_GRID_SHAPE",
+                              "CNMF_TPU_GRID_OVERLAP")
+            else ("autotuned" if "grid_blocks" in tuned else "heuristic"))
+
+    # -- streaming ------------------------------------------------------
+    from ..parallel.streaming import (_csr_transport, stream_depth,
+                                      stream_threads)
+
+    try:
+        import jax
+
+        transport = _csr_transport(jax.local_devices())
+    except Exception:
+        transport = "auto"
+    threads = int(stream_threads())
+    depth = int(stream_depth())
+    sources["streaming"] = (
+        "pin" if _env_set("CNMF_TPU_STREAM_TRANSPORT",
+                          "CNMF_TPU_STREAM_THREADS",
+                          "CNMF_TPU_STREAM_DEPTH")
+        else ("autotuned" if "stream_threads" in tuned else "heuristic"))
+
+    # -- ingest tier + store backend ------------------------------------
+    ooc = bool(ov.get("ooc_engaged", stats.has_store))
+    sources["ooc"] = "pin" if _env_set("CNMF_TPU_OOC") else "heuristic"
+    from ..utils.envknobs import env_str
+
+    uri = env_str("CNMF_TPU_STORE_URI", "").strip()
+    store = ("http" if uri.startswith(("http://", "https://"))
+             else ("file" if uri.startswith("file://") else "local"))
+    sources["store"] = "pin" if uri else "heuristic"
+
+    # -- serve schedule -------------------------------------------------
+    from ..serving.batcher import resolve_buckets
+
+    buckets = [int(b) for b in resolve_buckets(
+        int(ov.get("serve_chunk", 1024)))]
+    sources["serve"] = ("pin" if _env_set("CNMF_TPU_SERVE_BUCKETS")
+                        else "heuristic")
+
+    return ExecutionPlan(
+        package_version=str(pkg_version), fingerprint=fp,
+        beta=float(stats.beta), mode=str(stats.mode),
+        use_ell=use_ell,
+        density=(None if stats.density is None
+                 else round(float(stats.density), 6)),
+        density_threshold=thr,
+        ell_width=(int(stats.ell_width) if use_ell
+                   and stats.ell_width is not None else None),
+        recipe_algo=recipe.algo, inner_repeats=int(recipe.inner_repeats),
+        kl_newton=bool(recipe.kl_newton),
+        sketch_dim=int(recipe.sketch_dim),
+        sketch_exact_every=int(recipe.sketch_exact_every),
+        recipe_label=recipe.label,
+        use_pallas=use_pallas, bf16_ratio=bf16, kernel=kern,
+        packed=bool(packed), layout=layout, mesh_devices=mesh_devices,
+        grid_shape=grid_shape, grid_blocks=grid_blk, grid_overlap=grid_ovl,
+        stream_transport=str(transport), stream_threads=threads,
+        stream_depth=depth,
+        ooc_engaged=ooc, store_backend=store, serve_buckets=buckets,
+        sources=sources)
+
+
+# ---------------------------------------------------------------------------
+# replay: plan -> env pins
+# ---------------------------------------------------------------------------
+
+def apply_plan(plan: ExecutionPlan) -> dict:
+    """Pin the dispatch knobs to this plan's resolved values so every
+    scattered consumer reproduces its dispatch bit-identically. Returns
+    the applied ``{knob: value}`` map. The autotuner is pinned OFF — a
+    replay must not re-measure its way to a different program — and
+    ``CNMF_TPU_STORE_URI`` is deliberately NOT pinned (the recorded
+    backend kind is provenance; a dumped URI's credentials/host rarely
+    survive the machine the plan replays on)."""
+    pins: dict[str, str] = {}
+    pins["CNMF_TPU_AUTOTUNE"] = "0"
+    pins["CNMF_TPU_SPARSE_BETA"] = "1" if plan.use_ell else "0"
+    if plan.recipe_algo == "sketch":
+        pins["CNMF_TPU_SKETCH"] = "1"
+        pins["CNMF_TPU_SKETCH_DIM"] = str(int(plan.sketch_dim))
+        pins["CNMF_TPU_SKETCH_EXACT_EVERY"] = str(
+            int(plan.sketch_exact_every))
+        pins["CNMF_TPU_ACCEL"] = "0"
+    else:
+        pins["CNMF_TPU_SKETCH"] = "0"
+        if plan.recipe_algo == "mu":
+            pins["CNMF_TPU_ACCEL"] = "0"
+        elif plan.recipe_algo == "dna":
+            pins["CNMF_TPU_ACCEL"] = "1"
+            pins["CNMF_TPU_KL_NEWTON"] = "1"
+        elif plan.recipe_algo == "amu":
+            pins["CNMF_TPU_ACCEL"] = "1"
+            pins["CNMF_TPU_KL_NEWTON"] = "0"
+            pins["CNMF_TPU_INNER_REPEATS"] = str(int(plan.inner_repeats))
+        # hals is the caller's algo argument, not a knob product
+    pins["CNMF_TPU_PALLAS"] = "1" if plan.use_pallas else "0"
+    pins["CNMF_TPU_BF16_RATIO"] = "1" if plan.bf16_ratio else "0"
+    if plan.stream_transport not in ("", "auto"):
+        pins["CNMF_TPU_STREAM_TRANSPORT"] = str(plan.stream_transport)
+    pins["CNMF_TPU_STREAM_THREADS"] = str(int(plan.stream_threads))
+    pins["CNMF_TPU_STREAM_DEPTH"] = str(int(plan.stream_depth))
+    if plan.grid_shape:
+        pins["CNMF_TPU_GRID_SHAPE"] = "%dx%d" % tuple(plan.grid_shape)
+    if plan.grid_blocks is not None:
+        pins["CNMF_TPU_GRID_BLOCKS"] = str(int(plan.grid_blocks))
+    if plan.grid_overlap is not None:
+        pins["CNMF_TPU_GRID_OVERLAP"] = "1" if plan.grid_overlap else "0"
+    if plan.serve_buckets:
+        # resolve_buckets keeps sub-chunk entries and re-adds the chunk
+        # itself, so pinning the full recorded schedule round-trips
+        pins["CNMF_TPU_SERVE_BUCKETS"] = ",".join(
+            str(int(b)) for b in plan.serve_buckets)
+    from ..utils.envknobs import pin_knob
+
+    for name, value in pins.items():
+        pin_knob(name, value)
+    return pins
+
+
+def load_plan(path: str) -> ExecutionPlan:
+    with open(path) as f:
+        return ExecutionPlan.from_json(f.read())
+
+
+def maybe_apply_plan_env() -> ExecutionPlan | None:
+    """``CNMF_TPU_PLAN=<file>`` (the env spelling of ``--plan``): load
+    and pin before any dispatch resolves. Returns the applied plan, or
+    ``None`` when the knob is unset. A missing/invalid plan file is an
+    error — silently running a DIFFERENT dispatch than the operator
+    pinned is exactly what the planner exists to prevent."""
+    from ..utils.envknobs import env_str
+
+    path = env_str(PLAN_ENV, "").strip()
+    if not path:
+        return None
+    plan = load_plan(path)
+    apply_plan(plan)
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# rendering (report / CLI)
+# ---------------------------------------------------------------------------
+
+def render_plan(plan_dict: dict) -> list[str]:
+    """Text lines for the report's Plan section (takes the event/JSON
+    dict form so ``cnmf-tpu report`` renders historical events from
+    builds with more/fewer fields without re-validating)."""
+    d = dict(plan_dict)
+    src = d.get("sources") or {}
+
+    def tag(group):
+        s = src.get(group)
+        return f" [{s}]" if s else ""
+
+    lines = []
+    lines.append(
+        f"  plan v{d.get('plan_version')}  package "
+        f"{d.get('package_version')}  device {d.get('fingerprint')}")
+    enc = "ell" if d.get("use_ell") else "dense"
+    dens = d.get("density")
+    thr = d.get("density_threshold")
+    lines.append(
+        f"  encoding: {enc}"
+        + (f" (density {dens}" + (f" vs crossover {thr})"
+                                  if thr is not None else ")")
+           if dens is not None else "")
+        + tag("encoding"))
+    lines.append(
+        f"  recipe:   {d.get('recipe_label')}  (beta={d.get('beta')}, "
+        f"mode={d.get('mode')})" + tag("recipe"))
+    lines.append(f"  kernel:   {d.get('kernel')}" + tag("kernel"))
+    lines.append(
+        f"  program:  {'packed K-sweep' if d.get('packed') else 'per-K'}"
+        + tag("packed"))
+    lay = f"  layout:   {d.get('layout')} x{d.get('mesh_devices')} device(s)"
+    if d.get("grid_shape"):
+        lay += (f"  grid {d['grid_shape'][0]}x{d['grid_shape'][-1]}"
+                f" blocks={d.get('grid_blocks')}"
+                f" overlap={'on' if d.get('grid_overlap') else 'off'}"
+                + tag("grid"))
+    lines.append(lay)
+    lines.append(
+        f"  stream:   transport={d.get('stream_transport')} "
+        f"threads={d.get('stream_threads')} depth={d.get('stream_depth')}"
+        + tag("streaming"))
+    lines.append(
+        f"  ingest:   {'out-of-core shard store' if d.get('ooc_engaged') else 'resident'}"
+        + tag("ooc") + f"  store={d.get('store_backend')}" + tag("store"))
+    if d.get("serve_buckets"):
+        lines.append(
+            "  serve:    buckets="
+            + ",".join(str(b) for b in d["serve_buckets"]) + tag("serve"))
+    return lines
+
+
+def plan_from_run_dir(run_dir: str) -> dict | None:
+    """The last ``plan`` event recorded in a run directory's telemetry
+    (the ``cnmf-tpu plan <run_dir>`` source), or ``None``."""
+    from ..utils.telemetry import _find_event_files, read_events
+
+    plan = None
+    for path in _find_event_files(run_dir):
+        for ev in read_events(path):
+            if ev.get("t") == "plan":
+                plan = ev.get("plan")
+    return plan

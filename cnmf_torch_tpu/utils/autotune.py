@@ -27,7 +27,14 @@ import threading
 import time
 
 __all__ = ["device_fingerprint", "cache_path", "measure_rho_scales",
-           "maybe_autotune_rho", "cached_rho_scale"]
+           "maybe_autotune_rho", "cached_rho_scale",
+           "measure_plan_points", "maybe_autotune_plan",
+           "cached_plan_point", "cached_plan_points", "AUTOTUNE_ENV"]
+
+AUTOTUNE_ENV = "CNMF_TPU_AUTOTUNE"
+
+_OFF_WORDS = ("", "0", "off", "false", "no")
+_ON_WORDS = ("1", "on", "true", "yes", "force")
 
 _PROBE_N, _PROBE_G, _PROBE_K = 2048, 512, 10
 _PROBE_DENSITY = 0.05
@@ -37,13 +44,41 @@ _memo_lock = threading.Lock()
 
 
 def device_fingerprint() -> str:
-    """Backend + device kind + count — the identity a measured ratio is
-    valid for (a resumed run on different hardware re-measures)."""
+    """Package version + backend + device kind + count — the identity a
+    measured point is valid for. The PACKAGE VERSION is part of the
+    fingerprint (ISSUE 17 satellite): a version bump changes the cache
+    path outright, so stale crossovers measured against older kernels
+    are orphaned instead of silently reused (a resumed run on different
+    hardware re-measures for the same reason)."""
     import jax
 
+    try:
+        from ..version import __version__ as pkg_version
+    except Exception:
+        pkg_version = "unknown"
     d = jax.devices()[0]
     kind = str(getattr(d, "device_kind", "unknown")).replace(" ", "_")
-    return f"{jax.default_backend()}-{kind}-x{len(jax.devices())}"
+    return (f"v{pkg_version}-{jax.default_backend()}-{kind}"
+            f"-x{len(jax.devices())}")
+
+
+def autotune_mode() -> str:
+    """The ``CNMF_TPU_AUTOTUNE`` word, normalized to ``off`` | ``auto``
+    | ``force``. ``off`` disables measuring AND consuming (static
+    heuristics only — the deterministic escape hatch); ``auto`` (the
+    default) consumes an existing cache but only measures when an
+    explicitly engaged lane needs it; ``force`` measures all plan
+    points up front."""
+    from .envknobs import env_str
+
+    raw = env_str(AUTOTUNE_ENV, "auto").strip().lower()
+    if raw in _OFF_WORDS:
+        return "off"
+    if raw in _ON_WORDS:
+        return "force"
+    if raw == "auto":
+        return "auto"
+    raise ValueError(f"{AUTOTUNE_ENV}={raw!r}: expected 0, 1, or auto")
 
 
 def cache_path(cache_dir: str | None = None) -> str:
@@ -160,11 +195,20 @@ def maybe_autotune_rho(cache_dir: str | None = None,
     try:
         from .envknobs import env_str
 
-        if not force:
-            accel = env_str("CNMF_TPU_ACCEL", "0").strip().lower()
+        mode = autotune_mode()
+        if mode == "off" and not force:
+            return None
+        if not force and mode != "force":
+            # lazy mode: measure only when the accel knobs EXPLICITLY
+            # engage an amu schedule. The "auto" accel default (ISSUE 17)
+            # deliberately does not trigger measurement — a stock run
+            # stays deterministic on a cold machine and uses the static
+            # ρ schedule; an existing cache is still consumed
+            # (precedence pin > autotuned > heuristic), and
+            # CNMF_TPU_AUTOTUNE=1 measures up front.
+            accel = env_str("CNMF_TPU_ACCEL", "auto").strip().lower()
             rho_pin = env_str("CNMF_TPU_INNER_REPEATS", "").strip().lower()
-            if accel in ("", "0", "off", "false", "no") or \
-                    rho_pin not in ("", "auto"):
+            if accel not in _ON_WORDS or rho_pin not in ("", "auto"):
                 return None
             # amu-reachability (``beta`` known): a run whose engaged
             # recipe can only be sketch (CNMF_TPU_SKETCH forces the
@@ -179,25 +223,242 @@ def maybe_autotune_rho(cache_dir: str | None = None,
                 if sk in ("1", "on", "true", "yes", "force") or \
                         env_flag("CNMF_TPU_KL_NEWTON", True):
                     return None
-            import jax
+        import jax
 
-            if jax.process_count() > 1:
-                return None
+        if jax.process_count() > 1:
+            return None
         path = cache_path(cache_dir)
         payload = None if force else _load(path)
-        if payload is None:
-            payload = measure_rho_scales()
-            os.makedirs(os.path.dirname(path), exist_ok=True)
-            from .anndata_lite import atomic_artifact
-
-            with atomic_artifact(path) as tmp:
-                with open(tmp, "w") as f:
-                    json.dump(payload, f)
+        if payload is None or "scales" not in payload:
+            payload = _merge_write(path, measure_rho_scales())
         with _memo_lock:
             _memo[path] = payload
         return payload
     except Exception:
         return None
+
+
+def _merge_write(path: str, updates: dict) -> dict:
+    """Merge ``updates`` into the device's cache payload and atomically
+    rewrite it (the ρ scales and the plan points share one file, so a
+    later measurement must not clobber an earlier section)."""
+    payload = _load(path) or {}
+    payload.update(updates)
+    payload["fingerprint"] = device_fingerprint()
+    payload["measured_at"] = time.time()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    from .anndata_lite import atomic_artifact
+
+    with atomic_artifact(path) as tmp:
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+    with _memo_lock:
+        _memo[path] = payload
+    return payload
+
+
+def measure_plan_points() -> dict:
+    """Run the PLANNER microbenches (ISSUE 17): one measured value per
+    dispatch decision the static heuristics in
+    ``runtime/planner.py:build_plan`` would otherwise guess. Every point
+    is individually best-effort — a lane that fails to measure is simply
+    absent from the dict and the planner keeps its static default for
+    that decision. Points:
+
+      * ``ell_density_crossover`` — the density below which the ELL
+        encoding beats the dense chain, extrapolated from the probe-
+        density wall ratio (ELL pass cost scales ~linearly with width,
+        dense is density-blind), clamped to [0.01, 0.5].
+      * ``pallas_wins`` — fused-Pallas vs jnp ELL H-statistics wall
+        (TPU backends only: interpret mode is not a perf signal).
+      * ``grid_blocks`` — fastest per-axis chunk count for the chunked
+        statistics pass among {1, 2, 4, 8}.
+      * ``stream_threads`` — fastest host→device slab-staging thread
+        count among {1, 2, 4} (depth follows as ``2*threads + 1``).
+      * ``sketch_dim`` — largest probe-scaled sketch row count whose
+        W-update wall is at most half the exact update (the sketch
+        recipe's break-even contract); recorded as rows per 2048 cells
+        so the planner can rescale to the live ``n``.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import scipy.sparse as sp
+
+    from ..ops.nmf import _update_H, _update_W
+    from ..ops.sparse import csr_to_ell, ell_device_put, ell_w_table
+
+    n, g, k = _PROBE_N, _PROBE_G, _PROBE_K
+    rng = np.random.default_rng(0)
+    H = jnp.asarray(rng.uniform(0.1, 1.0, (n, k)).astype(np.float32))
+    W = jnp.asarray(rng.uniform(0.1, 1.0, (k, g)).astype(np.float32))
+    Xd = jnp.asarray(rng.gamma(1.0, 1.0, (n, g)).astype(np.float32))
+    mask = rng.uniform(size=(n, g)) < _PROBE_DENSITY
+    Xs = sp.csr_matrix(np.where(mask, np.asarray(Xd), 0.0))
+    E = ell_device_put(csr_to_ell(Xs))
+    table = ell_w_table(W, E.cols)
+
+    points: dict = {}
+
+    # ELL-vs-dense crossover: at the probe density the walls are
+    # dense_w (flat in density) and ell_w (~linear in width ∝ density),
+    # so equal-cost density ≈ probe_density * dense_w / ell_w
+    try:
+        h_dense = jax.jit(lambda h: _update_H(Xd, h, W, 1.0, 0.0, 0.0))
+        h_ell = jax.jit(
+            lambda h: _update_H(E, h, W, 1.0, 0.0, 0.0, w_table=table))
+        dense_w = _time_call(h_dense, H)
+        ell_w = max(_time_call(h_ell, H), 1e-9)
+        points["ell_density_crossover"] = round(
+            min(0.5, max(0.01, _PROBE_DENSITY * dense_w / ell_w)), 4)
+    except Exception:
+        pass
+
+    # Pallas-vs-jnp: only a real TPU lowering is a perf signal
+    # (interpret mode times the reference interpreter, not the kernel)
+    try:
+        from ..ops.pallas import pallas_available, pallas_interpret
+
+        if pallas_available() and not pallas_interpret():
+            h_jnp = jax.jit(
+                lambda h: _update_H(E, h, W, 1.0, 0.0, 0.0, w_table=table))
+            h_pl = jax.jit(lambda h: _update_H(
+                E, h, W, 1.0, 0.0, 0.0, w_table=table, use_pallas=True))
+            points["pallas_wins"] = bool(
+                _time_call(h_pl, H) < _time_call(h_jnp, H))
+    except Exception:
+        pass
+
+    # grid block count: wall of the row-chunked dense statistics pass
+    # (the grid2d overlap unit) at each candidate chunking
+    try:
+        walls = {}
+        for nb in (1, 2, 4, 8):
+            rows = n // nb
+            h_blk = jax.jit(
+                lambda h, x: _update_H(x, h, W, 1.0, 0.0, 0.0))
+
+            def run_blocks(nb=nb, rows=rows, h_blk=h_blk):
+                return [h_blk(H[i * rows:(i + 1) * rows],
+                              Xd[i * rows:(i + 1) * rows])
+                        for i in range(nb)]
+
+            walls[nb] = _time_call(run_blocks)
+        points["grid_blocks"] = int(min(walls, key=walls.get))
+    except Exception:
+        pass
+
+    # slab-staging threads: host->device put throughput over 16 slabs
+    try:
+        from concurrent.futures import ThreadPoolExecutor
+
+        slabs = [np.asarray(rng.gamma(1.0, 1.0, (128, g)),
+                            dtype=np.float32) for _ in range(16)]
+        dev = jax.devices()[0]
+
+        def stage_all(n_threads):
+            with ThreadPoolExecutor(max_workers=n_threads) as pool:
+                futs = [pool.submit(jax.device_put, s, dev) for s in slabs]
+                jax.block_until_ready([f.result() for f in futs])
+
+        t_walls = {}
+        for nt in (1, 2, 4):
+            stage_all(nt)  # warm-up
+            w0 = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                stage_all(nt)
+                w0.append(time.perf_counter() - t0)
+            t_walls[nt] = sorted(w0)[1]
+        points["stream_threads"] = int(min(t_walls, key=t_walls.get))
+    except Exception:
+        pass
+
+    # sketch dim: largest row-subsample whose W update costs at most
+    # half the exact one (recorded per 2048 probe cells)
+    try:
+        w_exact = jax.jit(lambda h, w: _update_W(Xd, h, w, 1.0, 0.0, 0.0))
+        exact_wall = _time_call(w_exact, H, W)
+        best = None
+        for m in (n // 16, n // 8, n // 4):
+            Xm, Hm = Xd[:m], H[:m]
+            w_sk = jax.jit(
+                lambda h, w, x=Xm: _update_W(x, h, w, 1.0, 0.0, 0.0))
+            if _time_call(w_sk, Hm, W) <= 0.5 * exact_wall:
+                best = int(m)
+        if best is not None:
+            points["sketch_dim"] = best
+    except Exception:
+        pass
+
+    return points
+
+
+def maybe_autotune_plan(cache_dir: str | None = None,
+                        force: bool = False) -> dict | None:
+    """Ensure the plan-point section of the device cache exists.
+    MEASURES only under ``CNMF_TPU_AUTOTUNE=1`` (force mode) or an
+    explicit ``force=True`` — the ``auto`` default consumes an existing
+    cache without ever paying the bench on a stock run, keeping cold-
+    machine dispatch deterministic (the static heuristics). Multi-host
+    pods never measure nor consume (plan points feed jit statics that
+    must agree across SPMD hosts). Returns the full cache payload or
+    ``None``; best-effort, never raises."""
+    try:
+        mode = autotune_mode()
+        if mode == "off" and not force:
+            return None
+        import jax
+
+        if jax.process_count() > 1:
+            return None
+        path = cache_path(cache_dir)
+        payload = _load(path)
+        if force or mode == "force":
+            if force or payload is None or "plan_points" not in payload:
+                payload = _merge_write(
+                    path, {"plan_points": measure_plan_points()})
+        if payload is not None:
+            with _memo_lock:
+                _memo[path] = payload
+        return payload
+    except Exception:
+        return None
+
+
+def cached_plan_points(cache_dir: str | None = None) -> dict:
+    """Read-only: the measured plan points for this device fingerprint,
+    or ``{}``. Never measures. Same consumption gates as
+    :func:`cached_rho_scale`: ``CNMF_TPU_AUTOTUNE=0`` and multi-host
+    pods always get ``{}``."""
+    try:
+        if autotune_mode() == "off":
+            return {}
+        import jax
+
+        if jax.process_count() > 1:
+            return {}
+        path = cache_path(cache_dir)
+        with _memo_lock:
+            payload = _memo.get(path)
+        if payload is None:
+            payload = _load(path)
+            if payload is None:
+                return {}
+            with _memo_lock:
+                _memo[path] = payload
+        pts = payload.get("plan_points")
+        return dict(pts) if isinstance(pts, dict) else {}
+    except Exception:
+        return {}
+
+
+def cached_plan_point(name: str, cache_dir: str | None = None):
+    """One measured plan point by name, or ``None`` when absent (the
+    caller keeps its static heuristic). The consumption sites:
+    ``runtime/planner.py`` (ell_density_crossover, grid/stream points),
+    ``ops/pallas`` (pallas_wins), ``ops/recipe.py`` (sketch_dim)."""
+    return cached_plan_points(cache_dir).get(name)
 
 
 def cached_rho_scale(beta: float, ell: bool = False,
@@ -207,8 +468,13 @@ def cached_rho_scale(beta: float, ell: bool = False,
     when no cache has been written for this device. Never measures.
     Multi-host pods always get ``None`` — a cache written by an earlier
     single-host run on one machine must not steer ρ differently across
-    hosts compiling one SPMD program (see :func:`maybe_autotune_rho`)."""
+    hosts compiling one SPMD program (see :func:`maybe_autotune_rho`).
+    ``CNMF_TPU_AUTOTUNE=0`` also gets ``None`` — the deterministic
+    static-heuristics escape hatch disables consumption, not just
+    measurement."""
     try:
+        if autotune_mode() == "off":
+            return None
         import jax
 
         if jax.process_count() > 1:

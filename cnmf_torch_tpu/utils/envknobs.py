@@ -34,6 +34,7 @@ __all__ = [
     "env_str",
     "env_flag",
     "env_is_set",
+    "pin_knob",
     "knob_table",
     "parse_knob_table",
 ]
@@ -69,21 +70,24 @@ REGISTRY: dict[str, Knob] = _knobs(
          "β∈{1,0} ELL sparse path: `0` force dense, `1` force ELL, a value "
          "in (0,1) replaces the auto density threshold (default 0.10, plus "
          "a width≤genes/8 ragged-row guard)"),
-    Knob("CNMF_TPU_ACCEL", "str", "`0`",
-         "iteration-count acceleration recipes (ISSUE 9): `0` pins plain "
+    Knob("CNMF_TPU_ACCEL", "str", "auto",
+         "iteration-count acceleration recipes (ISSUE 9): `auto` "
+         "(default since the planner, ISSUE 17) engages them for batch "
+         "β∈{1,0} MU solves and derives amu/dna from β; `0` pins plain "
          "MU (programs byte-identical to a build without the recipe "
-         "layer), `1` forces acceleration wherever defined, `auto` "
-         "engages it for batch β∈{1,0} MU solves and derives amu/dna "
-         "from β — the chosen recipe lands in telemetry dispatch events, "
+         "layer — the parity escape hatch), `1` forces acceleration "
+         "wherever defined — the chosen recipe lands in the plan event, "
          "provenance, and the checkpoint identity"),
-    Knob("CNMF_TPU_PALLAS", "str", "`0`",
+    Knob("CNMF_TPU_PALLAS", "str", "auto",
          "fused Pallas kernels for the ELL β=1 (KL) statistics + "
-         "objective (ISSUE 16): `0` pins the jnp ELL path (programs "
-         "byte-identical to a build without the kernel layer), `1` "
-         "forces the fused kernels (interpret mode off-TPU — parity "
-         "runs, not perf), `auto` engages them only on a TPU backend — "
-         "the engaged kernel label lands in telemetry dispatch events, "
-         "provenance, and the checkpoint identity"),
+         "objective (ISSUE 16): `auto` (default since the planner, "
+         "ISSUE 17) engages them only on a TPU backend (consulting the "
+         "measured Pallas-vs-jnp microbench point when cached); `0` "
+         "pins the jnp ELL path (programs byte-identical to a build "
+         "without the kernel layer), `1` forces the fused kernels "
+         "(interpret mode off-TPU — parity runs, not perf) — the "
+         "engaged kernel label lands in the plan event, provenance, and "
+         "the checkpoint identity"),
     Knob("CNMF_TPU_INNER_REPEATS", "int", "auto",
          "accelerated-MU ρ (H sub-iterations per W update, arXiv "
          "1107.5194); unset derives ρ from the H-repeat vs W-update "
@@ -117,6 +121,22 @@ REGISTRY: dict[str, Knob] = _knobs(
          "bf16 X/WH/ratio intermediates for online KL/IS (1.78–2.09× on "
          "v5e); `0` restores strict f32 (announced once per process when "
          "active)"),
+    Knob("CNMF_TPU_PLAN", "str", "unset",
+         "path to a dumped execution-plan JSON (the env spelling of "
+         "`cnmf-tpu factorize --plan`): loaded before any dispatch "
+         "resolves and pinned knob-by-knob, so the run reproduces the "
+         "recorded dispatch bit-identically (`runtime/planner.py`; the "
+         "resolved plan of every factorize is logged as a `plan` "
+         "telemetry event and printed by `cnmf-tpu plan <run_dir>`)"),
+    Knob("CNMF_TPU_AUTOTUNE", "str", "auto",
+         "the microbench autotuner behind the execution planner "
+         "(`utils/autotune.py`): `auto` (default) consumes an existing "
+         "per-device measured cache (ρ cost ratios, ELL density "
+         "crossover, Pallas-vs-jnp, grid blocks, stream threads, sketch "
+         "dim) but only measures when an explicitly engaged lane needs "
+         "it; `1` measures all plan points up front (once per device "
+         "fingerprint, ~2 s); `0` disables measuring AND consuming — "
+         "static heuristics only, the deterministic escape hatch"),
     Knob("CNMF_TPU_BUDGET_ELEMS", "int", "device-derived",
          "fp32 element budget for replicate-sweep slicing"),
     Knob("CNMF_TPU_WARM_DUMMY_BUDGET_BYTES", "int", "`2<<30`",
@@ -459,6 +479,19 @@ def env_is_set(name: str) -> bool:
             f"env knob {name!r} is not registered; declare it in "
             "cnmf_torch_tpu/utils/envknobs.py")
     return name in os.environ
+
+
+def pin_knob(name: str, value) -> None:
+    """Set a registered knob's process-environment value — the execution
+    planner's replay mechanism (``runtime/planner.py:apply_plan``): a
+    loaded ``--plan`` pins each dispatch knob so every scattered
+    consumer resolves the recorded decision. Lives here (the env owner)
+    so no other module writes ``os.environ`` for knobs."""
+    if name not in REGISTRY:
+        raise ValueError(
+            f"env knob {name!r} is not registered; declare it in "
+            "cnmf_torch_tpu/utils/envknobs.py")
+    os.environ[name] = str(value)
 
 
 # ---------------------------------------------------------------------------

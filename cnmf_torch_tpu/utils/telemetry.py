@@ -104,6 +104,14 @@ EVENT_TYPES = {
     # overlap_fraction (the fraction of the collective wall hidden
     # behind compute — optional: present only when the probe ran)
     "collective": {"context", "wall_s", "nbytes"},
+    # the resolved execution plan (runtime/planner.py, ISSUE 17): the
+    # WHOLE dispatch surface as one auditable record — encoding, solver
+    # recipe, kernel, mesh layout, streaming, OOC tier, store backend,
+    # serve buckets — plus per-group provenance (pin / autotuned /
+    # heuristic) and the identity signature carried into checkpoints.
+    # Exactly ONE per factorize; `cnmf-tpu plan <run_dir>` re-renders it
+    # and `--plan <file>` replays it bit-identically
+    "plan": {"plan", "signature"},
 }
 
 # per-record required fields inside a "replicates" event's records list
@@ -523,6 +531,14 @@ def summarize_events(events: list[dict]) -> dict:
         {k: e[k] for k in ("decision", "context") if k in e}
         for e in events if e["t"] == "dispatch"]
 
+    # the resolved execution plan (ISSUE 17): one per factorize — keep
+    # the LAST (a multi-worker run dir concatenates worker streams; they
+    # resolved the same plan or the signatures differ loudly here)
+    plan_ev = next((e for e in reversed(events) if e["t"] == "plan"), None)
+    if plan_ev is not None:
+        summary["plan"] = {"plan": plan_ev.get("plan"),
+                           "signature": plan_ev.get("signature")}
+
     # consensus/k-selection dispatch lane (ISSUE 11): which geometry the
     # clustering stages ran on — sketched (random-projected) vs exact —
     # with the replicate counts and distance-matrix shapes that justify
@@ -866,6 +882,21 @@ def render_report(run_dir: str) -> str:
             f"  package {man.get('package_version')}   "
             f"jax {man.get('jax_version')}   backend {man.get('backend')} "
             f"({man.get('n_devices')} device(s))")
+
+    plan_sum = summary.get("plan")
+    if plan_sum and isinstance(plan_sum.get("plan"), dict):
+        lines.append("")
+        lines.append("Plan")
+        lines.append("-" * 4)
+        try:
+            from ..runtime.planner import render_plan
+
+            lines.extend("  " + ln
+                         for ln in render_plan(plan_sum["plan"]))
+        except Exception:
+            lines.append("  (unrenderable plan payload)")
+        if plan_sum.get("signature"):
+            lines.append(f"  signature {plan_sum['signature']}")
 
     if summary.get("dispatch"):
         lines.append("")

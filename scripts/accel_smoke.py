@@ -88,9 +88,12 @@ def main() -> int:
     rel = np.abs(payloads["hals"] - payloads["mu-f2"]) / payloads["mu-f2"]
     assert (rel < TOL).all(), ("hals", payloads["hals"], payloads["mu-f2"])
 
-    # the auto lane resolves the documented recipes
+    # the auto lane resolves the documented recipes — and since the
+    # execution planner (ISSUE 17) it IS the shipped default, with
+    # CNMF_TPU_ACCEL=0 as the byte-identical plain-MU escape hatch
     assert resolve_recipe(1.0, "batch", accel="auto").label == "dna"
-    assert resolve_recipe(1.0, "batch").label == "mu"  # default: plain
+    assert resolve_recipe(1.0, "batch").label == "dna"  # default: auto
+    assert resolve_recipe(1.0, "batch", accel="0").label == "mu"
 
     # schema-valid stream + recipe/fallback visible in the summary
     n_events = validate_events_file(log.path)

@@ -1,0 +1,134 @@
+"""Tier-1 execution-planner smoke gate (ISSUE 17, wired in
+scripts/verify_tier1.sh).
+
+Runs the mini pipeline three times against the same prepared counts and
+asserts the planner contract end to end:
+
+  * run A (shipped ``auto`` defaults, telemetry on) records exactly ONE
+    schema-valid ``plan`` event for the factorize, and
+    ``cnmf-tpu plan <run_dir>`` renders it and dumps replayable JSON;
+  * run B replays the dumped plan via ``CNMF_TPU_PLAN`` and reproduces
+    run A bit-identically — same plan signature, byte-equal spectra for
+    every replicate;
+  * run C sets the ``=0`` escape hatches (``CNMF_TPU_ACCEL=0``,
+    ``CNMF_TPU_PALLAS=0``) and stays byte-identical to the ``auto``
+    defaults on this fixture — the flipped defaults only change stock
+    programs where a measured win says so, never silently.
+
+Exit 0 on success; any assertion or schema failure exits nonzero and
+fails the gate.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+
+# runnable as `python scripts/plan_smoke.py` without installing the
+# package: sys.path[0] is scripts/, the package lives one level up
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["CNMF_TPU_TELEMETRY"] = "1"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    import numpy as np
+    import pandas as pd
+
+    from cnmf_torch_tpu import cNMF
+    from cnmf_torch_tpu.cli import main as cli_main
+    from cnmf_torch_tpu.runtime.planner import ExecutionPlan, PLAN_ENV
+    from cnmf_torch_tpu.utils import load_df_from_npz, save_df_to_npz
+    from cnmf_torch_tpu.utils.telemetry import (read_events,
+                                                validate_events_file)
+
+    workdir = tempfile.mkdtemp(prefix="plan_smoke_")
+    env0 = dict(os.environ)
+    try:
+        rng = np.random.default_rng(11)
+        usage = rng.dirichlet(np.ones(4) * 0.3, size=180)
+        spectra = rng.gamma(0.3, 1.0, size=(4, 120)) * 40.0 / 120
+        counts = rng.poisson(usage @ spectra * 250.0).astype(np.float64)
+        counts[counts.sum(axis=1) == 0, 0] = 1.0
+        df = pd.DataFrame(counts, index=[f"c{i}" for i in range(180)],
+                          columns=[f"g{j}" for j in range(120)])
+        counts_fn = os.path.join(workdir, "counts.df.npz")
+        save_df_to_npz(df, counts_fn)
+
+        def run(name):
+            """One prepare+factorize under the current environment;
+            returns (run_dir, plan events, {file: spectra array})."""
+            obj = cNMF(output_dir=workdir, name=name)
+            obj.prepare(counts_fn, components=[3], n_iter=4, seed=7,
+                        num_highvar_genes=90)
+            obj.factorize()
+            run_dir = os.path.join(workdir, name)
+            tmp_dir = os.path.join(run_dir, "cnmf_tmp")
+            ev_path = os.path.join(tmp_dir, name + ".events.jsonl")
+            validate_events_file(ev_path)  # raises on any malformed line
+            plans = [e for e in read_events(ev_path) if e["t"] == "plan"]
+            # key by the `spectra.k_%d.iter_%d` suffix so the three
+            # differently-named runs compare file-for-file
+            mats = {f.split(".", 1)[1]:
+                    load_df_from_npz(os.path.join(tmp_dir, f)).to_numpy()
+                    for f in sorted(os.listdir(tmp_dir))
+                    if ".spectra.k_" in f}
+            assert mats, f"{name}: no replicate spectra written"
+            return run_dir, plans, mats
+
+        # -- run A: the shipped auto defaults -------------------------
+        dir_a, plans_a, mats_a = run("auto")
+        assert len(plans_a) == 1, \
+            f"expected exactly 1 plan event, got {len(plans_a)}"
+        sig_a = plans_a[0]["signature"]
+        print(f"[plan-smoke] auto run: 1 schema-valid plan event, "
+              f"signature {sig_a}")
+
+        # `cnmf-tpu plan <run_dir>` renders the event and dumps JSON
+        plan_fn = os.path.join(workdir, "plan.json")
+        cli_main(["plan", dir_a, "--out", plan_fn])
+        with open(plan_fn) as f:
+            dumped = ExecutionPlan.from_json(f.read())
+        assert dumped.signature() == sig_a, \
+            (dumped.signature(), sig_a)
+
+        # -- run B: CNMF_TPU_PLAN replay is bit-identical -------------
+        os.environ[PLAN_ENV] = plan_fn
+        _, plans_b, mats_b = run("replay")
+        os.environ.clear()
+        os.environ.update(env0)
+        assert len(plans_b) == 1, plans_b
+        assert plans_b[0]["signature"] == sig_a, \
+            ("replay rebuilt a different plan under the pins",
+             plans_b[0]["signature"], sig_a)
+        assert set(mats_b) == set(mats_a), \
+            (sorted(mats_a), sorted(mats_b))
+        for fn in mats_a:
+            assert np.array_equal(mats_a[fn], mats_b[fn]), \
+                f"replay spectra differ: {fn}"
+        print(f"[plan-smoke] --plan replay: signature match, "
+              f"{len(mats_a)} spectra files byte-identical")
+
+        # -- run C: the =0 escape hatch keeps the stock program -------
+        os.environ["CNMF_TPU_ACCEL"] = "0"
+        os.environ["CNMF_TPU_PALLAS"] = "0"
+        _, _, mats_c = run("stock")
+        os.environ.clear()
+        os.environ.update(env0)
+        for fn in mats_a:
+            assert np.array_equal(mats_a[fn], mats_c[fn]), \
+                f"ACCEL=0/PALLAS=0 escape hatch diverged: {fn}"
+        print(f"[plan-smoke] OK: escape hatch byte-identical on "
+              f"{len(mats_a)} spectra files")
+        return 0
+    finally:
+        os.environ.clear()
+        os.environ.update(env0)
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -160,6 +160,24 @@ if [ "$rc" -eq 0 ]; then
   fi
 fi
 
+# plan smoke: three mini runs against one prepared counts fixture —
+# the shipped auto defaults must record exactly ONE schema-valid `plan`
+# telemetry event per factorize, `cnmf-tpu plan <run_dir>` must render
+# and dump it, a CNMF_TPU_PLAN replay of the dumped JSON must reproduce
+# the run bit-identically (same plan signature, byte-equal spectra),
+# and the =0 escape hatches (ACCEL/PALLAS) must stay byte-identical to
+# the auto defaults (scripts/plan_smoke.py)
+if [ "$rc" -eq 0 ]; then
+  echo "[tier1] plan smoke (execution planner: one plan event + --plan replay bit-parity + =0 escape hatch) ..."
+  if timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      python scripts/plan_smoke.py; then
+    echo PLAN_SMOKE=ok
+  else
+    echo PLAN_SMOKE=fail
+    exit 1
+  fi
+fi
+
 # serve smoke: consensus-complete mini run served by the REAL daemon
 # (CLI subprocess on a unix socket) under concurrent clients + one
 # poison tenant — asserts cross-request batching engaged (telemetry

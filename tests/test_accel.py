@@ -46,8 +46,13 @@ def _sparse_counts(n=240, g=100, k=4, seed=11, scale=0.8):
 # ---------------------------------------------------------------------------
 
 class TestRecipeResolution:
-    def test_default_is_identity_plain_mu(self, monkeypatch):
+    def test_default_is_auto_zero_is_identity_plain_mu(self, monkeypatch):
+        # shipped default since the execution planner (ISSUE 17): unset
+        # == auto, so batch KL engages dna; '0' is the identity hatch
         monkeypatch.delenv("CNMF_TPU_ACCEL", raising=False)
+        assert resolve_recipe(1.0, "batch").label == "dna"
+        assert resolve_recipe(1.0, "online").label == "mu"
+        monkeypatch.setenv("CNMF_TPU_ACCEL", "0")
         rec = resolve_recipe(1.0, "batch")
         assert rec.algo == "mu" and rec.is_identity
         assert rec.label == "mu"
@@ -466,13 +471,19 @@ class TestAccelTrajectoryParity:
         best = C.max(axis=1)
         assert (best > 0.98).all(), best
 
-    def test_default_accel_remains_identity(self, monkeypatch):
-        """The documented outcome of this suite: bands hold, default
-        stays '0' (byte-identity with the golden/oracle-pinned
-        programs). README's Solver recipes section records the why."""
+    def test_default_accel_auto_with_zero_escape_hatch(self, monkeypatch):
+        """The documented outcome of this suite: the bands above hold,
+        which is what let the execution planner (ISSUE 17) flip the
+        shipped default to 'auto' (batch KL engages dna out of the box).
+        CNMF_TPU_ACCEL=0 remains the byte-identical plain-MU escape
+        hatch (golden/oracle-pinned programs). README's Solver recipes
+        section records the why."""
         monkeypatch.delenv("CNMF_TPU_ACCEL", raising=False)
         rec = resolve_recipe(1.0, "batch")
-        assert rec.is_identity and rec.source == "default"
+        assert rec.label == "dna" and rec.source == "auto"
+        monkeypatch.setenv("CNMF_TPU_ACCEL", "0")
+        rec0 = resolve_recipe(1.0, "batch")
+        assert rec0.is_identity
         readme = open(os.path.join(os.path.dirname(__file__), os.pardir,
                                    "README.md")).read()
         assert "CNMF_TPU_ACCEL" in readme

@@ -191,10 +191,46 @@ def test_accessor_usage_clean_and_unregistered_detected(tmp_path):
     res = _lint_src(tmp_path, """
 from cnmf_torch_tpu.utils.envknobs import env_flag, env_int
 
-ok = env_int("CNMF_TPU_STREAM_DEPTH", 3, lo=1)
+ok = env_int("CNMF_TPU_MAX_RETRIES", 2, lo=0)
 bad = env_flag("CNMF_TPU_NOT_A_KNOB", True)
 """)
     assert _rules(res) == ["knob-unregistered"]
+
+
+def test_plan_bypass_detected_outside_resolvers(tmp_path):
+    # a dispatch-class knob read through the accessors, outside the
+    # planner-owned files and outside a registered resolver: flagged —
+    # both the literal and the module-level *_ENV-constant spellings
+    res = _lint_src(tmp_path, """
+from cnmf_torch_tpu.utils.envknobs import env_int, env_str
+
+PALLAS_ENV = "CNMF_TPU_PALLAS"
+
+depth = env_int("CNMF_TPU_STREAM_DEPTH", 3, lo=1)
+word = env_str(PALLAS_ENV, "auto")
+""")
+    assert _rules(res) == ["knob-plan-bypass"] * 2
+    assert "CNMF_TPU_STREAM_DEPTH" in res.findings[0].message
+
+
+def test_plan_bypass_exempts_registered_resolvers(tmp_path):
+    # the SAME reads inside a PLAN_ACCESSORS-registered resolver
+    # function are the sanctioned resolution sites
+    res = _lint_src(tmp_path, """
+from cnmf_torch_tpu.utils.envknobs import env_int, env_str
+
+def stream_depth():
+    return env_int("CNMF_TPU_STREAM_DEPTH", 3, lo=1)
+
+def resolve_pallas():
+    def inner():
+        return env_str("CNMF_TPU_PALLAS", "auto")
+    return inner()
+
+# non-dispatch knobs never trip the rule anywhere
+retries = env_int("CNMF_TPU_MAX_RETRIES", 2, lo=0)
+""")
+    assert res.findings == []
 
 
 def test_envknobs_module_itself_exempt(tmp_path):

@@ -1,0 +1,286 @@
+"""Execution planner (ISSUE 17): plan build determinism, JSON
+round-trip + replay pinning, override precedence (pin > autotuned >
+heuristic), the identity-plan lowering byte-identity behind the auto
+default flips, autotune-cache invalidation, and the checkpoint-identity
+fragment (a plan change restarts, never splices)."""
+
+import json
+import os
+
+import pytest
+
+from cnmf_torch_tpu.runtime.planner import (
+    DISPATCH_KNOBS,
+    DeviceInventory,
+    ExecutionPlan,
+    InputStats,
+    apply_plan,
+    build_plan,
+    load_plan,
+    maybe_apply_plan_env,
+    render_plan,
+)
+
+INV = DeviceInventory(backend="cpu", device_kind="cpu", n_devices=1,
+                      n_processes=1, cpu_count=4)
+
+# a sparse batch KL sweep: the stats shape where every contested
+# decision (encoding / recipe / kernel) actually has two live outcomes
+SPARSE_KL = InputStats(n=2000, g=800, beta=1.0, mode="batch",
+                      init="random", algo="mu", sparse=True,
+                      density=0.05, ell_width=40, k_max=8, n_ks=2,
+                      max_replicates=3, total_workers=1)
+
+
+@pytest.fixture(autouse=True)
+def _isolated(monkeypatch, tmp_path):
+    """Every test here runs with (a) no dispatch knobs in the
+    environment — apply_plan writes os.environ via pin_knob, so the
+    whole map is snapshotted/restored — and (b) a PRIVATE autotune
+    cache dir, so the machine-level measured cache can't steer plans."""
+    from cnmf_torch_tpu.utils import autotune
+
+    env0 = dict(os.environ)
+    for knob in DISPATCH_KNOBS:
+        monkeypatch.delenv(knob, raising=False)
+    real_cache_path = autotune.cache_path
+    monkeypatch.setattr(
+        autotune, "cache_path",
+        lambda cache_dir=None: real_cache_path(
+            cache_dir or str(tmp_path / "autotune")))
+    yield
+    os.environ.clear()
+    os.environ.update(env0)
+
+
+def _plant_points(points: dict) -> None:
+    from cnmf_torch_tpu.utils import autotune
+
+    autotune._merge_write(autotune.cache_path(), {"plan_points": points})
+
+
+# ---------------------------------------------------------------------------
+# determinism + serialization
+# ---------------------------------------------------------------------------
+
+def test_build_plan_deterministic():
+    a = build_plan(SPARSE_KL, INV)
+    b = build_plan(SPARSE_KL, INV)
+    assert a.to_dict() == b.to_dict()
+    assert a.signature() == b.signature()
+    # the shipped auto defaults on this stats shape: ELL encoding
+    # (density 0.05 <= 0.10), dna recipe (batch KL), no Pallas off-TPU
+    assert a.use_ell and a.recipe_algo == "dna" and not a.use_pallas
+    assert set(a.sources.values()) == {"heuristic"}
+
+
+def test_json_round_trip(tmp_path):
+    plan = build_plan(SPARSE_KL, INV)
+    back = ExecutionPlan.from_json(plan.to_json())
+    assert back.to_dict() == plan.to_dict()
+    assert back.signature() == plan.signature()
+    path = str(tmp_path / "plan.json")
+    plan.save(path)
+    assert load_plan(path).to_dict() == plan.to_dict()
+
+
+def test_from_dict_rejects_unknown_fields_and_versions():
+    plan = build_plan(SPARSE_KL, INV)
+    d = plan.to_dict()
+    with pytest.raises(ValueError, match="unknown plan fields"):
+        ExecutionPlan.from_dict(dict(d, not_a_field=1))
+    with pytest.raises(ValueError, match="plan_version"):
+        ExecutionPlan.from_dict(dict(d, plan_version=99))
+
+
+def test_signature_excludes_provenance_and_measured_density():
+    plan = build_plan(SPARSE_KL, INV)
+    sig = plan.signature()
+    pinned = ExecutionPlan.from_dict(plan.to_dict())
+    pinned.sources = {k: "pin" for k in plan.sources}
+    pinned.density = 0.0123
+    assert pinned.signature() == sig  # same DISPATCH, same signature
+    flipped = ExecutionPlan.from_dict(plan.to_dict())
+    flipped.use_ell = not flipped.use_ell
+    assert flipped.signature() != sig
+
+
+def test_render_plan_covers_every_decision_group():
+    text = "\n".join(render_plan(build_plan(SPARSE_KL, INV).to_dict()))
+    for token in ("encoding:", "recipe:", "kernel:", "program:",
+                  "layout:", "stream:", "ingest:", "[heuristic]"):
+        assert token in text, token
+
+
+# ---------------------------------------------------------------------------
+# replay: apply_plan pins / CNMF_TPU_PLAN / round-trip
+# ---------------------------------------------------------------------------
+
+def test_apply_plan_round_trips_to_the_same_signature():
+    plan = build_plan(SPARSE_KL, INV)
+    pins = apply_plan(plan)
+    assert pins["CNMF_TPU_SPARSE_BETA"] == "1"
+    assert pins["CNMF_TPU_ACCEL"] == "1"  # dna
+    assert pins["CNMF_TPU_AUTOTUNE"] == "0"  # replay never re-measures
+    replay = build_plan(SPARSE_KL, INV)
+    assert replay.signature() == plan.signature()
+    # provenance records the pins; the dispatch itself is unchanged
+    assert replay.sources["encoding"] == "pin"
+    assert replay.sources["recipe"] == "pin"
+
+
+def test_maybe_apply_plan_env(tmp_path):
+    assert maybe_apply_plan_env() is None  # knob unset: no-op
+    plan = build_plan(SPARSE_KL, INV)
+    path = str(tmp_path / "plan.json")
+    plan.save(path)
+    os.environ["CNMF_TPU_PLAN"] = path
+    applied = maybe_apply_plan_env()
+    assert applied.signature() == plan.signature()
+    assert os.environ["CNMF_TPU_SPARSE_BETA"] == "1"
+    # a missing plan file is an ERROR, not a silent different dispatch
+    os.environ["CNMF_TPU_PLAN"] = str(tmp_path / "nope.json")
+    with pytest.raises(OSError):
+        maybe_apply_plan_env()
+
+
+# ---------------------------------------------------------------------------
+# precedence: pin > autotuned > heuristic
+# ---------------------------------------------------------------------------
+
+def test_autotuned_crossover_beats_static_heuristic():
+    stats = InputStats(**dict(SPARSE_KL.__dict__, density=0.15))
+    base = build_plan(stats, INV)
+    assert not base.use_ell  # 0.15 > the static 0.10 crossover
+    assert base.sources["encoding"] == "heuristic"
+    _plant_points({"ell_density_crossover": 0.2})
+    tuned = build_plan(stats, INV)
+    assert tuned.use_ell  # 0.15 <= the measured 0.2 crossover
+    assert tuned.sources["encoding"] == "autotuned"
+    assert tuned.density_threshold == 0.2
+
+
+def test_pin_beats_autotuned_point():
+    stats = InputStats(**dict(SPARSE_KL.__dict__, density=0.15))
+    _plant_points({"ell_density_crossover": 0.2, "stream_threads": 3})
+    tuned = build_plan(stats, INV)
+    assert tuned.use_ell and tuned.stream_threads == 3
+    assert tuned.sources["streaming"] == "autotuned"
+    os.environ["CNMF_TPU_SPARSE_BETA"] = "0"
+    os.environ["CNMF_TPU_STREAM_THREADS"] = "2"
+    pinned = build_plan(stats, INV)
+    assert not pinned.use_ell and pinned.stream_threads == 2
+    assert pinned.sources["encoding"] == "pin"
+    assert pinned.sources["streaming"] == "pin"
+
+
+def test_caller_override_is_a_pin():
+    plan = build_plan(SPARSE_KL, INV, overrides={"packed": True})
+    assert plan.sources["packed"] == "pin"
+    auto = build_plan(SPARSE_KL, INV)
+    assert auto.sources["packed"] == "heuristic"
+
+
+# ---------------------------------------------------------------------------
+# autotune cache invalidation
+# ---------------------------------------------------------------------------
+
+def test_cache_invalidated_by_fingerprint_change(monkeypatch):
+    from cnmf_torch_tpu import version
+    from cnmf_torch_tpu.utils.autotune import cached_plan_points
+
+    _plant_points({"stream_threads": 3})
+    assert cached_plan_points().get("stream_threads") == 3
+    # a package-version bump changes the device fingerprint, which is
+    # part of the cache FILENAME: stale measured points are orphaned
+    monkeypatch.setattr(version, "__version__", "999.0.0")
+    assert cached_plan_points() == {}
+
+
+def test_autotune_off_disables_consumption():
+    from cnmf_torch_tpu.utils.autotune import cached_plan_points
+
+    _plant_points({"stream_threads": 3, "ell_density_crossover": 0.2})
+    os.environ["CNMF_TPU_AUTOTUNE"] = "0"
+    assert cached_plan_points() == {}
+    stats = InputStats(**dict(SPARSE_KL.__dict__, density=0.15))
+    plan = build_plan(stats, INV)
+    assert not plan.use_ell  # static heuristics only
+    assert plan.sources["encoding"] == "heuristic"
+
+
+# ---------------------------------------------------------------------------
+# the default flips: identity-plan lowering byte-identity
+# ---------------------------------------------------------------------------
+
+def test_online_auto_default_lowers_byte_identical_to_zero():
+    """Where the auto lanes do NOT engage (online mode, CPU backend),
+    the flipped defaults must compile the EXACT pre-flip program:
+    unset == ACCEL=0/PALLAS=0, lowering equality."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cnmf_torch_tpu.ops.nmf import nmf_fit_batch, random_init
+    from cnmf_torch_tpu.ops.pallas import resolve_pallas
+    from cnmf_torch_tpu.ops.recipe import resolve_recipe
+
+    rec_auto = resolve_recipe(1.0, "online")
+    os.environ["CNMF_TPU_ACCEL"] = "0"
+    rec_zero = resolve_recipe(1.0, "online")
+    del os.environ["CNMF_TPU_ACCEL"]
+    assert rec_auto.is_identity and rec_zero.is_identity
+    assert not resolve_pallas()  # auto off-TPU == off
+    os.environ["CNMF_TPU_PALLAS"] = "0"
+    assert not resolve_pallas()
+    del os.environ["CNMF_TPU_PALLAS"]
+
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.gamma(1.0, 1.0, (60, 30)).astype(np.float32))
+    H0, W0 = random_init(jax.random.key(0), 60, 30, 3, jnp.mean(X))
+    low_auto = nmf_fit_batch.lower(
+        X, H0, W0, beta=1.0, max_iter=10,
+        inner_repeats=rec_auto.inner_repeats,
+        kl_newton=rec_auto.kl_newton).as_text()
+    low_zero = nmf_fit_batch.lower(
+        X, H0, W0, beta=1.0, max_iter=10,
+        inner_repeats=rec_zero.inner_repeats,
+        kl_newton=rec_zero.kl_newton).as_text()
+    low_bare = nmf_fit_batch.lower(X, H0, W0, beta=1.0,
+                                   max_iter=10).as_text()
+    assert low_auto == low_zero == low_bare
+
+
+# ---------------------------------------------------------------------------
+# checkpoint identity: a plan change restarts, never splices
+# ---------------------------------------------------------------------------
+
+def test_identity_fragment_tracks_math_affecting_fields_only():
+    plan = build_plan(SPARSE_KL, INV)
+    frag = plan.identity_fragment()
+    assert "enc=ell" in frag
+
+    def variant(**kw):
+        v = ExecutionPlan.from_dict(plan.to_dict())
+        for k, val in kw.items():
+            setattr(v, k, val)
+        return v.identity_fragment()
+
+    # recipe / kernel / encoding flips change the fragment => restart
+    assert variant(recipe_algo="mu", kl_newton=False) != frag
+    assert variant(use_pallas=True, kernel="ell-pallas") != frag
+    assert variant(use_ell=False) != frag
+    # layout / streaming replay the same trajectory => same fragment
+    assert variant(stream_threads=7, stream_depth=9) == frag
+    assert variant(layout="grid2d", mesh_devices=8) == frag
+
+
+def test_plan_signature_rides_factorize_provenance_contract():
+    # the solver_recipe the plan rebuilds is the object the sweeps key
+    # on: algo/repeats/newton/sketch fields survive the round trip
+    plan = build_plan(SPARSE_KL, INV)
+    rec = plan.solver_recipe()
+    assert rec.algo == plan.recipe_algo
+    assert rec.inner_repeats == plan.inner_repeats
+    assert rec.kl_newton == plan.kl_newton
+    assert json.loads(plan.to_json())["recipe_label"] == rec.label

@@ -47,6 +47,11 @@ class TestSketchRecipeResolution:
     def test_default_is_off_and_identity(self, monkeypatch):
         monkeypatch.delenv("CNMF_TPU_SKETCH", raising=False)
         rec = resolve_recipe(1.0, "batch")
+        assert rec.algo != "sketch"  # sketch lane off by default
+        # with the accel auto-default hatched off too, the full default
+        # stack resolves the identity plain-MU recipe
+        monkeypatch.setenv("CNMF_TPU_ACCEL", "0")
+        rec = resolve_recipe(1.0, "batch")
         assert rec.algo == "mu" and rec.is_identity
 
     def test_forced_engages_for_kl_everywhere(self, monkeypatch):
@@ -57,13 +62,17 @@ class TestSketchRecipeResolution:
             assert rec.sketch_dim == 10000 // 8
             assert rec.sketch_exact_every == 4
             assert not rec.is_identity
-        # and stays off outside KL (the scheme is beta=1 math)
+        # and stays off outside KL (the scheme is beta=1 math); beta=0
+        # falls through to the accel lane (amu under the auto default)
         assert resolve_recipe(2.0, "batch").algo == "mu"
-        assert resolve_recipe(0.0, "batch").algo == "mu"
+        assert resolve_recipe(0.0, "batch").algo == "amu"
+        assert resolve_recipe(0.0, "batch", accel="0").algo == "mu"
 
     def test_auto_leaves_the_solver_lane_off(self, monkeypatch):
         monkeypatch.setenv("CNMF_TPU_SKETCH", "auto")
-        assert resolve_recipe(1.0, "batch", n=100000).algo == "mu"
+        assert resolve_recipe(1.0, "batch", n=100000).algo != "sketch"
+        assert resolve_recipe(1.0, "batch", n=100000,
+                              accel="0").algo == "mu"
 
     def test_knobs_pin_dim_and_cadence(self, monkeypatch):
         monkeypatch.setenv("CNMF_TPU_SKETCH", "1")
@@ -285,11 +294,13 @@ def test_run_nmf_sketch_recipe_objective_parity_online():
 
 
 def test_sweep_identity_recipe_hits_same_program_cache(monkeypatch):
-    """CNMF_TPU_SKETCH unset resolves the identity recipe, whose sweep
-    program cache entry is the EXACT pre-sketch-layer entry."""
+    """CNMF_TPU_SKETCH unset (plus the accel =0 escape hatch) resolves
+    the identity recipe, whose sweep program cache entry is the EXACT
+    pre-sketch-layer entry."""
     from cnmf_torch_tpu.parallel.replicates import _recipe_statics
 
     monkeypatch.delenv("CNMF_TPU_SKETCH", raising=False)
+    monkeypatch.setenv("CNMF_TPU_ACCEL", "0")
     rec = resolve_recipe(1.0, "batch")
     assert _recipe_statics(rec) == {}
     sk = SolverRecipe("sketch", sketch_dim=64, sketch_exact_every=4)
