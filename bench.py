@@ -1598,6 +1598,12 @@ def bench_serve():
     from cnmf_torch_tpu.utils.telemetry import read_events
 
     os.environ.setdefault("CNMF_TPU_TELEMETRY", "1")
+    # the observability plane rides the measured load (ISSUE 18): the
+    # reported QPS/latency INCLUDE live metrics publication, and the
+    # scraped /metrics histogram is attached to the result so the two
+    # latency surfaces (client-side stopwatch, daemon-side histogram)
+    # can be compared in one output
+    os.environ.setdefault("CNMF_TPU_METRICS", "1")
     n, g, k = 400, 200, 5
     workdir = tempfile.mkdtemp(prefix="bench_serve_")
     try:
@@ -1679,6 +1685,24 @@ def bench_serve():
                   beta=ref.beta)
         solo_ms = (time.perf_counter() - t1) / 10 * 1e3
 
+        # scrape the live registry through the daemon's own endpoint
+        # before shutdown — the exposition must parse back, and its
+        # request histogram is the attached serve-side latency surface
+        from cnmf_torch_tpu.obs.metrics import parse_exposition
+
+        scraped = parse_exposition(
+            ServeClient(socket_path=sock, timeout=60.0).metrics())
+        hist = {
+            "buckets": {labels[0][1]: int(v)
+                        for (name, labels), v in
+                        scraped["samples"].items()
+                        if name == "cnmf_serve_request_ms_bucket"},
+            "count": scraped["samples"].get(
+                ("cnmf_serve_request_ms_count", ()), 0),
+            "sum_ms": round(scraped["samples"].get(
+                ("cnmf_serve_request_ms_sum", ()), 0.0), 3),
+        }
+
         daemon.close()
         ev_path = os.path.join(run_dir, "cnmf_tmp",
                                "srv.serve.events.jsonl")
@@ -1707,6 +1731,10 @@ def bench_serve():
             "batched_fraction": stats["batched_fraction"],
             "multi_request_batches_telemetry": multi,
             "warm_started_requests": stats["warm_started"],
+            "scraped_request_ms_histogram": hist,
+            "latency_samples_kept": stats.get("latency_samples_kept"),
+            "latency_samples_dropped":
+                stats.get("latency_samples_dropped"),
             "telemetry": _tier_telemetry(),
         }
         # the acceptance gates, surfaced as booleans the driver can read

@@ -39,13 +39,14 @@ def build_parser() -> argparse.ArgumentParser:
         "command", type=str,
         choices=["prepare", "factorize", "combine", "consensus",
                  "k_selection_plot", "run_parallel", "report", "lint",
-                 "serve", "plan"])
+                 "serve", "plan", "trace"])
     parser.add_argument(
         "run_dir", type=str, nargs="?", default=None,
-        help="[report|serve|plan] Run directory ([output-dir]/[name]) "
-             "whose telemetry to render / whose consensus reference to "
-             "serve / whose resolved execution plan to show; defaults to "
-             "--output-dir/--name")
+        help="[report|serve|plan|trace] Run directory "
+             "([output-dir]/[name]) whose telemetry to render / whose "
+             "consensus reference to serve / whose resolved execution "
+             "plan to show / whose sampled trace waterfalls to render; "
+             "defaults to --output-dir/--name")
     parser.add_argument("--name", type=str, nargs="?", default="cNMF",
                         help="[all] Name for analysis. All output will be "
                              "placed in [output-dir]/[name]/...")
@@ -250,15 +251,15 @@ def main(argv=None):
                      "[paths ...] [--format text|json] [--baseline FILE] "
                      "[--write-baseline] [--knob-table]")
 
-    if args.command not in ("report", "serve", "plan") \
+    if args.command not in ("report", "serve", "plan", "trace") \
             and args.run_dir is not None:
-        # the optional positional exists for `report`/`serve`/`plan`
-        # only; for every other subcommand a stray positional (e.g.
-        # `consensus 9` meaning `-k 9`) must fail fast, not be silently
-        # swallowed
+        # the optional positional exists for `report`/`serve`/`plan`/
+        # `trace` only; for every other subcommand a stray positional
+        # (e.g. `consensus 9` meaning `-k 9`) must fail fast, not be
+        # silently swallowed
         parser.error(f"unrecognized argument: {args.run_dir!r} "
                      f"(a positional run directory applies to 'report', "
-                     f"'serve', and 'plan' only)")
+                     f"'serve', 'plan', and 'trace' only)")
 
     if args.command == "plan":
         # like `report`: pure host-side rendering of the run's recorded
@@ -293,6 +294,18 @@ def main(argv=None):
         if not os.path.isfile(args.plan):
             parser.error(f"factorize: plan file not found: {args.plan}")
         os.environ[PLAN_ENV] = args.plan
+
+    if args.command == "trace":
+        # like `report`: pure host-side rendering of the run's recorded
+        # `span` events (obs/tracing.py) — per-request/per-run waterfalls
+        # of queue wait vs batch linger vs device dispatch vs store I/O
+        from .obs.tracing import render_run_traces
+
+        run_dir = args.run_dir or os.path.join(args.output_dir, args.name)
+        if not os.path.isdir(run_dir):
+            parser.error(f"trace: run directory not found: {run_dir}")
+        print(render_run_traces(run_dir))
+        return
 
     if args.command == "report":
         # pure host-side rendering of a run's telemetry (events JSONL from

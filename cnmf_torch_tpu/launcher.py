@@ -460,6 +460,18 @@ def run_pipeline(counts: str, output_dir: str, name: str,
     if env_extra:
         base_env.update({k: str(v) for k, v in env_extra.items()})
 
+    # distributed tracing (obs/tracing.py): when sampling is on, the
+    # launcher owns the run's root trace and plants it in the worker
+    # environment — every worker's process_context() then parents its
+    # spans on this one and `cnmf-tpu trace` renders parent -> workers
+    # as one waterfall. None (the common case) costs nothing.
+    from .obs import tracing as obs_tracing
+
+    run_trace = obs_tracing.new_trace()
+    if run_trace is not None:
+        base_env[obs_tracing.TRACE_CTX_ENV] = obs_tracing.env_value(
+            run_trace)
+
     any_failed = False
     if engine == "subprocess":
         # launcher-side telemetry: work-stealing adoptions and straggler
@@ -470,9 +482,14 @@ def run_pipeline(counts: str, output_dir: str, name: str,
 
         events = EventLog(os.path.join(
             output_dir, name, "cnmf_tmp", f"{name}.events.jsonl"))
-        failed, unhealthy = _run_subprocess_workers(
-            output_dir, name, total_workers, factorize_flags, base_env,
-            events=events)
+        # the root span covers the whole worker phase; worker-side spans
+        # (factorize.worker etc.) land in the same events file and parent
+        # on run_trace's span id via CNMF_TPU_TRACE_CTX
+        with obs_tracing.span(events, run_trace, "launcher.run",
+                              workers=total_workers):
+            failed, unhealthy = _run_subprocess_workers(
+                output_dir, name, total_workers, factorize_flags, base_env,
+                events=events)
         if unhealthy:
             # the min-healthy-frac floor is a hard guarantee end-to-end:
             # degrading around it with skip-missing combine would produce

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import datetime
 import os
+import time
 import uuid
 import warnings
 
@@ -688,6 +689,41 @@ class cNMF:
         axes ``('cells', 'genes')`` passed as ``mesh`` routes to the
         grid too.
         """
+        # observability shell (obs/): the implementation below has many
+        # early returns (2-D mesh, grid, rowshard, resume-noop), so the
+        # worker-level trace span + the end-of-factorize metrics
+        # snapshot live in this wrapper's finally. Both are no-ops
+        # unless their knobs are set; neither touches compiled programs.
+        from ..obs import metrics as obs_metrics
+        from ..obs import tracing as obs_tracing
+
+        obs_metrics.counter_inc("cnmf_factorize_workers_total")
+        # launcher-planted ambient context when present; a direct class-driven
+        # run mints its own root so sampled runs always trace
+        ctx = obs_tracing.child(obs_tracing.process_context())
+        if ctx is None:
+            ctx = obs_tracing.new_trace()
+        t0 = time.perf_counter()
+        try:
+            return self._factorize_impl(
+                worker_i=worker_i, total_workers=total_workers,
+                skip_completed_runs=skip_completed_runs, batched=batched,
+                mesh=mesh, replicates_per_batch=replicates_per_batch,
+                rowshard=rowshard, rowshard_threshold=rowshard_threshold,
+                packed=packed, mesh_shape=mesh_shape)
+        finally:
+            obs_tracing.emit_span(
+                self._events, ctx, "factorize.worker",
+                obs_tracing.perf_to_wall(t0),
+                (time.perf_counter() - t0) * 1e3,
+                worker=int(worker_i))
+            obs_metrics.emit_snapshot(self._events)
+
+    def _factorize_impl(self, worker_i=0, total_workers=1,
+                        skip_completed_runs=False, batched=True, mesh=None,
+                        replicates_per_batch=None, rowshard=None,
+                        rowshard_threshold: int | None = None, packed=None,
+                        mesh_shape=None):
         from ..runtime import faults, resilience
 
         # declarative plan replay (ISSUE 17, runtime/planner.py):

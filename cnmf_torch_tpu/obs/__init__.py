@@ -1,0 +1,26 @@
+"""Live observability plane (ISSUE 18).
+
+Three surfaces over one event layer:
+
+* :mod:`~cnmf_torch_tpu.obs.metrics` — a process-local metrics registry
+  (counters / gauges / fixed-log-bucket histograms) with a text
+  exposition format served from ``GET /metrics`` on the serve daemon and
+  the object-store server, plus periodic ``metrics_snapshot`` telemetry
+  events so batch runs leave a scrape-equivalent trail in the JSONL.
+* :mod:`~cnmf_torch_tpu.obs.tracing` — sampled distributed traces:
+  a trace/span context propagated client -> daemon via the
+  ``X-CNMF-Trace`` header and launcher parent -> worker via env, each
+  span landing as a schema-valid ``span`` event; ``cnmf-tpu trace``
+  renders per-request waterfalls.
+* :mod:`~cnmf_torch_tpu.obs.slo` — a sliding-window SLO tracker
+  (target p99 + error budget) evaluated inside the daemon and surfaced
+  in ``/metrics``, ``/healthz``, and the report's SLO section.
+
+Everything here is host-side and off by default: with the knobs unset
+no instrument records, no span emits, and compiled programs are
+byte-identical to a build without this package (pinned by test).
+"""
+
+from . import metrics, slo, tracing  # noqa: F401
+
+__all__ = ["metrics", "tracing", "slo"]

@@ -26,6 +26,7 @@ import numpy as np
 import scipy.sparse as sp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs import metrics as obs_metrics
 from ..utils.jax_compat import shard_map
 
 from .streaming import (
@@ -688,6 +689,7 @@ def _fit_rowsharded_checkpointed(Xd, H0, W0, mesh, axis, beta, tol, h_tol,
         # write for this pass landed: an injected (or real) loss here
         # leaves exactly the on-disk state a preempted host leaves, and
         # the resumed continuation picks up from this pass's cursor
+        obs_metrics.counter_inc("cnmf_rowshard_passes_total")
         if heartbeat is not None:
             heartbeat.beat(phase="pass", cursor=it)
         maybe_hostloss(context="pass")
@@ -1099,6 +1101,7 @@ def _fit_rowsharded_ooc(store, k, mesh, axis, beta, seed, tol, h_tol,
                   H=_gather_h())
 
     def _pass_boundary():
+        obs_metrics.counter_inc("cnmf_rowshard_passes_total")
         if heartbeat is not None:
             heartbeat.beat(phase="ooc_pass", cursor=it)
         maybe_hostloss(context="pass")

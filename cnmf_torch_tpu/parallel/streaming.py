@@ -85,6 +85,7 @@ import jax.numpy as jnp
 import numpy as np
 import scipy.sparse as sp
 
+from ..obs import metrics as obs_metrics
 from ..runtime.faults import maybe_fail as _maybe_fail_fault
 
 __all__ = ["StreamStats", "SlabBufferPool", "run_pipeline", "nnz_bucket",
@@ -235,6 +236,13 @@ class StreamStats:
             self.slabs += slabs
             self.disk_s += disk_s
             self.disk_nbytes += disk_nbytes
+        # live-scrape mirror (obs/metrics.py, CNMF_TPU_METRICS): the
+        # same slab/byte totals the stream_summary table reports per
+        # pass, visible mid-pass on /metrics instead of post-hoc
+        if slabs:
+            obs_metrics.counter_inc("cnmf_stream_slabs_total", slabs)
+        if nbytes:
+            obs_metrics.counter_inc("cnmf_stream_bytes_total", nbytes)
 
     def fold_store_counters(self, before, after):
         """Fold a remote backend's counter delta (snapshots from

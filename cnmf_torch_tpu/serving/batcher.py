@@ -43,6 +43,10 @@ import time
 
 import numpy as np
 
+from ..obs import metrics as obs_metrics
+from ..obs import slo as obs_slo
+from ..obs import tracing as obs_tracing
+from ..runtime import faults
 from ..utils.envknobs import env_flag, env_float, env_int, env_str
 
 __all__ = [
@@ -210,10 +214,11 @@ _req_ids = itertools.count(1)
 
 class _Request:
     __slots__ = ("rid", "tenant", "X", "n", "h_init", "warm",
-                 "t_enqueue", "event", "_rlock", "result", "error",
-                 "meta")
+                 "t_enqueue", "t_dequeue", "event", "_rlock", "result",
+                 "error", "meta", "trace")
 
-    def __init__(self, tenant: str, X: np.ndarray, h_init, warm: bool):
+    def __init__(self, tenant: str, X: np.ndarray, h_init, warm: bool,
+                 trace=None):
         self.rid = next(_req_ids)
         self.tenant = tenant
         self.X = X
@@ -221,11 +226,17 @@ class _Request:
         self.h_init = h_init
         self.warm = warm
         self.t_enqueue = time.perf_counter()
+        # stamped by the dispatcher when it pulls the request off the
+        # queue: splits queue wait (enqueue->dequeue) from batch linger
+        # (dequeue->launch) on the trace waterfall
+        self.t_dequeue = None
         self.event = threading.Event()
         self._rlock = threading.Lock()
         self.result = None
         self.error = None
         self.meta: dict = {}
+        # sampled trace context (obs/tracing.py) or None
+        self.trace = trace
 
     def reply(self, result=None, error=None, **meta):
         # first reply wins: the dispatcher and the shutdown drain can
@@ -311,6 +322,13 @@ class ProjectionService:
             "cold_dispatches_after_warmup": 0,
         }
         self._latencies: list = []
+        # latency-reservoir honesty (ISSUE 18): the reservoir halves
+        # itself when full; count what it dropped so /stats and /metrics
+        # state what the p99 was computed over instead of implying
+        # all-time coverage
+        self._lat_dropped = 0
+        # sliding-window SLO tracker, armed by CNMF_TPU_SLO_P99_MS
+        self._slo = obs_slo.tracker_from_env()
 
     # -- lifecycle -----------------------------------------------------
 
@@ -419,10 +437,12 @@ class ProjectionService:
 
     # -- admission -----------------------------------------------------
 
-    def submit(self, X, tenant: str = "default") -> _Request:
+    def submit(self, X, tenant: str = "default", trace=None) -> _Request:
         """Validate + enqueue one projection request; returns the pending
         handle (``.wait()`` for the result). Raises ``ServeError``
-        subclasses on admission failure."""
+        subclasses on admission failure. ``trace`` is an optional
+        sampled trace context; the dispatcher emits queue/linger/solve
+        spans under it."""
         tenant = str(tenant)
         if not self._running:
             raise ShedError("daemon not running")
@@ -459,15 +479,17 @@ class ProjectionService:
                 f"blocks and project them separately (results are "
                 f"row-independent)"))
         h_init, warm = self._warm_init_for(tenant, X)
-        req = _Request(tenant, X, h_init, warm)
+        req = _Request(tenant, X, h_init, warm, trace=trace)
         try:
             self._q.put_nowait(req)
         except queue.Full:
             self._count("shed")
+            self._slo_record(0.0, ok=False)
             self._emit_request(tenant, X.shape[0], "shed")
             raise ShedError(
                 f"admission queue full ({self._q.maxsize} requests in "
                 f"flight); retry with backoff")
+        obs_metrics.gauge_set("cnmf_serve_queue_depth", self._q.qsize())
         if not self._running:
             # close() raced us: the dispatcher may already have drained
             # the queue, so nobody would ever reply — shed immediately.
@@ -486,9 +508,9 @@ class ProjectionService:
         return error
 
     def project(self, X, tenant: str = "default", timeout: float | None
-                = None) -> tuple[np.ndarray, dict]:
+                = None, trace=None) -> tuple[np.ndarray, dict]:
         """Blocking projection: returns ``(usage (n, k), meta)``."""
-        req = self.submit(X, tenant=tenant)
+        req = self.submit(X, tenant=tenant, trace=trace)
         wait = timeout
         if wait is None:
             wait = (self.timeout_s + 120.0) if self.timeout_s else None
@@ -505,6 +527,8 @@ class ProjectionService:
                 req = self._q.get()
             if req is _SENTINEL:
                 break
+            if req.t_dequeue is None:
+                req.t_dequeue = time.perf_counter()
             if self._expired(req):
                 continue
             batch = [req]
@@ -521,6 +545,7 @@ class ProjectionService:
                 if nxt is _SENTINEL:
                     carry = _SENTINEL
                     break
+                nxt.t_dequeue = time.perf_counter()
                 if self._expired(nxt):
                     continue
                 n_lanes = lane_count(nxt.n, self.reference.chunk_size)
@@ -546,6 +571,7 @@ class ProjectionService:
         if waited <= self.timeout_s:
             return False
         self._count("shed")
+        self._slo_record(waited * 1e3, ok=False)
         self._emit_request(req.tenant, req.n, "shed",
                            wait_ms=round(waited * 1e3, 3))
         req.reply(error=ShedError(
@@ -602,6 +628,9 @@ class ProjectionService:
 
     def _dispatch(self, batch: list):
         t0 = time.perf_counter()
+        # injectable consistent slowness at the dispatch seam — the
+        # deterministic load the obs smoke uses to flip the SLO verdict
+        faults.maybe_straggle(context="serve:dispatch")
         ref = self.reference
         chunk_size = ref.chunk_size
         g, k = ref.n_genes, ref.k
@@ -676,6 +705,10 @@ class ProjectionService:
                 bucket=[int(b_pad), int(n_pad)],
                 solve_ms=round(solve_ms, 3), cache_hit=cache_hit,
                 queue_depth=self._q.qsize())
+        obs_metrics.counter_inc("cnmf_serve_batches_total")
+        obs_metrics.counter_inc("cnmf_serve_lanes_total", len(lanes))
+        obs_metrics.observe("cnmf_serve_solve_ms", solve_ms)
+        obs_metrics.gauge_set("cnmf_serve_queue_depth", self._q.qsize())
         if self.liveness is not None:
             try:
                 self.liveness(phase="serve", cursor=self._stats["batches"])
@@ -692,6 +725,7 @@ class ProjectionService:
         for req in batch:
             healthy, rows = by_req[req.rid]
             wait_ms = round((t_solve - req.t_enqueue) * 1e3, 3)
+            self._emit_req_spans(req, t0, t_solve, solve_ms)
             if healthy:
                 H = np.concatenate(rows, axis=0)
                 if self.warm_start:
@@ -704,7 +738,11 @@ class ProjectionService:
                 with self._lock:
                     self._latencies.append(total)
                     if len(self._latencies) > _LATENCY_SAMPLES:
-                        del self._latencies[:len(self._latencies) // 2]
+                        dropped = len(self._latencies) // 2
+                        del self._latencies[:dropped]
+                        self._lat_dropped += dropped
+                self._slo_record(total, ok=True)
+                obs_metrics.observe("cnmf_serve_request_ms", total)
                 self._emit_request(
                     req.tenant, req.n, "ok", wait_ms=wait_ms,
                     solve_ms=round(solve_ms, 3), total_ms=total,
@@ -716,6 +754,9 @@ class ProjectionService:
             else:
                 strikes = self._strike(req.tenant)
                 self._count("poison")
+                self._slo_record(
+                    (time.perf_counter() - req.t_enqueue) * 1e3,
+                    ok=False)
                 self._emit_request(
                     req.tenant, req.n, "poison", wait_ms=wait_ms,
                     solve_ms=round(solve_ms, 3),
@@ -732,6 +773,73 @@ class ProjectionService:
                     f"projection graded unhealthy (nonfinite input or "
                     f"usage); strike {strikes}/"
                     f"{POISON_QUARANTINE_STRIKES}"))
+
+    def _emit_req_spans(self, req, t0: float, t_solve: float,
+                        solve_ms: float):
+        """The request's hop spans on the batcher side: queue wait
+        (enqueue -> dequeue), batch linger (dequeue -> launch), device
+        dispatch (launch -> fetched). Nothing happens for untraced
+        requests."""
+        if req.trace is None or self.events is None:
+            return
+        dq = req.t_dequeue if req.t_dequeue is not None else t0
+        obs_tracing.emit_span(
+            self.events, obs_tracing.child(req.trace), "serve.queue",
+            start_ts=obs_tracing.perf_to_wall(req.t_enqueue),
+            wall_ms=(dq - req.t_enqueue) * 1e3, tenant=req.tenant)
+        obs_tracing.emit_span(
+            self.events, obs_tracing.child(req.trace), "serve.linger",
+            start_ts=obs_tracing.perf_to_wall(dq),
+            wall_ms=(t_solve - dq) * 1e3)
+        obs_tracing.emit_span(
+            self.events, obs_tracing.child(req.trace), "serve.solve",
+            start_ts=obs_tracing.perf_to_wall(t_solve),
+            wall_ms=solve_ms)
+
+    def _slo_record(self, latency_ms: float, ok: bool):
+        if self._slo is not None:
+            self._slo.record(latency_ms, ok=ok)
+
+    def slo_status(self, refresh_metrics: bool = False):
+        """The windowed SLO verdict, or ``None`` when the tracker is not
+        armed. With ``refresh_metrics`` the verdict is also published as
+        gauges so a /metrics scrape carries it."""
+        if self._slo is None:
+            return None
+        verdict = self._slo.evaluate()
+        if refresh_metrics:
+            obs_metrics.gauge_set("cnmf_slo_target_p99_ms",
+                                  verdict["target_p99_ms"])
+            obs_metrics.gauge_set("cnmf_slo_window_requests",
+                                  verdict["requests"])
+            obs_metrics.gauge_set("cnmf_slo_window_errors",
+                                  verdict["errors"])
+            if verdict.get("p99_ms") is not None:
+                obs_metrics.gauge_set("cnmf_slo_p99_ms",
+                                      verdict["p99_ms"])
+            obs_metrics.gauge_set("cnmf_slo_burning",
+                                  1.0 if verdict["burning"] else 0.0)
+        return verdict
+
+    def metrics_text(self) -> str:
+        """The daemon's /metrics body: refresh the point-in-time gauges
+        (queue depth, reservoir honesty, SLO verdict) and render the
+        process registry."""
+        if obs_metrics.metrics_enabled():
+            obs_metrics.gauge_set("cnmf_serve_queue_depth",
+                                  self._q.qsize())
+            with self._lock:
+                kept = len(self._latencies)
+                dropped = self._lat_dropped
+            obs_metrics.gauge_set("cnmf_serve_latency_samples_kept", kept)
+            obs_metrics.gauge_set("cnmf_serve_latency_samples_dropped",
+                                  dropped)
+            obs_metrics.gauge_set(
+                "cnmf_serve_latency_window_coverage",
+                round(kept / (kept + dropped), 6) if kept + dropped
+                else 1.0)
+            self.slo_status(refresh_metrics=True)
+        return obs_metrics.render_text()
 
     def _store_warm(self, tenant: str, token: tuple, H: np.ndarray):
         with self._lock:
@@ -752,10 +860,14 @@ class ProjectionService:
     # -- accounting ----------------------------------------------------
 
     def _count(self, key: str):
+        is_status = key in ("ok", "shed", "poison", "quarantined",
+                            "error")
         with self._lock:
-            self._stats["requests"] += key in (
-                "ok", "shed", "poison", "quarantined", "error")
+            self._stats["requests"] += is_status
             self._stats[key] = self._stats.get(key, 0) + 1
+        if is_status:
+            obs_metrics.counter_inc("cnmf_serve_requests_total",
+                                    status=key)
 
     def _emit_request(self, tenant: str, n_cells, status: str, **fields):
         if self.events is not None:
@@ -769,6 +881,7 @@ class ProjectionService:
         with self._lock:
             out = dict(self._stats)
             lat = list(self._latencies)
+            lat_dropped = self._lat_dropped
             out["quarantined_tenants"] = sorted(self._quarantined)
             out["programs_warmed"] = sum(
                 1 for v in self._programs.values() if v)
@@ -778,6 +891,17 @@ class ProjectionService:
         out["mean_lanes"] = (round(out["lanes_total"] / out["batches"], 2)
                              if out["batches"] else 0.0)
         out["latency_ms"] = latency_summary(lat)
+        # reservoir honesty: state what the latency summary was computed
+        # over — kept sample count, samples the halving dropped, and the
+        # fraction of all recorded latencies still in the window
+        out["latency_samples_kept"] = len(lat)
+        out["latency_samples_dropped"] = lat_dropped
+        out["latency_window_coverage"] = (
+            round(len(lat) / (len(lat) + lat_dropped), 6)
+            if len(lat) + lat_dropped else 1.0)
+        slo = self.slo_status()
+        if slo is not None:
+            out["slo"] = slo
         out["reference"] = self.reference.describe()
         out["buckets"] = list(self.buckets)
         out["lane_buckets"] = list(self.b_buckets)

@@ -17,11 +17,21 @@ Protocol (all bodies JSON):
     encoding). Errors carry ``{"ok": false, "status", "error"}`` with
     HTTP 429 (shed), 422 (poison), 403 (quarantined), 400 (bad
     request).
-  * ``GET /healthz`` — liveness + resident-reference summary.
+  * ``GET /healthz`` — liveness + resident-reference summary; when the
+    SLO tracker is armed (``CNMF_TPU_SLO_P99_MS``) the reply carries the
+    windowed verdict and ``"degraded": true`` while the SLO burns.
   * ``GET /reference`` — full reference description incl. gene order.
   * ``GET /stats`` — serving counters + latency summary
     (``utils/profiling.latency_summary``).
+  * ``GET /metrics`` — text exposition of the live metrics registry
+    (``obs/metrics.py``; a "disabled" banner unless
+    ``CNMF_TPU_METRICS=1``).
   * ``POST /shutdown`` — clean stop (the socket file is removed).
+
+Tracing: a sampled client sends ``X-CNMF-Trace: <trace>:<span>`` and
+the daemon threads a child context through admission -> batcher queue ->
+linger -> AOT dispatch, each hop landing as a ``span`` event in the
+daemon's telemetry stream (``obs/tracing.py``).
 """
 
 from __future__ import annotations
@@ -37,6 +47,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from ..obs import metrics as obs_metrics
+from ..obs import tracing as obs_tracing
 from .batcher import (PoisonError, ProjectionService, QuarantinedError,
                       ServeError, ShedError)
 
@@ -99,11 +111,23 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _reply_text(self, code: int, text: str):
+        body = text.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "text/plain; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):
         if self.path == "/healthz":
-            self._reply(200, {
-                "ok": True,
-                "reference": self.service.reference.describe()})
+            reply = {"ok": True,
+                     "reference": self.service.reference.describe()}
+            slo = self.service.slo_status()
+            if slo is not None:
+                reply["slo"] = slo
+                reply["degraded"] = bool(slo.get("burning"))
+            self._reply(200, reply)
         elif self.path == "/reference":
             ref = self.service.reference
             self._reply(200, dict(
@@ -111,6 +135,8 @@ class _Handler(BaseHTTPRequestHandler):
                 components=[str(c) for c in ref.components]))
         elif self.path == "/stats":
             self._reply(200, {"ok": True, "stats": self.service.stats()})
+        elif self.path == "/metrics":
+            self._reply_text(200, self.service.metrics_text())
         else:
             self._reply(404, {"ok": False, "error": f"no route "
                               f"{self.path!r}"})
@@ -134,16 +160,25 @@ class _Handler(BaseHTTPRequestHandler):
                               "error": str(exc)})
             return
         tenant = str(payload.get("tenant", "default"))
-        try:
-            H, meta = self.service.project(X, tenant=tenant)
-        except (ShedError, PoisonError, QuarantinedError,
-                ServeError) as exc:
-            self._reply(_STATUS_HTTP.get(exc.status, 400),
-                        {"ok": False, "status": exc.status,
-                         "error": str(exc)})
-            return
-        self._reply(200, dict({"ok": True, "meta": meta},
-                              **_encode_matrix(H, payload)))
+        # sampled distributed tracing: the client's context arrives in
+        # the X-CNMF-Trace header; everything the daemon does for this
+        # request nests under one serve.http child span
+        ctx = obs_tracing.from_header(
+            self.headers.get(obs_tracing.TRACE_HEADER))
+        hctx = obs_tracing.child(ctx)
+        with obs_tracing.span(self.service.events, hctx, "serve.http",
+                              tenant=tenant, n_cells=int(X.shape[0])):
+            try:
+                H, meta = self.service.project(X, tenant=tenant,
+                                               trace=hctx)
+            except (ShedError, PoisonError, QuarantinedError,
+                    ServeError) as exc:
+                self._reply(_STATUS_HTTP.get(exc.status, 400),
+                            {"ok": False, "status": exc.status,
+                             "error": str(exc)})
+                return
+            self._reply(200, dict({"ok": True, "meta": meta},
+                                  **_encode_matrix(H, payload)))
 
 
 class _UnixHTTPServer(ThreadingHTTPServer):
@@ -250,14 +285,19 @@ class ServeClient:
 
     def __init__(self, socket_path: str | None = None,
                  host: str = "127.0.0.1", port: int | None = None,
-                 timeout: float = 180.0):
+                 timeout: float = 180.0, events=None):
         if socket_path is None and port is None:
             raise ValueError("need socket_path or port")
         self.socket_path = socket_path
         self.host, self.port = host, port
         self.timeout = timeout
+        # optional EventLog: a traced client with one writes its own
+        # client.request root span next to the daemon's spans (the
+        # O_APPEND event log interleaves multi-process writers safely)
+        self.events = events
 
-    def _request(self, method: str, path: str, payload: dict | None = None):
+    def _request(self, method: str, path: str, payload: dict | None = None,
+                 headers: dict | None = None, raw: bool = False):
         if self.socket_path:
             conn = _UnixHTTPConnection(self.socket_path,
                                        timeout=self.timeout)
@@ -267,11 +307,15 @@ class ServeClient:
         try:
             body = (json.dumps(payload).encode("utf-8")
                     if payload is not None else None)
-            headers = {"Content-Type": "application/json"} if body else {}
-            conn.request(method, path, body=body, headers=headers)
+            hdrs = dict(headers or {})
+            if body:
+                hdrs["Content-Type"] = "application/json"
+            conn.request(method, path, body=body, headers=hdrs)
             resp = conn.getresponse()
-            data = json.loads(resp.read() or b"{}")
-            return resp.status, data
+            blob = resp.read()
+            if raw:
+                return resp.status, blob.decode("utf-8", "replace")
+            return resp.status, json.loads(blob or b"{}")
         finally:
             conn.close()
 
@@ -279,7 +323,10 @@ class ServeClient:
                 encoding: str = "b64"):
         """Project ``X`` (n x genes) onto the resident reference;
         returns ``(usage (n, k) np.ndarray, meta dict)``. Raises the
-        matching :class:`ServeError` subclass on a daemon-side error."""
+        matching :class:`ServeError` subclass on a daemon-side error.
+        With ``CNMF_TPU_TRACE_SAMPLE`` > 0 a sampled call carries an
+        ``X-CNMF-Trace`` header so the daemon's spans stitch to this
+        client's trace."""
         X = np.ascontiguousarray(np.asarray(X), dtype=np.float32)
         payload: dict = {"tenant": tenant}
         if encoding == "b64":
@@ -287,7 +334,13 @@ class ServeClient:
             payload["b64"] = base64.b64encode(X.tobytes()).decode("ascii")
         else:
             payload["data"] = X.tolist()
-        status, data = self._request("POST", "/project", payload)
+        ctx = obs_tracing.new_trace()
+        headers = ({obs_tracing.TRACE_HEADER: obs_tracing.header_value(ctx)}
+                   if ctx is not None else None)
+        with obs_tracing.span(self.events, ctx, "client.request",
+                              tenant=tenant):
+            status, data = self._request("POST", "/project", payload,
+                                         headers=headers)
         if status != 200 or not data.get("ok"):
             err = {"shed": ShedError, "poison": PoisonError,
                    "quarantined": QuarantinedError}.get(
@@ -317,6 +370,13 @@ class ServeClient:
         if status != 200:
             raise ServeError(f"stats: HTTP {status}: {data}")
         return data["stats"]
+
+    def metrics(self) -> str:
+        """The daemon's ``GET /metrics`` text exposition, verbatim."""
+        status, text = self._request("GET", "/metrics", raw=True)
+        if status != 200:
+            raise ServeError(f"metrics: HTTP {status}: {text}")
+        return text
 
     def shutdown(self):
         status, data = self._request("POST", "/shutdown")
@@ -354,6 +414,15 @@ def serve_forever(run_dir: str, k: int | None = None,
         socket_path = default_socket_path(run_dir)
     daemon = ServeDaemon(service, socket_path=socket_path, port=port)
 
+    # live metrics -> telemetry bridge: periodic metrics_snapshot events
+    # (plus one at shutdown) carrying registry state and the SLO verdict
+    snapshotter = None
+    if obs_metrics.metrics_enabled() and events.enabled:
+        snapshotter = obs_metrics.Snapshotter(
+            events, interval_s=30.0,
+            slo_fn=lambda: service.slo_status(refresh_metrics=True))
+        snapshotter.start()
+
     def _stop(signum, frame):
         threading.Thread(target=daemon.server.shutdown,
                          daemon=True).start()
@@ -374,6 +443,8 @@ def serve_forever(run_dir: str, k: int | None = None,
               f"{service.linger_s * 1e3:g} ms)")
         daemon.serve_forever()
     finally:
+        if snapshotter is not None:
+            snapshotter.stop()
         daemon.close()
         for sig, handler in prev.items():
             try:

@@ -317,6 +317,38 @@ REGISTRY: dict[str, Knob] = _knobs(
          "and no file I/O"),
     Knob("CNMF_TPU_PROFILE_DIR", "str", "unset",
          "per-stage `jax.profiler` traces into this directory"),
+    Knob("CNMF_TPU_METRICS", "flag", "`0`",
+         "`1` enables the live metrics plane (`obs/metrics.py`): the "
+         "process-local counter/gauge/histogram registry records, "
+         "`GET /metrics` on the serve daemon and the object-store "
+         "server exposes it as text, and `metrics_snapshot` telemetry "
+         "events carry the registry state into the run JSONL. Off = "
+         "every publication site is a no-op and compiled programs are "
+         "byte-identical"),
+    Knob("CNMF_TPU_TRACE_SAMPLE", "float", "`0`",
+         "distributed-trace sampling probability in [0, 1] "
+         "(`obs/tracing.py`): sampled requests carry an `X-CNMF-Trace` "
+         "header client->daemon (and `CNMF_TPU_TRACE_CTX` launcher "
+         "parent->worker), each hop landing as a `span` telemetry "
+         "event; `cnmf-tpu trace <run_dir>` renders the waterfalls. "
+         "The keep/drop decision is deterministic in the trace id, so "
+         "every process agrees; `0` (default) disables tracing"),
+    Knob("CNMF_TPU_TRACE_CTX", "str", "unset",
+         "serialized `trace_id:span_id` context a launcher parent "
+         "plants in worker env so batch-run spans stitch into one "
+         "trace — set by the launcher when sampling engages, not "
+         "normally set by hand"),
+    Knob("CNMF_TPU_SLO_P99_MS", "float", "`0` (off)",
+         "arms the serve daemon's sliding-window SLO tracker "
+         "(`obs/slo.py`) with this target p99 latency in ms: the "
+         "verdict (burning or not) is surfaced in `/metrics`, "
+         "`/healthz` (`degraded: true` while burning), periodic "
+         "`metrics_snapshot` events, and the report's SLO section"),
+    Knob("CNMF_TPU_SLO_WINDOW_S", "float", "`300`",
+         "SLO evaluation window in seconds: only requests completing "
+         "within the last window count toward the p99/error-rate "
+         "verdict (an observation exactly one window old has just "
+         "aged out)"),
     # -- fault tolerance ---------------------------------------------------
     Knob("CNMF_TPU_MAX_RETRIES", "int", "`2`",
          "retry budget per unhealthy (nonfinite) replicate: each attempt "

@@ -13,6 +13,10 @@ Verbs (the object-store subset the backend needs, plus range reads):
     with the slice (the range-GET surface a real object store offers);
   * ``GET /prefix/?list=1`` — 200 JSON array of object names under the
     prefix (relative, the backend's listing verb);
+  * ``GET /metrics``      — 200 text exposition of this process's live
+    metrics registry (``obs/metrics.py``; a "disabled" banner unless
+    ``CNMF_TPU_METRICS=1``). The path is reserved: an object literally
+    named ``metrics`` is shadowed by the endpoint;
   * ``PUT /name``         — 201, body stored verbatim;
   * ``HEAD /name``        — 200 with Content-Length, or 404;
   * ``DELETE /name``      — 204, or 404.
@@ -31,6 +35,8 @@ import json
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..obs import metrics as obs_metrics
 
 __all__ = ["ObjectStoreServer"]
 
@@ -59,6 +65,12 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         parts = urllib.parse.urlsplit(self.path)
         query = urllib.parse.parse_qs(parts.query)
+        if parts.path == "/metrics" and not query:
+            self._send(200, obs_metrics.render_text().encode("utf-8"),
+                       content_type="text/plain; charset=utf-8")
+            return
+        obs_metrics.counter_inc("cnmf_netstore_requests_total",
+                                verb="get")
         if query.get("list"):
             prefix = urllib.parse.unquote(parts.path).lstrip("/")
             if prefix and not prefix.endswith("/"):
@@ -92,6 +104,8 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(200, body)
 
     def do_HEAD(self):
+        obs_metrics.counter_inc("cnmf_netstore_requests_total",
+                                verb="head")
         key = self._key()
         with self.server.lock:
             body = self.server.objects.get(key)
@@ -101,6 +115,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, body)  # _send skips the body for HEAD
 
     def do_PUT(self):
+        obs_metrics.counter_inc("cnmf_netstore_requests_total",
+                                verb="put")
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length)
         with self.server.lock:
@@ -108,6 +124,8 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(201)
 
     def do_DELETE(self):
+        obs_metrics.counter_inc("cnmf_netstore_requests_total",
+                                verb="delete")
         with self.server.lock:
             existed = self.server.objects.pop(self._key(), None) is not None
         self._send(204 if existed else 404)

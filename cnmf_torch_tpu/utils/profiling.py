@@ -21,15 +21,18 @@ import threading
 import time
 
 __all__ = ["StageTimer", "trace", "PROFILE_ENV", "percentile",
-           "latency_summary"]
+           "latency_summary", "HIST_EDGES"]
 
 PROFILE_ENV = "CNMF_TPU_PROFILE_DIR"
 
 # log-ish histogram bucket edges for latency summaries, in the caller's
 # unit (serving uses milliseconds): fine buckets where SLOs live, coarse
-# tails, one overflow bucket
+# tails, one overflow bucket. Shared with the live metrics registry
+# (obs/metrics.py) so a scraped /metrics histogram and the post-hoc
+# report's latency_summary bucket the same way.
 _HIST_EDGES = (1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 500.0,
                1000.0, 2000.0, 5000.0)
+HIST_EDGES = _HIST_EDGES
 
 
 def percentile(values, q: float) -> float:

@@ -49,6 +49,8 @@ import urllib.parse
 import urllib.request
 import warnings
 
+from ..obs import metrics as obs_metrics
+from ..obs import tracing as obs_tracing
 from .anndata_lite import atomic_artifact
 from .envknobs import env_float, env_int, env_str
 
@@ -153,6 +155,10 @@ class _Counters:
     def bump(self, key: str, n: int = 1):
         with self._lock:
             setattr(self, key, getattr(self, key) + int(n))
+        # mirror into the live metrics registry (no-op when the metrics
+        # knob is off) — the same numbers a scrape sees mid-run that the
+        # post-hoc Ingestion table reports per pass
+        obs_metrics.counter_inc("cnmf_store_%s_total" % key, n)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -420,10 +426,19 @@ class RemoteBackend(StoreBackend):
                     self.counters.bump("degraded_reads")
                 return data
             self.counters.bump("cache_misses")
+        # store-I/O hop of a sampled batch-run trace (the launcher
+        # plants the process context in worker env) — plus the live GET
+        # latency histogram
+        t_get = time.perf_counter()
         try:
-            data = self._with_retries(
-                lambda: self._fetch(name, op),
-                op="get", name=name, events=events)
+            with obs_tracing.span(
+                    events, obs_tracing.child(obs_tracing.process_context()),
+                    "store.get", object=str(name), op=str(op)):
+                data = self._with_retries(
+                    lambda: self._fetch(name, op),
+                    op="get", name=name, events=events)
+            obs_metrics.observe("cnmf_store_get_ms",
+                                (time.perf_counter() - t_get) * 1e3)
         except RemoteStoreError:
             if cache_on and not refresh:
                 # a copy may have landed since the miss (another worker

@@ -112,6 +112,17 @@ EVENT_TYPES = {
     # Exactly ONE per factorize; `cnmf-tpu plan <run_dir>` re-renders it
     # and `--plan <file>` replays it bit-identically
     "plan": {"plan", "signature"},
+    # live observability plane (obs/, ISSUE 18): one `span` per sampled
+    # trace hop (client request, daemon admission, batcher queue/linger,
+    # AOT dispatch, store GET, launcher parent/worker) — `trace` stitches
+    # hops across processes, `parent` nests them, start_ts/wall_ms place
+    # them on the `cnmf-tpu trace` waterfall; one `metrics_snapshot` per
+    # Snapshotter tick (and per batch-stage boundary) carrying the full
+    # metrics-registry state, so the post-hoc JSONL holds what a live
+    # `GET /metrics` scrape would have shown (optionally plus the SLO
+    # verdict that `/healthz` was serving at that moment)
+    "span": {"trace", "span", "name", "start_ts", "wall_ms"},
+    "metrics_snapshot": {"metrics"},
 }
 
 # per-record required fields inside a "replicates" event's records list
@@ -455,6 +466,12 @@ def validate_event(ev: dict) -> None:
                     f"replicate record missing {sorted(rmissing)}: {rec}")
     if t == "memory" and not isinstance(ev["devices"], list):
         raise ValueError("memory.devices must be a list")
+    if t == "span":
+        for field in ("start_ts", "wall_ms"):
+            if not isinstance(ev[field], (int, float)):
+                raise ValueError(f"span.{field} must be numeric: {ev}")
+    if t == "metrics_snapshot" and not isinstance(ev["metrics"], dict):
+        raise ValueError("metrics_snapshot.metrics must be an object")
 
 
 def validate_events_file(path: str) -> int:
@@ -813,6 +830,32 @@ def summarize_events(events: list[dict]) -> dict:
                     sum(bool(h) for h in hits) / len(hits), 3)
         summary["serving"] = serving
 
+    # live observability plane (ISSUE 18): sampled trace spans rolled up
+    # by name (the waterfall itself is `cnmf-tpu trace`), and the LAST
+    # SLO verdict carried by a metrics_snapshot — what /healthz was
+    # reporting when the stream ended
+    span_evs = [e for e in events if e["t"] == "span"]
+    if span_evs:
+        by_name: dict = {}
+        for e in span_evs:
+            ent = by_name.setdefault(str(e.get("name")),
+                                     {"count": 0, "wall_ms": 0.0})
+            ent["count"] += 1
+            w = e.get("wall_ms")
+            if isinstance(w, (int, float)) and math.isfinite(w):
+                ent["wall_ms"] += float(w)
+        summary["spans"] = {
+            "count": len(span_evs),
+            "traces": len({e.get("trace") for e in span_evs}),
+            "by_name": {name: {"count": v["count"],
+                               "wall_ms_total": round(v["wall_ms"], 3)}
+                        for name, v in sorted(by_name.items())}}
+    slo_ev = next((e for e in reversed(events)
+                   if e["t"] == "metrics_snapshot"
+                   and isinstance(e.get("slo"), dict)), None)
+    if slo_ev is not None:
+        summary["slo"] = slo_ev["slo"]
+
     mem_peak = 0
     mem_stage = None
     for e in events:
@@ -1116,6 +1159,38 @@ def render_report(run_dir: str) -> str:
                 for label, cnt in hist.items():
                     bar = "#" * max(1, int(round(cnt / total * 32)))
                     lines.append(f"    {label:>8s} ms {cnt:>7d}  {bar}")
+
+    slo = summary.get("slo")
+    if slo:
+        lines.append("")
+        lines.append("SLO")
+        lines.append("-" * 3)
+        verdict = ("BURNING" if slo.get("burning")
+                   else "ok" if slo.get("requests") else "ok (no traffic)")
+        p99 = slo.get("p99_ms")
+        lines.append(
+            f"  target p99 {slo.get('target_p99_ms')} ms over "
+            f"{slo.get('window_s')} s window: {verdict}")
+        lines.append(
+            f"  windowed p99 "
+            + (f"{p99:.2f} ms" if isinstance(p99, (int, float))
+               else "n/a")
+            + f"  requests {slo.get('requests', 0)}  errors "
+            f"{slo.get('errors', 0)} "
+            f"(rate {slo.get('error_rate', 0.0):.4f}, budget "
+            f"{slo.get('max_error_rate', 0.0):.4f})")
+
+    spans = summary.get("spans")
+    if spans:
+        lines.append("")
+        lines.append("Trace spans (sampled)")
+        lines.append("-" * 21)
+        lines.append(f"  {spans['count']} span(s) across "
+                     f"{spans['traces']} trace(s) — render waterfalls "
+                     f"with `cnmf-tpu trace <run_dir>`")
+        for name, v in spans.get("by_name", {}).items():
+            lines.append(f"  {name:<28s} {v['count']:>6d} span(s) "
+                         f"{v['wall_ms_total']:>10.1f} ms total")
 
     lines.append("")
     lines.append("Device memory")

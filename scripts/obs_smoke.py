@@ -1,0 +1,300 @@
+"""Tier-1 observability smoke gate (scripts/verify_tier1.sh, ISSUE 18).
+
+Drives the live observability plane end-to-end against REAL processes:
+
+  * serve path — daemon via the CLI with metrics + tracing + SLO armed,
+    concurrent tenants, a ``/metrics`` scrape mid-load that parses back
+    (exposition round-trip), ``/stats`` reservoir-honesty fields, and at
+    least one request traced CLIENT -> DAEMON across two processes
+    (client.request / serve.http / serve.solve share a trace id with two
+    distinct pid prefixes) rendering a ``cnmf-tpu trace`` waterfall;
+  * SLO flip — a second daemon with a tight p99 target plus an injected
+    ``straggler:context=serve`` fault reports ``degraded`` on
+    ``/healthz`` (the generous-target phase must NOT);
+  * batch path — ``run_pipeline`` with sampling on traces parent ->
+    worker (``launcher.run`` -> ``factorize.worker`` across processes,
+    linked by ``CNMF_TPU_TRACE_CTX``) and leaves schema-valid
+    ``metrics_snapshot`` events;
+  * hygiene — clean shutdowns, no orphaned sockets, no lingering
+    cnmf-* threads in this process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _fail(msg: str) -> int:
+    print("obs smoke: " + msg, file=sys.stderr)
+    return 1
+
+
+def _start_daemon(run_dir: str, sock: str, env: dict):
+    from cnmf_torch_tpu.serving import ServeClient
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cnmf_torch_tpu", "serve", run_dir,
+         "--socket", sock],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    cli = ServeClient(socket_path=sock, timeout=60.0)
+    deadline = time.time() + 120
+    while True:
+        if proc.poll() is not None:
+            raise RuntimeError("daemon exited early:\n"
+                               + (proc.stdout.read() or ""))
+        try:
+            if cli.healthz().get("ok"):
+                return proc, cli
+        except Exception:
+            pass
+        if time.time() > deadline:
+            proc.kill()
+            raise RuntimeError("daemon never came up")
+        time.sleep(0.25)
+
+
+def _stop_daemon(proc, cli, sock: str):
+    cli.shutdown()
+    rc = proc.wait(timeout=60)
+    out = proc.stdout.read() or ""
+    if rc != 0:
+        raise RuntimeError("daemon exit code %d:\n%s" % (rc, out))
+    if os.path.exists(sock):
+        raise RuntimeError("orphaned socket file after shutdown")
+
+
+def main() -> int:
+    import numpy as np
+    import pandas as pd
+
+    from cnmf_torch_tpu import cNMF
+    from cnmf_torch_tpu.obs import metrics as obs_metrics
+    from cnmf_torch_tpu.obs import tracing as obs_tracing
+    from cnmf_torch_tpu.serving import ServeClient, ServeError
+    from cnmf_torch_tpu.utils import save_df_to_npz
+    from cnmf_torch_tpu.utils.telemetry import (EventLog, read_events,
+                                                validate_events_file)
+
+    workdir = tempfile.mkdtemp(prefix="obs_smoke_")
+    proc = None
+    try:
+        # -- fixture run (obs knobs still off) -----------------------------
+        rng = np.random.default_rng(8)
+        usage = rng.dirichlet(np.ones(4) * 0.3, size=160)
+        spectra = rng.gamma(0.3, 1.0, size=(4, 90)) * 40.0 / 90
+        counts = rng.poisson(usage @ spectra * 260.0).astype(np.float64)
+        counts[counts.sum(axis=1) == 0, 0] = 1.0
+        df = pd.DataFrame(counts, index=[f"c{i}" for i in range(160)],
+                          columns=[f"g{j}" for j in range(90)])
+        counts_fn = os.path.join(workdir, "counts.df.npz")
+        save_df_to_npz(df, counts_fn)
+
+        obj = cNMF(output_dir=workdir, name="smoke")
+        obj.prepare(counts_fn, components=[3], n_iter=6, seed=4,
+                    num_highvar_genes=70)
+        obj.factorize()
+        obj.combine()
+        obj.consensus(k=3, density_threshold=2.0, show_clustering=False)
+        run_dir = os.path.join(workdir, "smoke")
+        ev_path = os.path.join(run_dir, "cnmf_tmp", "smoke.events.jsonl")
+
+        # the whole plane on, in THIS process (client spans) and every
+        # child (daemon, launcher workers) via inherited env
+        os.environ["CNMF_TPU_TELEMETRY"] = "1"
+        os.environ["CNMF_TPU_METRICS"] = "1"
+        os.environ["CNMF_TPU_TRACE_SAMPLE"] = "1"
+
+        # -- phase A: serve path with generous SLO -------------------------
+        sock = os.path.join(workdir, "serve.sock")
+        env = dict(os.environ,
+                   CNMF_TPU_SLO_P99_MS="30000",
+                   CNMF_TPU_SERVE_LINGER_MS="100",
+                   CNMF_TPU_SERVE_WARM_START="0")
+        proc, cli = _start_daemon(run_dir, sock, env)
+        client_events = EventLog(ev_path)  # client spans, same O_APPEND file
+
+        queries = {f"tenant{i}": rng.gamma(
+            1.0, 1.0, size=(12 + 9 * i, 70)).astype(np.float32)
+            for i in range(4)}
+        results: dict = {}
+
+        def client(tenant, X):
+            try:
+                c = ServeClient(socket_path=sock, timeout=60.0,
+                                events=client_events)
+                results[tenant] = c.project(X, tenant=tenant)
+            except ServeError as exc:
+                results[tenant] = exc
+
+        threads = [threading.Thread(target=client, args=(t, X))
+                   for t, X in queries.items()]
+        for t in threads:
+            t.start()
+        # mid-load /metrics scrape: must answer while requests are in
+        # flight (the endpoint shares the daemon's accept loop)
+        mid = ServeClient(socket_path=sock, timeout=60.0).metrics()
+        for t in threads:
+            t.join()
+        bad = [t for t, r in results.items() if isinstance(r, Exception)]
+        if bad:
+            return _fail(f"clients failed: { {t: str(results[t]) for t in bad} }")
+        if not mid.startswith("#") and "cnmf" not in mid:
+            return _fail(f"mid-load scrape looks wrong: {mid[:200]!r}")
+
+        scraped = obs_metrics.parse_exposition(cli.metrics())
+        samples, types = scraped["samples"], scraped["types"]
+        ok_reqs = sum(v for (name, labels), v in samples.items()
+                      if name == "cnmf_serve_requests_total"
+                      and ("status", "ok") in labels)
+        if ok_reqs < len(queries):
+            return _fail(f"scrape saw {ok_reqs} ok requests, expected "
+                         f">= {len(queries)}")
+        for needed, kind in (("cnmf_serve_request_ms", "histogram"),
+                             ("cnmf_serve_solve_ms", "histogram"),
+                             ("cnmf_serve_batches_total", "counter"),
+                             ("cnmf_serve_queue_depth", "gauge"),
+                             ("cnmf_serve_latency_samples_kept", "gauge"),
+                             ("cnmf_slo_target_p99_ms", "gauge")):
+            if types.get(needed) != kind:
+                return _fail(f"scrape missing {kind} {needed}: "
+                             f"{sorted(types)}")
+        if samples[("cnmf_serve_request_ms_count", ())] < len(queries):
+            return _fail("request histogram undercounts")
+
+        stats = cli.stats()
+        for key in ("latency_samples_kept", "latency_samples_dropped",
+                    "latency_window_coverage"):
+            if key not in stats:
+                return _fail(f"/stats missing honesty field {key}")
+        health = cli.healthz()
+        if "slo" not in health or health.get("degraded"):
+            return _fail(f"generous-SLO healthz wrong: {health}")
+        if health["slo"]["burning"]:
+            return _fail(f"generous SLO burning: {health['slo']}")
+        _stop_daemon(proc, cli, sock)
+        proc = None
+
+        # -- phase A assertions: one request traced across two processes --
+        validate_events_file(ev_path)
+        evs = read_events(ev_path)
+        spans = [e for e in evs if e["t"] == "span"]
+        by_trace: dict = {}
+        for e in spans:
+            by_trace.setdefault(e["trace"], []).append(e)
+        crossed = None
+        for tid, tspans in by_trace.items():
+            names = {e["name"] for e in tspans}
+            pids = {e["span"].split(".")[0] for e in tspans}
+            if ({"client.request", "serve.http", "serve.solve"} <= names
+                    and len(pids) >= 2):
+                crossed = tid
+                break
+        if crossed is None:
+            return _fail("no trace covers client.request -> serve.http -> "
+                         "serve.solve across two processes; traces: "
+                         + json.dumps({t: sorted({e['name'] for e in s})
+                                       for t, s in by_trace.items()}))
+        snaps = [e for e in evs if e["t"] == "metrics_snapshot"]
+        if not snaps:
+            return _fail("daemon left no metrics_snapshot event")
+        waterfall = subprocess.run(
+            [sys.executable, "-m", "cnmf_torch_tpu", "trace", run_dir],
+            env=dict(os.environ), capture_output=True, text=True,
+            timeout=120)
+        if waterfall.returncode != 0:
+            return _fail("cnmf-tpu trace failed: " + waterfall.stderr)
+        for needle in (crossed, "client.request", "serve.solve", "#"):
+            if needle not in waterfall.stdout:
+                return _fail(f"serve waterfall missing {needle!r}:\n"
+                             + waterfall.stdout)
+
+        # -- phase B: SLO verdict flips under an injected straggler --------
+        sock_b = os.path.join(workdir, "serve_b.sock")
+        env_b = dict(env, CNMF_TPU_SLO_P99_MS="10",
+                     CNMF_TPU_FAULT_SPEC="straggler:context=serve,"
+                                         "seconds=0.05")
+        proc, cli = _start_daemon(run_dir, sock_b, env_b)
+        for i in range(4):
+            cli.project(queries["tenant0"], tenant="t")
+        health_b = cli.healthz()
+        if not (health_b.get("degraded")
+                and health_b["slo"]["burning"]
+                and health_b["slo"]["p99_ms"] > 10):
+            return _fail(f"tight SLO + straggler not burning: {health_b}")
+        _stop_daemon(proc, cli, sock_b)
+        proc = None
+
+        # -- phase C: launcher parent -> worker trace ----------------------
+        from cnmf_torch_tpu.launcher import run_pipeline
+
+        run_pipeline(counts_fn, workdir, "obsrun", components=[3],
+                     n_iter=4, total_workers=2, seed=4, numgenes=70,
+                     max_nmf_iter=150, k_selection=False)
+        run_dir_c = os.path.join(workdir, "obsrun")
+        ev_c = os.path.join(run_dir_c, "cnmf_tmp", "obsrun.events.jsonl")
+        validate_events_file(ev_c)
+        evs_c = read_events(ev_c)
+        spans_c = [e for e in evs_c if e["t"] == "span"]
+        roots = [e for e in spans_c if e["name"] == "launcher.run"]
+        workers = [e for e in spans_c if e["name"] == "factorize.worker"]
+        if not roots or not workers:
+            return _fail("launcher trace incomplete: "
+                         + str(sorted({e['name'] for e in spans_c})))
+        root = roots[0]
+        linked = [w for w in workers
+                  if w["trace"] == root["trace"]
+                  and w.get("parent") == root["span"]
+                  and w["span"].split(".")[0]
+                  != root["span"].split(".")[0]]
+        if not linked:
+            return _fail(f"no worker span parented on launcher.run across "
+                         f"processes: root={root}, workers={workers}")
+        if not [e for e in evs_c if e["t"] == "metrics_snapshot"]:
+            return _fail("workers left no metrics_snapshot event")
+        waterfall_c = subprocess.run(
+            [sys.executable, "-m", "cnmf_torch_tpu", "trace", run_dir_c],
+            env=dict(os.environ), capture_output=True, text=True,
+            timeout=120)
+        if waterfall_c.returncode != 0 or \
+                "launcher.run" not in waterfall_c.stdout or \
+                "factorize.worker" not in waterfall_c.stdout:
+            return _fail("launcher waterfall wrong:\n" + waterfall_c.stdout
+                         + waterfall_c.stderr)
+
+        # -- hygiene: no lingering obs threads in this process -------------
+        stragglers = [t.name for t in threading.enumerate()
+                      if t.name.startswith("cnmf-")]
+        if stragglers:
+            return _fail(f"orphaned threads: {stragglers}")
+
+        print("obs smoke: %d tenants served with mid-load /metrics scrape "
+              "(%d series), trace %s spans client->daemon across 2 "
+              "processes, SLO verdict flipped under injected straggler "
+              "(p99 %.1f ms > 10 ms), launcher run traced parent->worker "
+              "(%d worker span(s)), waterfalls rendered, clean shutdowns"
+              % (len(queries), len(samples), crossed,
+                 health_b["slo"]["p99_ms"], len(linked)))
+        return 0
+    except RuntimeError as exc:
+        return _fail(str(exc))
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -195,4 +195,23 @@ if [ "$rc" -eq 0 ]; then
     exit 1
   fi
 fi
+
+# obs smoke: the live observability plane end-to-end against real
+# processes (scripts/obs_smoke.py) — concurrent tenants with a mid-load
+# /metrics scrape that parses back, /stats reservoir-honesty fields, one
+# request traced client->daemon across two processes and one launcher
+# run traced parent->worker (both rendering `cnmf-tpu trace`
+# waterfalls), SLO verdict flipping to degraded under an injected
+# serve-dispatch straggler, schema-valid span/metrics_snapshot events,
+# clean shutdowns with no orphaned sockets or threads
+if [ "$rc" -eq 0 ]; then
+  echo "[tier1] obs smoke (metrics scrape + cross-process tracing + SLO flip) ..."
+  if timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      python scripts/obs_smoke.py; then
+    echo OBS_SMOKE=ok
+  else
+    echo OBS_SMOKE=fail
+    exit 1
+  fi
+fi
 exit $rc

@@ -1,0 +1,335 @@
+"""Live observability plane tests (ISSUE 18): metrics registry +
+exposition round-trip, metrics_snapshot schema validity, distributed
+trace propagation (header and env wires, including a real subprocess
+hop), deterministic sampling, SLO sliding-window boundary math, and —
+load-bearing for production — the off-path guarantees: unset knobs
+record nothing and compile byte-identical programs."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from cnmf_torch_tpu.obs import metrics as obs_metrics
+from cnmf_torch_tpu.obs import slo as obs_slo
+from cnmf_torch_tpu.obs import tracing as obs_tracing
+from cnmf_torch_tpu.utils import telemetry as tel
+from cnmf_torch_tpu.utils.profiling import HIST_EDGES
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state(monkeypatch):
+    """Every test starts with the obs knobs unset and the process-global
+    registry/context empty, and leaves them that way."""
+    for var in (obs_metrics.METRICS_ENV, obs_tracing.TRACE_SAMPLE_ENV,
+                obs_tracing.TRACE_CTX_ENV, obs_slo.SLO_P99_ENV,
+                obs_slo.SLO_WINDOW_ENV):
+        monkeypatch.delenv(var, raising=False)
+    obs_metrics.reset_default_registry()
+    obs_tracing.reset_process_context()
+    yield
+    obs_metrics.reset_default_registry()
+    obs_tracing.reset_process_context()
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+def test_registry_concurrent_counters_exact():
+    reg = obs_metrics.MetricsRegistry()
+    n_threads, n_incs = 8, 500
+
+    def worker(i):
+        for _ in range(n_incs):
+            reg.inc("hits", worker=i % 2)
+            reg.observe("lat_ms", 3.0)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = reg.snapshot()
+    total = sum(v for k, v in snap["counters"].items()
+                if k.startswith("hits"))
+    assert total == n_threads * n_incs
+    assert snap["histograms"]["lat_ms"]["count"] == n_threads * n_incs
+
+
+def test_registry_kind_conflict_and_negative_counter():
+    reg = obs_metrics.MetricsRegistry()
+    reg.inc("x")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.set("x", 1.0)
+    with pytest.raises(ValueError, match=">= 0"):
+        reg.inc("x", -1.0)
+
+
+def test_exposition_round_trip_with_labels():
+    reg = obs_metrics.MetricsRegistry()
+    reg.inc("reqs", 3, tenant="a b", status="ok")
+    reg.set("depth", 7.5)
+    for v in (0.5, 3.0, 15.0, 9999.0):
+        reg.observe("lat_ms", v)
+    parsed = obs_metrics.parse_exposition(reg.render_text())
+    assert parsed["types"] == {"reqs": "counter", "depth": "gauge",
+                              "lat_ms": "histogram"}
+    samples = parsed["samples"]
+    assert samples[("reqs", (("status", "ok"), ("tenant", "a b")))] == 3
+    assert samples[("depth", ())] == 7.5
+    # cumulative buckets: monotone, and +Inf equals _count
+    buckets = [(k, v) for k, v in samples.items()
+               if k[0] == "lat_ms_bucket"]
+    assert samples[("lat_ms_bucket", (("le", "+Inf"),))] == 4
+    assert samples[("lat_ms_count", ())] == 4
+    assert samples[("lat_ms_sum", ())] == pytest.approx(10017.5)
+    by_edge = dict((k[1][0][1], v) for k, v in buckets)
+    cum = [by_edge["%g" % e] for e in HIST_EDGES] + [by_edge["+Inf"]]
+    assert cum == sorted(cum)
+    # the overflow observation (9999 > last edge) only lands in +Inf
+    assert by_edge["%g" % HIST_EDGES[-1]] == 3
+
+
+def test_label_escaping_round_trips():
+    reg = obs_metrics.MetricsRegistry()
+    reg.inc("odd", path='a"b\\c\nd')
+    parsed = obs_metrics.parse_exposition(reg.render_text())
+    (key,) = [k for k in parsed["samples"] if k[0] == "odd"]
+    assert key[1] == (("path", 'a"b\\c\nd'),)
+
+
+def test_gated_helpers_noop_when_off(monkeypatch):
+    obs_metrics.counter_inc("c")
+    obs_metrics.gauge_set("g", 1.0)
+    obs_metrics.observe("h", 1.0)
+    snap = obs_metrics.default_registry().snapshot()
+    assert snap == {"counters": {}, "gauges": {}, "histograms": {}}
+    assert "disabled" in obs_metrics.render_text()
+    monkeypatch.setenv(obs_metrics.METRICS_ENV, "1")
+    obs_metrics.counter_inc("c")
+    assert obs_metrics.default_registry().snapshot()["counters"] == {
+        "c": 1.0}
+    assert "disabled" not in obs_metrics.render_text()
+
+
+def test_metrics_snapshot_event_schema_valid(tmp_path, monkeypatch):
+    monkeypatch.setenv(tel.TELEMETRY_ENV, "1")
+    monkeypatch.setenv(obs_metrics.METRICS_ENV, "1")
+    path = str(tmp_path / "run.events.jsonl")
+    events = tel.EventLog(path)
+    obs_metrics.counter_inc("c", tenant="t")
+    obs_metrics.observe("h", 12.0)
+    slo = obs_slo.SloTracker(50.0, window_s=10.0).evaluate()
+    assert obs_metrics.emit_snapshot(events, slo=slo)
+    n = tel.validate_events_file(path)
+    assert n >= 2  # manifest + snapshot
+    snaps = [e for e in tel.read_events(path)
+             if e["t"] == "metrics_snapshot"]
+    assert len(snaps) == 1
+    assert snaps[0]["metrics"]["counters"] == {"c{tenant=t}": 1.0}
+    assert snaps[0]["slo"]["burning"] is False
+    # off paths: no telemetry, or no metrics -> no event
+    monkeypatch.setenv(obs_metrics.METRICS_ENV, "0")
+    assert not obs_metrics.emit_snapshot(events)
+    monkeypatch.setenv(obs_metrics.METRICS_ENV, "1")
+    monkeypatch.setenv(tel.TELEMETRY_ENV, "0")
+    assert not obs_metrics.emit_snapshot(events)
+
+
+# ---------------------------------------------------------------------------
+# tracing
+# ---------------------------------------------------------------------------
+
+def test_sampling_deterministic_and_bounded():
+    ids = ["%032x" % i for i in range(200)]
+    for rate in (0.0, 0.3, 1.0):
+        first = [obs_tracing.is_sampled(t, rate) for t in ids]
+        again = [obs_tracing.is_sampled(t, rate) for t in ids]
+        assert first == again
+    assert not any(obs_tracing.is_sampled(t, 0.0) for t in ids)
+    assert all(obs_tracing.is_sampled(t, 1.0) for t in ids)
+    # a kept id stays kept at any higher rate (hash is rate-independent)
+    kept_03 = {t for t in ids if obs_tracing.is_sampled(t, 0.3)}
+    kept_07 = {t for t in ids if obs_tracing.is_sampled(t, 0.7)}
+    assert kept_03 <= kept_07
+
+
+def test_new_trace_off_by_default_and_child_chains():
+    assert obs_tracing.new_trace() is None  # knob unset -> never samples
+    ctx = obs_tracing.new_trace(rate=1.0)
+    assert ctx is not None and ctx.parent_id is None
+    c1 = obs_tracing.child(ctx)
+    c2 = obs_tracing.child(c1)
+    assert c1.trace_id == c2.trace_id == ctx.trace_id
+    assert c1.parent_id == ctx.span_id and c2.parent_id == c1.span_id
+    assert obs_tracing.child(None) is None
+
+
+def test_header_round_trip_and_malformed_dropped():
+    ctx = obs_tracing.new_trace(rate=1.0)
+    back = obs_tracing.from_header(obs_tracing.header_value(ctx))
+    assert (back.trace_id, back.span_id) == (ctx.trace_id, ctx.span_id)
+    for bad in (None, "", "noseparator", "a:b:c", ":x", "x:"):
+        assert obs_tracing.from_header(bad) is None
+
+
+def test_env_propagation_into_subprocess(monkeypatch):
+    """The launcher wire: a parent-planted CNMF_TPU_TRACE_CTX is picked
+    up by a real child interpreter's process_context()."""
+    parent = obs_tracing.new_trace(rate=1.0)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env[obs_tracing.TRACE_CTX_ENV] = obs_tracing.env_value(parent)
+    env[obs_tracing.TRACE_SAMPLE_ENV] = "1"
+    code = ("import json\n"
+            "from cnmf_torch_tpu.obs import tracing as t\n"
+            "ctx = t.child(t.process_context())\n"
+            "print(json.dumps({'trace': ctx.trace_id,"
+            " 'parent': ctx.parent_id}))\n")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    got = json.loads(out.stdout.strip().splitlines()[-1])
+    assert got["trace"] == parent.trace_id
+    assert got["parent"] == parent.span_id
+
+
+def test_span_events_schema_valid_and_waterfall(tmp_path, monkeypatch):
+    monkeypatch.setenv(tel.TELEMETRY_ENV, "1")
+    run_dir = tmp_path / "run"
+    (run_dir / "cnmf_tmp").mkdir(parents=True)
+    path = str(run_dir / "cnmf_tmp" / "x.events.jsonl")
+    events = tel.EventLog(path)
+    root = obs_tracing.new_trace(rate=1.0)
+    with obs_tracing.span(events, root, "client.request", tenant="t0"):
+        with obs_tracing.span(events, obs_tracing.child(root),
+                              "serve.solve"):
+            pass
+    tel.validate_events_file(path)
+    spans = [e for e in tel.read_events(path) if e["t"] == "span"]
+    assert [e["name"] for e in spans] == ["serve.solve", "client.request"]
+    assert spans[0]["parent"] == root.span_id
+    assert "parent" not in spans[1]  # None fields are omitted on emit
+    text = obs_tracing.render_run_traces(str(run_dir))
+    assert root.trace_id in text
+    assert "client.request" in text and "serve.solve" in text
+    # the child renders indented under its parent
+    lines = text.splitlines()
+    (solve_line,) = [ln for ln in lines if "serve.solve" in ln]
+    assert solve_line.startswith("    serve.solve"[:4] or "  ")
+
+
+def test_emit_span_noop_paths(tmp_path, monkeypatch):
+    monkeypatch.setenv(tel.TELEMETRY_ENV, "1")
+    path = str(tmp_path / "e.jsonl")
+    events = tel.EventLog(path)
+    obs_tracing.emit_span(events, None, "x", 0.0, 1.0)  # unsampled
+    obs_tracing.emit_span(None, obs_tracing.new_trace(rate=1.0),
+                          "x", 0.0, 1.0)  # no log
+    assert not os.path.exists(path)
+    assert "no span events" in obs_tracing.render_run_traces(
+        str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# SLO tracking
+# ---------------------------------------------------------------------------
+
+def test_slo_window_boundary_math():
+    trk = obs_slo.SloTracker(50.0, window_s=5.0)
+    trk.record(10.0, now=1.0)
+    # strictly inside the window
+    ev = trk.evaluate(now=5.99)
+    assert ev["requests"] == 1 and not ev["burning"]
+    # exactly window_s old -> just aged out; empty window never burns
+    ev = trk.evaluate(now=6.0)
+    assert ev["requests"] == 0 and ev["p99_ms"] is None
+    assert ev["burning"] is False and ev["ok"] is True
+
+
+def test_slo_burns_on_latency_and_error_budget():
+    trk = obs_slo.SloTracker(50.0, window_s=100.0)
+    for i in range(49):
+        trk.record(10.0, now=1.0 + i * 0.01)
+    assert not trk.evaluate(now=2.0)["burning"]
+    # interpolated p99 over 50 samples reaches well into the outlier
+    trk.record(500.0, now=2.0)
+    ev = trk.evaluate(now=2.0)
+    assert ev["p99_ms"] > 50.0 and ev["burning"]
+
+    trk2 = obs_slo.SloTracker(1000.0, window_s=100.0,
+                              max_error_rate=0.01)
+    for i in range(99):
+        trk2.record(1.0, ok=True, now=1.0)
+    trk2.record(1.0, ok=False, now=1.0)
+    assert not trk2.evaluate(now=1.0)["burning"]  # 1% == budget, not >
+    trk2.record(1.0, ok=False, now=1.0)
+    ev = trk2.evaluate(now=1.0)
+    assert ev["errors"] == 2 and ev["burning"]
+
+
+def test_slo_tracker_from_env(monkeypatch):
+    assert obs_slo.tracker_from_env() is None
+    monkeypatch.setenv(obs_slo.SLO_P99_ENV, "25")
+    monkeypatch.setenv(obs_slo.SLO_WINDOW_ENV, "60")
+    trk = obs_slo.tracker_from_env()
+    assert trk.target_p99_ms == 25.0 and trk.window_s == 60.0
+    with pytest.raises(ValueError):
+        obs_slo.SloTracker(0.0)
+    with pytest.raises(ValueError):
+        obs_slo.SloTracker(10.0, window_s=0.0)
+
+
+# ---------------------------------------------------------------------------
+# serving integration: /stats honesty + SLO surface
+# ---------------------------------------------------------------------------
+
+def test_stats_expose_reservoir_honesty_and_slo(monkeypatch):
+    from cnmf_torch_tpu.serving import ProjectionService, ResidentReference
+
+    monkeypatch.setenv(obs_slo.SLO_P99_ENV, "10000")
+    rng = np.random.default_rng(0)
+    W = rng.gamma(0.3, 1.0, size=(4, 40)).astype(np.float32)
+    ref = ResidentReference(W, beta=2.0, chunk_size=5000,
+                            chunk_max_iter=40, h_tol=0.05, l1_H=0.0)
+    with ProjectionService(ref, max_batch=4, linger_ms=0.0,
+                           warm_start=False) as svc:
+        X = (rng.random((8, 40)) + 0.01).astype(np.float32)
+        svc.project(X)
+        stats = svc.stats()
+    assert stats["latency_samples_kept"] == 1
+    assert stats["latency_samples_dropped"] == 0
+    assert stats["latency_window_coverage"] == 1.0
+    assert stats["slo"]["requests"] == 1
+    assert stats["slo"]["burning"] is False
+
+
+# ---------------------------------------------------------------------------
+# the production guarantee: off-path compiles byte-identical programs
+# ---------------------------------------------------------------------------
+
+def test_compiled_programs_byte_identical_with_knobs_on(monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    from cnmf_torch_tpu.ops.nmf import nmf_fit_batch, random_init
+
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.gamma(1.0, 1.0, (60, 30)).astype(np.float32))
+    H0, W0 = random_init(jax.random.key(0), 60, 30, 3, jnp.mean(X))
+
+    def lowered():
+        return nmf_fit_batch.lower(X, H0, W0, beta=1.0,
+                                   max_iter=10).as_text()
+
+    base = lowered()
+    monkeypatch.setenv(obs_metrics.METRICS_ENV, "1")
+    monkeypatch.setenv(obs_tracing.TRACE_SAMPLE_ENV, "1")
+    monkeypatch.setenv(obs_slo.SLO_P99_ENV, "25")
+    monkeypatch.setenv(obs_slo.SLO_WINDOW_ENV, "60")
+    assert lowered() == base
