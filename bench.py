@@ -1851,6 +1851,19 @@ def main():
     parser.add_argument("--out")
     parser.add_argument("--skip", nargs="*", default=[],
                         help="tiers to skip (debugging)")
+    parser.add_argument("--only", nargs="*", default=None, choices=TIERS,
+                        metavar="TIER",
+                        help="run only these tiers (a cheap subset for the "
+                             "perf-regression gate; default: all tiers)")
+    parser.add_argument("--json-out", dest="json_out",
+                        help="also write the results as a schema-versioned "
+                             "bench snapshot (obs/regress.py cnmf-bench "
+                             "schema, keyed by the autotune device "
+                             "fingerprint) — the format cnmf-tpu benchdiff "
+                             "and scripts/perf_gate.py consume")
+    parser.add_argument("--label",
+                        help="free-form label recorded in the --json-out "
+                             "snapshot (e.g. a git rev)")
     args = parser.parse_args()
 
     if args.tier:
@@ -1879,7 +1892,8 @@ def main():
     partial_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "bench_partial.json")
     results: dict = {}
-    for tier in TIERS:
+    selected = args.only if args.only else TIERS
+    for tier in selected:
         if tier in args.skip:
             continue
         print(f"[bench] running tier {tier} ...", file=sys.stderr, flush=True)
@@ -1926,6 +1940,21 @@ def main():
                     "programs — telemetry.enabled_during_run marks the "
                     "measurement condition for cross-round comparisons"),
     }))
+
+    if args.json_out:
+        # schema-versioned snapshot for the regression observatory: same
+        # validation surface as telemetry events, keyed by the autotune
+        # device fingerprint so benchdiff never compares across machines
+        import time as _time
+
+        from cnmf_torch_tpu.obs.regress import build_snapshot, save_snapshot
+        from cnmf_torch_tpu.utils.autotune import device_fingerprint
+
+        snap = build_snapshot(results, fingerprint=device_fingerprint(),
+                              created=_time.time(), label=args.label)
+        save_snapshot(snap, args.json_out)
+        print(f"[bench] snapshot written to {args.json_out}",
+              file=sys.stderr, flush=True)
 
 
 if __name__ == "__main__":

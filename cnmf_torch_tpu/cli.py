@@ -222,6 +222,12 @@ def build_parser() -> argparse.ArgumentParser:
                         action=argparse.BooleanOptionalAction, default=True,
                         help="[consensus] Generate reference spectra for "
                              "use in starCAT")
+    parser.add_argument("--json", action="store_true", default=False,
+                        help="[report] Emit the full report summary as "
+                             "machine-readable JSON (the structure "
+                             "`summarize_events` builds — what the perf "
+                             "gate and fleet dashboards consume) instead "
+                             "of the rendered text")
     return parser
 
 
@@ -241,10 +247,48 @@ def main(argv=None):
 
         raise SystemExit(lint_main(argv[1:]))
 
+    if argv and argv[0] == "benchdiff":
+        # noise-aware comparison of two bench snapshots (obs/regress.py):
+        # two positionals don't fit the single optional run_dir the
+        # reference-compatible parser exposes, so — like `lint` — it owns
+        # its argument surface and dispatches early. Never touches jax.
+        import argparse as _ap
+        import json as _json
+
+        from .obs.regress import diff_snapshots, load_snapshot, render_diff
+
+        bp = _ap.ArgumentParser(
+            prog="cnmf-tpu benchdiff",
+            description="Compare two bench snapshots (bench.py --json-out "
+                        "/ obs.regress schema) with noise-aware relative "
+                        "bands; exit 1 when any lane regresses past the "
+                        "band.")
+        bp.add_argument("base", help="baseline snapshot JSON")
+        bp.add_argument("new", help="candidate snapshot JSON")
+        bp.add_argument("--band", type=float, default=None,
+                        help="relative regression band (fraction; default "
+                             "CNMF_TPU_PERF_GATE_BAND or 0.6)")
+        bp.add_argument("--json", action="store_true", default=False,
+                        help="emit the diff as machine-readable JSON")
+        ba = bp.parse_args(argv[1:])
+        try:
+            diff = diff_snapshots(load_snapshot(ba.base),
+                                  load_snapshot(ba.new), band=ba.band)
+        except (OSError, ValueError) as exc:
+            bp.error(str(exc))
+        if ba.json:
+            print(_json.dumps(diff, indent=1, sort_keys=True))
+        else:
+            print(render_diff(diff))
+        raise SystemExit(0 if diff["ok"] else 1)
+
     # parse BEFORE any jax import: --help / usage errors must not pay the
-    # backend-initialization cost or touch the cache directory
+    # backend-initialization cost or touch the cache directory.
+    # parse_intermixed_args so flags may precede the optional run_dir
+    # positional (`report --json <run_dir>` and `report <run_dir> --json`
+    # both parse).
     parser = build_parser()
-    args = parser.parse_args(argv)
+    args = parser.parse_intermixed_args(argv)
 
     if args.command == "lint":  # e.g. `cnmf-tpu --name x lint`
         parser.error("lint takes its own options; use: cnmf-tpu lint "
@@ -316,7 +360,25 @@ def main(argv=None):
         run_dir = args.run_dir or os.path.join(args.output_dir, args.name)
         if not os.path.isdir(run_dir):
             parser.error(f"report: run directory not found: {run_dir}")
-        print(render_report(run_dir))
+        if args.json:
+            # machine-readable twin of the rendered report: the merged
+            # summarize_events structure (incl. the roofline block) that
+            # benchdiff/perf-gate tooling consumes
+            import json as _json
+
+            from .utils.telemetry import (_find_event_files, read_events,
+                                          summarize_events)
+
+            events: list[dict] = []
+            files = _find_event_files(run_dir)
+            for path in files:
+                events.extend(read_events(path))
+            doc = summarize_events(events)
+            doc["run_dir"] = run_dir
+            doc["event_files"] = len(files)
+            print(_json.dumps(doc, indent=1, sort_keys=True, default=str))
+        else:
+            print(render_report(run_dir))
         return
 
     if args.command in ("prepare", "run_parallel"):

@@ -1362,6 +1362,8 @@ class cNMF:
                     heartbeat.beat(phase="slice", cursor=task_idx[0])
                 faults.maybe_kill("factorize", worker_i)
 
+            self._perf_iters = {}
+            _perf_t0 = time.perf_counter()
             replicate_sweep_packed(
                 X, [t[0] for t in tasks], [t[2] for t in tasks],
                 beta_loss=_nmf_kwargs["beta_loss"],
@@ -1380,6 +1382,12 @@ class cNMF:
                     self._emit_replicates_event(pay))
             self._finish_resilience(guard, rerun_batched,
                                     norm_counts.var.index, worker_i)
+            _perf_acc, self._perf_iters = self._perf_iters, None
+            self._emit_perf_model(
+                "factorize", plan.kernel, int(norm_counts.X.shape[0]),
+                int(norm_counts.X.shape[1]), _perf_acc,
+                time.perf_counter() - _perf_t0, beta=beta_val,
+                ell_width=plan.ell_width, bf16_ratio=plan.bf16_ratio)
             return
 
         if len(by_k) > 1:
@@ -1415,6 +1423,8 @@ class cNMF:
         # of later ones while (a) each K's spectra files still land on disk
         # as soon as that K is done (crash-resume via --skip-completed-runs
         # keeps working) and (b) at most `window` Ks' results sit in HBM
+        self._perf_iters = {}
+        _perf_t0 = time.perf_counter()
         pending: list[tuple[int, list, list, object, object]] = []
         window = 4
         # sweep telemetry payloads hold DEVICE arrays until their K drains
@@ -1480,6 +1490,12 @@ class cNMF:
         _drain(0)
         self._finish_resilience(guard, rerun_batched, norm_counts.var.index,
                                 worker_i)
+        _perf_acc, self._perf_iters = self._perf_iters, None
+        self._emit_perf_model(
+            "factorize", plan.kernel, int(norm_counts.X.shape[0]),
+            int(norm_counts.X.shape[1]), _perf_acc,
+            time.perf_counter() - _perf_t0, beta=beta_val,
+            ell_width=plan.ell_width, bf16_ratio=plan.bf16_ratio)
 
     def _save_factorize_provenance(self, engaged_path: str, worker_i,
                                    effective_params: dict):
@@ -1511,12 +1527,71 @@ class cNMF:
             return
         from ..utils.telemetry import replicate_records
 
+        records = replicate_records(payload)
         self._events.emit("replicates", k=payload["k"], beta=payload["beta"],
                           mode=payload["mode"], cap=int(payload["cap"]),
                           cadence=payload["cadence"],
                           recipe=payload.get("recipe"),
                           kernel=payload.get("kernel"),
-                          records=replicate_records(payload))
+                          records=records)
+        # roofline accounting (ISSUE 19): while a factorize path has an
+        # open accumulator, total the solver iterations per K — the pass
+        # multiplicity its perf_model event scales the per-iteration
+        # analytic cost by
+        acc = getattr(self, "_perf_iters", None)
+        if acc is not None:
+            k = int(payload["k"])
+            acc[k] = acc.get(k, 0) + sum(
+                int(r.get("iters", 0)) for r in records)
+
+    def _emit_perf_model(self, stage, lane, n, g, iters_by_k, wall_s,
+                         *, beta, ell_width=None, bf16_ratio=False,
+                         grid_shape=None, grid_blocks=None):
+        """Join the analytic per-lane cost prediction
+        (obs/costmodel.py, instantiated from the resolved plan's lane)
+        with a measured wall as ONE schema-valid ``perf_model`` event:
+        achieved MFU, achieved bandwidth fraction, and the compute- vs
+        memory-bound roofline verdict. Host-side accounting only — off
+        unless telemetry AND CNMF_TPU_PERF_MODEL are both on, and never
+        takes factorize down."""
+        from ..obs.costmodel import (chip_peaks, lane_cost,
+                                     perf_model_enabled, roofline)
+
+        if not (self._events.enabled and perf_model_enabled()):
+            return
+        if not iters_by_k or wall_s is None:
+            return
+        try:
+            import jax
+
+            kind = jax.devices()[0].device_kind
+            backend = jax.default_backend()
+        except Exception:
+            kind, backend = None, "unknown"
+        peaks = chip_peaks(kind)
+        tot_f = tot_b = tot_coll = 0.0
+        passes = 0
+        exempt = backend != "tpu"
+        for k, n_iters in sorted(iters_by_k.items()):
+            c = lane_cost(lane, n, g, int(k), beta=beta,
+                          ell_width=ell_width, bf16_ratio=bf16_ratio,
+                          grid_shape=grid_shape, grid_blocks=grid_blocks)
+            exempt = exempt or bool(c.get("perf_exempt"))
+            tot_f += c["flops"] * int(n_iters)
+            tot_b += c["bytes"] * int(n_iters)
+            tot_coll += float(c.get("collective_bytes", 0.0)) * int(n_iters)
+            passes += int(n_iters)
+        roof = roofline(tot_f, tot_b, wall_s, peaks, perf_exempt=exempt)
+        pred = {"flops": tot_f, "bytes": tot_b,
+                "by_k": {str(k): int(v)
+                         for k, v in sorted(iters_by_k.items())}}
+        if tot_coll:
+            pred["collective_bytes"] = tot_coll
+        self._events.emit("perf_model", stage=stage, lane=lane,
+                          predicted=pred,
+                          measured={"wall_s": round(float(wall_s), 4),
+                                    "passes": passes},
+                          roofline=roof)
 
     def _write_iter_spectra(self, k, it, spectrum, columns):
         """One replicate's spectra artifact (atomic via save_df_to_npz);
@@ -2028,9 +2103,12 @@ class cNMF:
                     _remesh_after_loss(exc)  # DegradedMeshError aborts
                     force_resume = True
 
+        _perf_t0 = time.perf_counter()
+        _perf_passes: dict[int, int] = {}
         for idx in jobs:
             p = run_params.iloc[idx, :]
             k, it = int(p["n_components"]), int(p["iter"])
+            _perf_passes[k] = _perf_passes.get(k, 0) + int(n_passes_eff)
             faults.maybe_straggle(context="factorize", worker=worker_i)
             spectra, err, ckpt = _solve_elastic(k, it, p["nmf_seed"])
             sp3, errs = faults.maybe_poison_lanes(
@@ -2068,6 +2146,19 @@ class cNMF:
 
         self._finish_resilience(guard, rerun_rowshard, norm_counts.var.index,
                                 worker_i)
+        # roofline accounting (ISSUE 19): one pass of the sharded (or
+        # 2-D grid) solver is the cost unit here, scaled by the
+        # n_passes_eff each job ran
+        self._emit_perf_model(
+            "factorize_grid2d" if grid else "factorize_rowshard",
+            "grid2d" if grid else rs_kernel,
+            int(norm_counts.X.shape[0]), int(norm_counts.X.shape[1]),
+            _perf_passes, time.perf_counter() - _perf_t0, beta=rs_beta,
+            ell_width=(int(Xd.width) if isinstance(Xd, _EllMatrix)
+                       else None),
+            grid_shape=grid_ctx.get("mesh_shape"),
+            grid_blocks=(max(grid_ctx["blocks"])
+                         if grid_ctx.get("blocks") else None))
 
     def _factorize_2d(self, jobs, run_params, norm_counts, nmf_kwargs,
                       mesh, worker_i, replicates_per_batch=None,

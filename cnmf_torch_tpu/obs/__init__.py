@@ -15,12 +15,21 @@ Three surfaces over one event layer:
 * :mod:`~cnmf_torch_tpu.obs.slo` — a sliding-window SLO tracker
   (target p99 + error budget) evaluated inside the daemon and surfaced
   in ``/metrics``, ``/healthz``, and the report's SLO section.
+* :mod:`~cnmf_torch_tpu.obs.costmodel` — the roofline cost model
+  (ISSUE 19): analytic flop/byte/collective-word accounting per kernel
+  lane instantiated from the ExecutionPlan, joined with measured walls
+  into ``perf_model`` events (achieved MFU, bandwidth fraction,
+  compute- vs memory-bound verdict) and the report's Roofline section.
+* :mod:`~cnmf_torch_tpu.obs.regress` — the perf-regression observatory:
+  schema-versioned bench snapshots keyed by the autotune device
+  fingerprint, noise-aware diffing (`cnmf-tpu benchdiff`), and the
+  tier-1 perf gate (scripts/perf_gate.py).
 
 Everything here is host-side and off by default: with the knobs unset
 no instrument records, no span emits, and compiled programs are
 byte-identical to a build without this package (pinned by test).
 """
 
-from . import metrics, slo, tracing  # noqa: F401
+from . import costmodel, metrics, regress, slo, tracing  # noqa: F401
 
-__all__ = ["metrics", "tracing", "slo"]
+__all__ = ["metrics", "tracing", "slo", "costmodel", "regress"]

@@ -1,0 +1,229 @@
+"""Roofline cost model (ISSUE 19): the performance-truth layer.
+
+Analytic per-stage / per-kernel-lane cost accounting — flops, bytes
+moved through the solver, collective bytes per pass — instantiated
+directly from the resolved :class:`~cnmf_torch_tpu.runtime.planner.
+ExecutionPlan` (via :meth:`cost_inputs`) plus the per-dispatch problem
+shape. The closed forms follow the MPI-FAUN accounting (arXiv
+1609.09154: per-iteration flop/word/collective-word counts for
+distributed MU/HALS schedules) and the out-of-memory NMF slab-loop
+accounting (arXiv 2202.09518); each lane's formula lives NEXT TO its
+kernel (``ops/nmf.py:dense_update_cost``, ``ops/sparse.py:
+ell_stats_cost``, ``ops/pallas:pallas_stats_cost``, ``parallel/
+grid2d.py:grid_pass_cost``) and is cross-validated against
+``jit(f).lower(...).compile().cost_analysis()`` on pinned shapes by
+tests/test_costmodel.py — flops exact, bytes within the 10% band.
+
+Joining a prediction with a measured wall yields the roofline verdict
+(:func:`roofline`): achieved MFU, achieved bandwidth fraction,
+arithmetic intensity against the machine balance point, and the
+compute- vs memory-bound call. Runs on hardware without a datasheet
+entry (this CPU gate, Pallas interpret mode) get nominal peaks and a
+``perf_exempt`` flag — the verdict renders, but the perf gate and
+benchdiff never compare it.
+
+Host-side only: importing this module never imports jax, and nothing
+here runs inside a traced computation — with ``CNMF_TPU_PERF_MODEL``
+unset compiled programs are byte-identical (pinned by test).
+"""
+
+from __future__ import annotations
+
+import math
+
+__all__ = ["CHIP_PEAKS", "chip_peaks", "lane_cost", "plan_cost",
+           "serve_project_cost", "roofline", "xla_cost",
+           "perf_model_enabled", "PERF_MODEL_ENV"]
+
+PERF_MODEL_ENV = "CNMF_TPU_PERF_MODEL"
+
+# (peak dense-matmul flops/s, peak HBM bytes/s) per device kind —
+# datasheet bf16 numbers, same table the bench MFU tier reports against
+# (bench.py:_CHIP_PEAKS). Keys match jax's `device_kind` strings.
+CHIP_PEAKS = {
+    "TPU v4": (275e12, 1.2e12),
+    "TPU v5 lite": (394e12, 0.819e12),
+    "TPU v5": (459e12, 2.765e12),
+    "TPU v5p": (459e12, 2.765e12),
+    "TPU v6 lite": (918e12, 1.64e12),
+}
+
+# nominal single-core CPU envelope used when the device has no
+# datasheet entry: ~50 GFLOP/s f32 and ~20 GB/s effective stream
+# bandwidth. Deliberately round numbers — rows built on them carry
+# peak_source="nominal-cpu" + perf_exempt=True and are never gated.
+_NOMINAL_CPU = (50e9, 20e9)
+
+
+def perf_model_enabled() -> bool:
+    """Whether factorize/serve should emit ``perf_model`` events.
+    Host-side flag only — it gates event emission, never lowering."""
+    from ..utils.envknobs import env_flag
+
+    return env_flag(PERF_MODEL_ENV, False)
+
+
+def chip_peaks(device_kind: str | None) -> dict:
+    """Peak envelope for a device kind: ``{flops, bw, source}`` where
+    source is ``datasheet`` for known TPUs and ``nominal-cpu``
+    otherwise (the accompanying roofline rows become perf-exempt)."""
+    if device_kind:
+        for name, (pf, pb) in CHIP_PEAKS.items():
+            if device_kind == name or device_kind.startswith(name):
+                return {"flops": pf, "bw": pb, "source": "datasheet"}
+    return {"flops": _NOMINAL_CPU[0], "bw": _NOMINAL_CPU[1],
+            "source": "nominal-cpu"}
+
+
+# ---------------------------------------------------------------------------
+# per-lane analytic cost
+# ---------------------------------------------------------------------------
+
+def lane_cost(lane: str, n: int, g: int, k: int, *, beta: float = 1.0,
+              ell_width: int | None = None, t_width: int | None = None,
+              bf16_ratio: bool = False,
+              grid_shape: list | None = None,
+              grid_blocks: int | None = None) -> dict:
+    """Cost of ONE update iteration (H + W) on a kernel lane, per the
+    formula owned by that lane's module. ``lane`` is a kernel label as
+    carried by dispatch/replicates events (``vmapped``, ``vmapped-bf16``,
+    ``bundled``, ``dense-jnp``, ``ell-jnp``, ``ell-pallas``,
+    ``grid2d``). Returns ``{flops, bytes, lane, ...}``; grid lanes add
+    ``collective_bytes``. Degenerate windows (n==0, g==0, k==0, or a
+    zero-width ELL slab) cost exactly zero — callers never special-case
+    empty work."""
+    n, g, k = int(n), int(g), int(k)
+    if n <= 0 or g <= 0 or k <= 0 or (
+            lane in ("ell-jnp", "ell-pallas") and not ell_width):
+        return {"flops": 0.0, "bytes": 0.0, "lane": lane,
+                "degenerate": True}
+    if lane == "grid2d":
+        from ..parallel.grid2d import grid_pass_cost
+
+        gs = grid_shape or [1, 1]
+        n_dev = max(1, int(gs[0]) * int(gs[1]))
+        rows_loc = -(-n // max(int(gs[0]), 1))
+        g_loc = -(-g // max(int(gs[1]), 1))
+        nblk = max(1, int(grid_blocks or 1))
+        return grid_pass_cost(rows_loc, g_loc, k, beta,
+                              nblk_h=nblk, nblk_w=nblk, n_dev=n_dev)
+    if lane == "ell-pallas":
+        from ..ops.pallas import pallas_stats_cost
+
+        return pallas_stats_cost(n, g, k, int(ell_width),
+                                 t_width=t_width, beta=beta)
+    if lane == "ell-jnp":
+        from ..ops.sparse import ell_stats_cost
+
+        return ell_stats_cost(n, g, k, int(ell_width),
+                              t_width=t_width, beta=beta)
+    # dense lanes (vmapped / vmapped-bf16 / bundled / dense-jnp)
+    from ..ops.nmf import dense_update_cost
+
+    c = dense_update_cost(n, g, k, beta, bf16_ratio=bf16_ratio,
+                          bundled=(lane == "bundled"))
+    c["lane"] = lane
+    return c
+
+
+def plan_cost(plan_inputs: dict, n: int, g: int, k: int,
+              lane: str | None = None) -> dict:
+    """Instantiate the per-iteration cost for a resolved plan
+    (``ExecutionPlan.cost_inputs()`` or an equal dict) at a problem
+    shape. ``lane`` overrides the plan's kernel label when the caller
+    knows which lane actually dispatched (e.g. the rowshard solver's
+    per-job kernel)."""
+    p = dict(plan_inputs or {})
+    resolved = lane or str(p.get("kernel") or "vmapped")
+    if p.get("layout") == "grid2d" or resolved == "grid2d":
+        resolved = "grid2d"
+    return lane_cost(
+        resolved, n, g, k,
+        beta=float(p.get("beta", 1.0)),
+        ell_width=p.get("ell_width"),
+        bf16_ratio=bool(p.get("bf16_ratio")),
+        grid_shape=p.get("grid_shape"),
+        grid_blocks=p.get("grid_blocks"))
+
+
+def serve_project_cost(b: int, n: int, g: int, k: int, *,
+                       beta: float = 2.0, iters: int = 1) -> dict:
+    """Cost of one batched serve dispatch (``serving/batcher.py``
+    ``batched_project``): an H-only fit on a padded ``(b, n, g)`` lane
+    batch with the reference Gram precomputed (beta=2) or the ratio
+    chain (beta=1), times ``iters`` inner iterations. Serving assumes
+    the iteration CAP (the while loop's actual trip count is
+    data-dependent and not observable host-side) — events built on
+    this carry ``iters_assumed_cap``."""
+    b, n, g, k, iters = int(b), int(n), int(g), int(k), max(int(iters), 1)
+    if b <= 0 or n <= 0 or g <= 0 or k <= 0:
+        return {"flops": 0.0, "bytes": 0.0, "lane": "serve-project",
+                "degenerate": True}
+    f = 4.0
+    if beta == 2.0:
+        flops = b * (2 * n * g * k + 2 * n * k * k + 3 * n * k)
+        bytes_ = b * ((n * g + k * g + n * k) * f
+                      + (n * k + k * k + n * k) * f
+                      + 4 * n * k * f)
+    else:
+        flops = b * (4 * n * g * k + 2 * n * g + k * (g - 1) + 3 * n * k)
+        bytes_ = b * ((n * k + k * g + n * g) * f + 3 * n * g * f
+                      + (n * g + k * g + n * k) * f + 4 * n * k * f)
+    return {"flops": float(flops * iters), "bytes": float(bytes_ * iters),
+            "lane": "serve-project"}
+
+
+# ---------------------------------------------------------------------------
+# roofline verdict
+# ---------------------------------------------------------------------------
+
+def roofline(flops: float, nbytes: float, wall_s: float,
+             peaks: dict | None = None, *,
+             perf_exempt: bool = False) -> dict:
+    """Join predicted work with a measured wall: achieved MFU, achieved
+    bandwidth fraction, arithmetic intensity vs the machine balance
+    point, and the bound verdict. ``peaks`` is :func:`chip_peaks`
+    output (nominal-cpu assumed when absent). Zero/degenerate work or a
+    non-positive wall yields the ``"idle"`` verdict rather than a
+    division error."""
+    peaks = peaks or chip_peaks(None)
+    pf, pb = float(peaks["flops"]), float(peaks["bw"])
+    src = str(peaks.get("source", "nominal-cpu"))
+    exempt = bool(perf_exempt or src != "datasheet")
+    flops, nbytes = float(flops), float(nbytes)
+    out = {"peak_source": src, "perf_exempt": exempt}
+    if wall_s is None or wall_s <= 0 or (flops <= 0 and nbytes <= 0):
+        out.update(mfu=None, bw_frac=None, intensity=None, bound="idle")
+        return out
+    mfu = flops / wall_s / pf
+    bw = nbytes / wall_s / pb
+    balance = pf / pb                       # flops per byte at the ridge
+    intensity = flops / nbytes if nbytes > 0 else math.inf
+    bound = "compute-bound" if intensity >= balance else "memory-bound"
+    out.update(mfu=round(mfu, 6), bw_frac=round(bw, 6),
+               intensity=round(intensity, 4) if math.isfinite(intensity)
+               else None,
+               balance=round(balance, 4), bound=bound)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# XLA cross-validation
+# ---------------------------------------------------------------------------
+
+def xla_cost(fn, *args, static_argnames=None, **kwargs) -> dict:
+    """``jit(fn).lower(...).compile().cost_analysis()`` normalized to
+    ``{flops, bytes}``. Some backends return a per-computation LIST of
+    dicts (first entry = entry computation); flop-free programs (bare
+    gathers) omit the ``flops`` key entirely — both normalized here so
+    tests and calibration probes share one code path. Requires jax;
+    only ever called from tests/probes, never from the hot path."""
+    import jax
+
+    ca = (jax.jit(fn, static_argnames=static_argnames)
+          .lower(*args, **kwargs).compile().cost_analysis())
+    if isinstance(ca, list):
+        ca = ca[0] if ca else {}
+    ca = ca or {}
+    return {"flops": float(ca.get("flops", 0.0)),
+            "bytes": float(ca.get("bytes accessed", 0.0))}

@@ -2233,3 +2233,70 @@ def run_nmf(X, n_components: int, init: str = "random",
         raise ValueError(f"unknown mode {mode!r}")
 
     return np.asarray(H), np.asarray(W), float(err)
+
+
+# ---------------------------------------------------------------------------
+# analytic cost hooks (ISSUE 19, obs/costmodel.py)
+# ---------------------------------------------------------------------------
+
+def dense_update_cost(n: int, g: int, k: int, beta: float = 2.0,
+                      *, bf16_ratio: bool = False,
+                      bundled: bool = False) -> dict:
+    """Analytic flop/byte cost of ONE dense MU iteration (H update + W
+    update) of the chains above, in XLA ``cost_analysis()`` accounting:
+    2mnk flops per matmul, 1 flop per output element per elementwise op;
+    bytes = operand + output buffer bytes per unfused matmul plus
+    operand + output bytes per fused elementwise chain. Pure host
+    arithmetic — no jax import, callable from the cost model at plan
+    time. ``bundled`` counts USEFUL per-replicate work only (the packed
+    kernel's masked-Gram padding flops are overhead, same convention as
+    the bench MFU tier); ``bf16_ratio`` halves the X/WH/ratio traffic
+    of the beta!=2 chains, flops unchanged."""
+    n, g, k = int(n), int(g), int(k)
+    f = 4.0                      # f32 operand bytes
+    fx = 2.0 if (bf16_ratio and beta != 2.0) else 4.0
+    if beta == 2.0:
+        # H: X@W.T + W@W.T + H@WWT + rate chain; W: H.T@X + H.T@H +
+        # HtH@W + rate chain (ops above: _update_H/_update_W beta=2)
+        flops = (4 * n * g * k + 4 * n * k * k + 4 * g * k * k
+                 + 3 * n * k + 3 * g * k)
+        bytes_ = (
+            # H side: three unfused matmuls + the fused rate chain
+            (n * g + k * g + n * k) * f        # X @ W.T
+            + (2 * k * g + k * k) * f          # W @ W.T
+            + (n * k + k * k + n * k) * f      # H @ WWT
+            + 4 * n * k * f                    # numer,denom,H -> H'
+            # W side, symmetric
+            + (n * g + n * k + k * g) * f      # H.T @ X
+            + (2 * n * k + k * k) * f          # H.T @ H
+            + (k * g + k * k + k * g) * f      # HtH @ W
+            + 4 * k * g * f)
+    elif beta == 1.0:
+        # H: WH + ratio + R@W.T + colsum denom + rate; W mirrored
+        flops = (8 * n * g * k + 4 * n * g + k * (g - 1) + (n - 1) * k
+                 + 3 * n * k + 3 * k * g)
+        bytes_ = (
+            2 * ((n * k + k * g) * f + n * g * fx)   # H@W (x2: H and W upd)
+            + 2 * 3 * n * g * fx                     # X/max(WH,eps) chains
+            + (n * g * fx + k * g * f + n * k * f)   # R @ W.T
+            + (n * g * fx + n * k * f + k * g * f)   # H.T @ R
+            + (k * g * f + k * f)                    # W colsum
+            + (n * k * f + k * f)                    # H rowsum
+            + 4 * n * k * f + 4 * k * g * f)         # rate chains
+    elif beta == 0.0:
+        # IS: WH + two ratio chains + two stats matmuls per side + the
+        # gamma=0.5 rate chain (approximate elementwise accounting)
+        flops = (12 * n * g * k + 10 * n * g + 7 * n * k + 7 * k * g)
+        bytes_ = (
+            2 * ((n * k + k * g) * f + n * g * fx)
+            + 2 * 5 * n * g * fx
+            + 2 * (n * g * fx + k * g * f + n * k * f)
+            + 2 * (n * g * fx + n * k * f + k * g * f)
+            + 6 * n * k * f + 6 * k * g * f)
+    else:
+        raise ValueError(f"dense_update_cost implements beta in "
+                         f"{{2, 1, 0}}, got {beta}")
+    return {"flops": float(flops), "bytes": float(bytes_),
+            "lane": ("bundled" if bundled else
+                     ("vmapped-bf16" if (bf16_ratio and beta != 2.0)
+                      else "vmapped"))}

@@ -38,7 +38,7 @@ from __future__ import annotations
 import threading
 
 __all__ = ["PALLAS_ENV", "pallas_available", "pallas_interpret",
-           "resolve_pallas", "kernel_label"]
+           "resolve_pallas", "kernel_label", "pallas_stats_cost"]
 
 PALLAS_ENV = "CNMF_TPU_PALLAS"
 
@@ -155,3 +155,33 @@ def kernel_label(use_ell: bool, use_pallas: bool = False,
     if use_ell:
         return "ell-pallas" if use_pallas else "ell-jnp"
     return "vmapped-bf16" if bf16_ratio else "vmapped"
+
+
+def pallas_stats_cost(n: int, g: int, k: int, width: int,
+                      t_width=None, beta: float = 1.0) -> dict:
+    """Analytic flop/byte cost of one fused ELL KL iteration on the
+    Pallas lane. The fused kernels do the same useful arithmetic as the
+    jnp slab kernels (that is the parity contract pallas_smoke pins),
+    so the flop count is shared with :func:`..sparse.ell_stats_cost`;
+    fusion removes the intermediate slab materialisations, so the byte
+    floor is the operand + output traffic only. Interpret-mode runs are
+    NOT a perf configuration — the cost model marks them perf-exempt
+    (see ``perf_exempt``), never compared against a roofline."""
+    from ..sparse import ell_stats_cost
+
+    c = ell_stats_cost(n, g, k, width, t_width=t_width, beta=beta)
+    f = 4.0
+    n, g, k, w = int(n), int(g), int(k), int(width)
+    if t_width is not None:
+        wt = int(t_width)
+    else:
+        wt = -(-(w * n) // max(g, 1))
+        wt = max(8, -(-wt // 8) * 8)
+    nw, gwt = n * w, g * wt
+    # fused floor: vals + cols + W + H in, stats out, once per side
+    c["bytes"] = float(
+        (nw * f + nw * 4 + k * g * f + n * k * f + 2 * n * k * f)
+        + (gwt * f + gwt * 4 + n * k * f + k * g * f + 2 * k * g * f))
+    c["lane"] = "ell-pallas"
+    c["perf_exempt"] = bool(pallas_interpret())
+    return c

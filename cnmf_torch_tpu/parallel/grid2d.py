@@ -1144,3 +1144,49 @@ def measure_collectives(Xd, k: int, mesh: Mesh, beta: float = 2.0,
             rows_loc, g_loc, int(k), float(beta), nblk_h, nblk_w,
             c_dim * g_dim),
     }
+
+
+# ---------------------------------------------------------------------------
+# analytic cost hooks (ISSUE 19, obs/costmodel.py)
+# ---------------------------------------------------------------------------
+
+def grid_pass_cost(rows_loc: int, g_loc: int, k: int, beta: float = 2.0,
+                   *, nblk_h: int = 1, nblk_w: int = 1,
+                   n_dev: int = 4) -> dict:
+    """Analytic PER-DEVICE flop/byte cost of one :func:`_grid_pass_jit`
+    beta=2 pass, in XLA ``cost_analysis()`` accounting (while-loop
+    bodies counted once, per the trip-count-1 convention XLA uses for
+    dynamic loops). Byte constants are calibrated against XLA CPU's
+    buffer accounting for this program (least-squares over 8 pinned
+    shapes, residual < 0.1%). collective_bytes uses the same formula
+    the live `collective` telemetry event reports
+    (:func:`_coll_bytes_per_pass`). Host arithmetic only.
+    """
+    r, gl, k = int(rows_loc), int(g_loc), int(k)
+    if beta == 2.0:
+        flops = (
+            k * gl + 2 * r * gl * k + 2 * k * gl * k + 2 * (r * k + k * k)
+            + 2 * r * k * k + 4 * r * k + 3 * r * k + 4
+            + r * k + 2 * r * gl * k + 2 * r * k * k + 2 * (gl * k + k * k)
+            + 2 * k * k * gl + 4 * k * gl + 4 * k * gl + 4
+            + 2 * r * gl * k + 3 * r * gl + 2)
+        bytes_ = (4.0 * (7 * r * gl + 26 * (r * k + k * gl) + 8 * k * k)
+                  + 0.75 * (r + gl) + 402.0)
+    else:
+        # KL/IS passes share the stats shapes but run ratio chains over
+        # the local X block; approximate with the dominant terms (no
+        # calibrated fit — flagged approximate by the cost model).
+        flops = (8 * r * gl * k + 6 * r * gl
+                 + 4 * r * k * k + 4 * k * k * gl + 7 * (r * k + k * gl))
+        bytes_ = 4.0 * (9 * r * gl + 26 * (r * k + k * gl) + 8 * k * k)
+    coll = _coll_bytes_per_pass(r, gl, k, float(beta),
+                                int(nblk_h), int(nblk_w), int(n_dev))
+    return {"flops": float(flops), "bytes": float(bytes_),
+            "collective_bytes": float(coll),
+            "calibrated": beta == 2.0, "lane": "grid2d"}
+
+
+def coll_bytes_per_pass(rows_loc, g_loc, k, beta, nblk_h, nblk_w, n_dev):
+    """Public alias of :func:`_coll_bytes_per_pass` for obs/costmodel."""
+    return _coll_bytes_per_pass(rows_loc, g_loc, k, beta,
+                                nblk_h, nblk_w, n_dev)

@@ -287,6 +287,36 @@ class ExecutionPlan:
             sketch_dim=int(self.sketch_dim),
             sketch_exact_every=int(self.sketch_exact_every))
 
+    # -- cost model (ISSUE 19) ------------------------------------------
+
+    def cost_inputs(self) -> dict:
+        """The normalized lane + layout inputs the roofline cost model
+        instantiates its per-iteration formulas from
+        (:mod:`~cnmf_torch_tpu.obs.costmodel` — everything it needs
+        beyond the problem shape, which arrives per dispatch). Plain
+        data, stable keys: a costmodel built from a replayed plan must
+        predict identically."""
+        return {
+            "beta": float(self.beta),
+            "kernel": str(self.kernel),
+            "use_ell": bool(self.use_ell),
+            "use_pallas": bool(self.use_pallas),
+            "bf16_ratio": bool(self.bf16_ratio),
+            "packed": bool(self.packed),
+            "layout": str(self.layout),
+            "ell_width": (int(self.ell_width)
+                          if self.ell_width is not None else None),
+            "density": (float(self.density)
+                        if self.density is not None else None),
+            "mesh_devices": int(self.mesh_devices),
+            "grid_shape": (list(self.grid_shape)
+                           if self.grid_shape else None),
+            "grid_blocks": (int(self.grid_blocks)
+                            if self.grid_blocks is not None else None),
+            "recipe_algo": str(self.recipe_algo),
+            "inner_repeats": int(self.inner_repeats),
+        }
+
 
 # ---------------------------------------------------------------------------
 # building

@@ -329,6 +329,11 @@ class ProjectionService:
         self._lat_dropped = 0
         # sliding-window SLO tracker, armed by CNMF_TPU_SLO_P99_MS
         self._slo = obs_slo.tracker_from_env()
+        # roofline accounting (ISSUE 19): per-dispatch analytic cost +
+        # solve wall accumulated here, flushed as ONE perf_model event
+        # at daemon shutdown (emit_perf_model)
+        self._perf = {"solve_s": 0.0, "flops": 0.0, "bytes": 0.0,
+                      "lanes": 0, "batches": 0}
 
     # -- lifecycle -----------------------------------------------------
 
@@ -692,6 +697,11 @@ class ProjectionService:
 
         health = lane_health(rel_all, spectra=H_all)
 
+        from ..obs.costmodel import serve_project_cost
+
+        perf_c = serve_project_cost(int(b_pad), int(n_pad), g, k,
+                                    beta=ref.beta,
+                                    iters=int(ref.chunk_max_iter))
         with self._lock:
             self._stats["batches"] += 1
             self._stats["lanes_total"] += len(lanes)
@@ -699,6 +709,11 @@ class ProjectionService:
                                            len(lanes))
             if len(batch) > 1:
                 self._stats["multi_request_batches"] += 1
+            self._perf["solve_s"] += solve_ms / 1e3
+            self._perf["flops"] += perf_c["flops"]
+            self._perf["bytes"] += perf_c["bytes"]
+            self._perf["lanes"] += len(lanes)
+            self._perf["batches"] += 1
         if self.events is not None:
             self.events.emit(
                 "serve_batch", lanes=len(lanes), requests=len(batch),
@@ -874,6 +889,38 @@ class ProjectionService:
             self.events.emit("serve_request", tenant=str(tenant),
                              n_cells=int(n_cells), status=status,
                              **fields)
+
+    def emit_perf_model(self):
+        """Flush the accumulated serve-dispatch roofline accounting as
+        ONE ``perf_model`` event (ISSUE 19) — called at daemon
+        shutdown, after the batcher drained. No-op without telemetry +
+        CNMF_TPU_PERF_MODEL, or when nothing dispatched."""
+        from ..obs.costmodel import (chip_peaks, perf_model_enabled,
+                                     roofline)
+
+        if self.events is None or not perf_model_enabled():
+            return
+        with self._lock:
+            perf = dict(self._perf)
+        if not perf.get("batches"):
+            return
+        try:
+            import jax
+
+            kind = jax.devices()[0].device_kind
+            backend = jax.default_backend()
+        except Exception:
+            kind, backend = None, "unknown"
+        roof = roofline(perf["flops"], perf["bytes"], perf["solve_s"],
+                        chip_peaks(kind), perf_exempt=backend != "tpu")
+        self.events.emit(
+            "perf_model", stage="serve", lane="serve-project",
+            predicted={"flops": perf["flops"], "bytes": perf["bytes"],
+                       "iters_assumed_cap": True},
+            measured={"wall_s": round(perf["solve_s"], 4),
+                      "passes": int(perf["batches"]),
+                      "lanes": int(perf["lanes"])},
+            roofline=roof)
 
     def stats(self) -> dict:
         from ..utils.profiling import latency_summary

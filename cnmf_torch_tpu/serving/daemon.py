@@ -446,6 +446,12 @@ def serve_forever(run_dir: str, k: int | None = None,
         if snapshotter is not None:
             snapshotter.stop()
         daemon.close()
+        # one perf_model event over the whole serve session (ISSUE 19):
+        # emitted after close so every dispatch is in the accounting
+        try:
+            service.emit_perf_model()
+        except Exception:
+            pass
         for sig, handler in prev.items():
             try:
                 signal.signal(sig, handler)

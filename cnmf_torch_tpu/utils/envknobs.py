@@ -349,6 +349,25 @@ REGISTRY: dict[str, Knob] = _knobs(
          "within the last window count toward the p99/error-rate "
          "verdict (an observation exactly one window old has just "
          "aged out)"),
+    Knob("CNMF_TPU_PERF_MODEL", "flag", "`0`",
+         "`1` emits `perf_model` telemetry events (`obs/costmodel.py`): "
+         "per-stage/per-kernel-lane analytic flop/byte/collective "
+         "predictions from the ExecutionPlan joined with the measured "
+         "walls — achieved MFU, bandwidth fraction, and the compute- "
+         "vs memory-bound roofline verdict rendered by `cnmf-tpu "
+         "report`. Host-side accounting only: compiled programs are "
+         "byte-identical either way (requires telemetry to be on to "
+         "land anywhere)"),
+    Knob("CNMF_TPU_PERF_GATE_BAND", "float", "`0.6`",
+         "relative band a comparable bench metric must move past "
+         "before `cnmf-tpu benchdiff` / scripts/perf_gate.py flags a "
+         "regression: generous by default for oversubscribed CI "
+         "containers whose honest walls wobble; tighten on calm "
+         "dedicated hardware"),
+    Knob("CNMF_TPU_PERF_GATE_N", "int", "`3`",
+         "perf-gate sample count: gate walls are measured N times and "
+         "compared min-of-N (the low-noise estimator under scheduler "
+         "interference)"),
     # -- fault tolerance ---------------------------------------------------
     Knob("CNMF_TPU_MAX_RETRIES", "int", "`2`",
          "retry budget per unhealthy (nonfinite) replicate: each attempt "

@@ -123,6 +123,17 @@ EVENT_TYPES = {
     # verdict that `/healthz` was serving at that moment)
     "span": {"trace", "span", "name", "start_ts", "wall_ms"},
     "metrics_snapshot": {"metrics"},
+    # roofline cost model (obs/costmodel.py, ISSUE 19): one event per
+    # (stage, kernel lane) joining the ExecutionPlan-derived analytic
+    # predictions (flops / bytes / collective bytes per pass) with the
+    # measured wall for that lane. `predicted` carries the per-pass
+    # counts and pass multiplicity, `measured` the wall + work actually
+    # done, `roofline` the verdict (achieved MFU, achieved bandwidth
+    # fraction, arithmetic intensity vs the machine balance point,
+    # compute- vs memory-bound call, peak provenance, perf_exempt flag
+    # for interpret-mode/CPU runs). Rendered as the report's
+    # "Roofline" section and consumed by scripts/perf_gate.py
+    "perf_model": {"stage", "lane", "predicted", "measured", "roofline"},
 }
 
 # per-record required fields inside a "replicates" event's records list
@@ -472,6 +483,19 @@ def validate_event(ev: dict) -> None:
                 raise ValueError(f"span.{field} must be numeric: {ev}")
     if t == "metrics_snapshot" and not isinstance(ev["metrics"], dict):
         raise ValueError("metrics_snapshot.metrics must be an object")
+    if t == "perf_model":
+        for field in ("predicted", "measured", "roofline"):
+            if not isinstance(ev[field], dict):
+                raise ValueError(f"perf_model.{field} must be an object: {ev}")
+        for field in ("flops", "bytes"):
+            if not isinstance(ev["predicted"].get(field), (int, float)):
+                raise ValueError(
+                    f"perf_model.predicted.{field} must be numeric: {ev}")
+        if not isinstance(ev["measured"].get("wall_s"), (int, float)):
+            raise ValueError(
+                f"perf_model.measured.wall_s must be numeric: {ev}")
+        if not isinstance(ev["roofline"].get("bound"), str):
+            raise ValueError(f"perf_model.roofline.bound must be a str: {ev}")
 
 
 def validate_events_file(path: str) -> int:
@@ -856,6 +880,33 @@ def summarize_events(events: list[dict]) -> dict:
     if slo_ev is not None:
         summary["slo"] = slo_ev["slo"]
 
+    # roofline cost model (ISSUE 19): one row per (stage, kernel lane)
+    # joining predicted work with the measured wall — achieved MFU,
+    # achieved bandwidth fraction, and the compute-/memory-bound call.
+    # Interpret-mode / nominal-peak rows carry perf_exempt so consumers
+    # (the perf gate, benchdiff) skip them instead of comparing
+    perf_rows = []
+    for e in events:
+        if e["t"] != "perf_model":
+            continue
+        pred = e.get("predicted") or {}
+        meas = e.get("measured") or {}
+        roof = e.get("roofline") or {}
+        row = {"stage": e.get("stage"), "lane": e.get("lane"),
+               "wall_s": meas.get("wall_s"),
+               "passes": meas.get("passes"),
+               "flops": pred.get("flops"), "bytes": pred.get("bytes"),
+               "mfu": roof.get("mfu"), "bw_frac": roof.get("bw_frac"),
+               "intensity": roof.get("intensity"),
+               "bound": roof.get("bound"),
+               "peak_source": roof.get("peak_source"),
+               "perf_exempt": bool(roof.get("perf_exempt"))}
+        if pred.get("collective_bytes"):
+            row["collective_bytes"] = pred["collective_bytes"]
+        perf_rows.append(row)
+    if perf_rows:
+        summary["roofline"] = perf_rows
+
     mem_peak = 0
     mem_stage = None
     for e in events:
@@ -1179,6 +1230,36 @@ def render_report(run_dir: str) -> str:
             f"{slo.get('errors', 0)} "
             f"(rate {slo.get('error_rate', 0.0):.4f}, budget "
             f"{slo.get('max_error_rate', 0.0):.4f})")
+
+    roof = summary.get("roofline")
+    if roof:
+        lines.append("")
+        lines.append("Roofline")
+        lines.append("-" * 8)
+        lines.append(f"  {'stage':<22s} {'lane':<14s} {'wall':>9s} "
+                     f"{'MFU':>7s} {'BW':>7s} {'int.':>8s}  verdict")
+        for r in roof:
+            mfu, bw = r.get("mfu"), r.get("bw_frac")
+            inten = r.get("intensity")
+            wall = r.get("wall_s")
+            verdict = str(r.get("bound") or "?")
+            if r.get("perf_exempt"):
+                verdict += " (perf-exempt)"
+            if r.get("peak_source") and r.get("peak_source") != "datasheet":
+                verdict += f" [{r['peak_source']}]"
+            lines.append(
+                "  "
+                f"{str(r.get('stage'))[:22]:<22s} "
+                f"{str(r.get('lane'))[:14]:<14s} "
+                + (f"{wall:>8.3f}s" if isinstance(wall, (int, float))
+                   else f"{'n/a':>9s}") + " "
+                + (f"{100 * mfu:>6.2f}%" if isinstance(mfu, (int, float))
+                   else f"{'n/a':>7s}") + " "
+                + (f"{100 * bw:>6.2f}%" if isinstance(bw, (int, float))
+                   else f"{'n/a':>7s}") + " "
+                + (f"{inten:>8.2f}" if isinstance(inten, (int, float))
+                   else f"{'n/a':>8s}")
+                + f"  {verdict}")
 
     spans = summary.get("spans")
     if spans:

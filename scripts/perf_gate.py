@@ -1,0 +1,172 @@
+"""Tier-1 continuous perf-regression gate (scripts/verify_tier1.sh, ISSUE 19).
+
+Machinery self-test first, fingerprint-keyed baseline gate second:
+
+  * self-test — measures one pinned dense MU lane twice (min-of-N
+    walls, N = CNMF_TPU_PERF_GATE_N), builds two cnmf-bench snapshots
+    (obs/regress.py schema), and asserts the noise-aware diff is GREEN
+    on the honest re-measurement and RED after injecting a synthetic
+    2x slowdown into the candidate's wall samples — both verdicts
+    end-to-end through the ``cnmf-tpu benchdiff`` CLI (exit 0 / 1);
+  * baseline gate — when ``scripts/perf_baselines/<fingerprint>.json``
+    exists for THIS device fingerprint, today's measurement must stay
+    within the relative band of it (CNMF_TPU_PERF_GATE_BAND, default
+    ±60%: honest walls on a 2-core oversubscribed container wobble,
+    min-of-N plus the band absorb it). A baseline recorded on
+    different hardware is exempt by construction — the fingerprint key
+    means it can never red a run it cannot speak for.
+
+``--write-baseline`` records the current measurement as the new
+baseline for this fingerprint.
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# pinned gate lane: small enough that min-of-N stays honest on the
+# 2-core tier-1 container, big enough that the wall is ms-scale (not
+# dominated by dispatch overhead)
+GATE_SHAPE = (96, 64, 7)  # (n, g, k)
+GATE_ITERS = 150
+
+
+def _fail(msg: str) -> int:
+    print("perf gate: " + msg, file=sys.stderr)
+    return 1
+
+
+def _measure(n_samples: int) -> dict:
+    """Min-of-N wall for GATE_ITERS dense beta=2 MU iterations at the
+    pinned shape (compile excluded; tol=0 pins the trip count)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cnmf_torch_tpu.ops.nmf import dense_update_cost, nmf_fit_batch
+
+    n, g, k = GATE_SHAPE
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.random((n, g)), jnp.float32)
+    H0 = jnp.asarray(rng.random((n, k)), jnp.float32)
+    W0 = jnp.asarray(rng.random((k, g)), jnp.float32)
+    fit = jax.jit(lambda X, H, W: nmf_fit_batch(
+        X, H, W, beta=2.0, tol=0.0, max_iter=GATE_ITERS))
+    jax.block_until_ready(fit(X, H0, W0))  # compile outside the clock
+    samples = []
+    for _ in range(max(1, n_samples)):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fit(X, H0, W0))
+        samples.append(time.perf_counter() - t0)
+    cost = dense_update_cost(n, g, k, 2.0)
+    wall = min(samples)
+    return {"samples": samples, "wall_s": wall,
+            "gflops": cost["flops"] * GATE_ITERS / wall / 1e9}
+
+
+def _snapshot(meas: dict, fingerprint: str, created: float,
+              label: str) -> dict:
+    from cnmf_torch_tpu.obs.regress import build_snapshot, validate_bench
+
+    raw = {"update_wall_s": meas["wall_s"],
+           "achieved_gflops": meas["gflops"],
+           "n": GATE_SHAPE[0], "g": GATE_SHAPE[1], "k": GATE_SHAPE[2],
+           "iters": GATE_ITERS}
+    snap = build_snapshot({"gate": raw}, fingerprint=fingerprint,
+                          created=created, label=label)
+    # the full sample list rides along so diff's min-of-N estimator has
+    # the noise floor, not one draw
+    snap["tiers"]["gate"]["metrics"]["update_wall_s"]["samples"] = \
+        [float(s) for s in meas["samples"]]
+    validate_bench(snap)
+    return snap
+
+
+def _benchdiff_cli(a: str, b: str) -> tuple[int, str]:
+    p = subprocess.run(
+        [sys.executable, "-m", "cnmf_torch_tpu", "benchdiff", a, b],
+        env=dict(os.environ), capture_output=True, text=True, timeout=120)
+    return p.returncode, (p.stdout or "") + (p.stderr or "")
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="record the current measurement as the "
+                             "baseline for this device fingerprint")
+    args = parser.parse_args()
+
+    from cnmf_torch_tpu.obs.regress import (diff_snapshots, gate_n,
+                                            load_snapshot, render_diff,
+                                            save_snapshot)
+    from cnmf_torch_tpu.utils.autotune import device_fingerprint
+
+    fp = device_fingerprint()
+    n = gate_n()
+    workdir = tempfile.mkdtemp(prefix="perf_gate_")
+    try:
+        # -- self-test: green on an honest re-measurement ------------------
+        snap_a = _snapshot(_measure(n), fp, time.time(), "gate-base")
+        snap_b = _snapshot(_measure(n), fp, time.time(), "gate-new")
+        path_a = save_snapshot(snap_a, os.path.join(workdir, "a.json"))
+        path_b = save_snapshot(snap_b, os.path.join(workdir, "b.json"))
+        rc, out = _benchdiff_cli(path_a, path_b)
+        if rc != 0 or "=> OK" not in out:
+            return _fail(f"self-test GREEN leg failed (exit {rc}):\n{out}")
+
+        # -- self-test: red on an injected 2x lane slowdown ----------------
+        snap_red = copy.deepcopy(snap_b)
+        m = snap_red["tiers"]["gate"]["metrics"]["update_wall_s"]
+        m["value"] = 2.0 * float(m["value"])
+        m["samples"] = [2.0 * float(s) for s in m["samples"]]
+        path_red = save_snapshot(snap_red, os.path.join(workdir, "red.json"))
+        rc_red, out_red = _benchdiff_cli(path_a, path_red)
+        if rc_red != 1 or "regressed" not in out_red \
+                or "=> RED" not in out_red:
+            return _fail(f"self-test RED leg failed to regress "
+                         f"(exit {rc_red}):\n{out_red}")
+
+        # -- baseline gate (fingerprint-keyed, optional) -------------------
+        base_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "perf_baselines")
+        safe_fp = "".join(c if c.isalnum() or c in "._-" else "_"
+                          for c in fp)
+        base_path = os.path.join(base_dir, safe_fp + ".json")
+        baseline_note = "no baseline recorded for this fingerprint"
+        if args.write_baseline:
+            save_snapshot(snap_b, base_path)
+            baseline_note = f"baseline written to {base_path}"
+        elif os.path.isfile(base_path):
+            diff = diff_snapshots(load_snapshot(base_path), snap_b)
+            print(render_diff(diff))
+            if not diff["ok"]:
+                return _fail(f"regression vs recorded baseline "
+                             f"{base_path}")
+            baseline_note = (f"within band of baseline {base_path} "
+                             f"({diff['improvements']} improvement(s))")
+
+        wall_ms = 1e3 * min(snap_b["tiers"]["gate"]["raw"]["update_wall_s"],
+                            snap_a["tiers"]["gate"]["raw"]["update_wall_s"])
+        print(f"perf gate: self-test green on re-measurement and red on "
+              f"injected 2x slowdown (benchdiff exits 0/1), min-of-{n} "
+              f"gate wall {wall_ms:.1f} ms at {GATE_SHAPE}, fingerprint "
+              f"{fp}; {baseline_note}")
+        return 0
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

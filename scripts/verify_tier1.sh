@@ -214,4 +214,23 @@ if [ "$rc" -eq 0 ]; then
     exit 1
   fi
 fi
+
+# perf gate: the continuous perf-regression lane (scripts/perf_gate.py,
+# ISSUE 19) — measures a pinned dense MU lane min-of-N twice, asserts
+# the noise-aware benchdiff machinery is green on the honest
+# re-measurement AND red on an injected 2x lane slowdown (both
+# end-to-end through `cnmf-tpu benchdiff`, exit 0/1), then gates
+# against scripts/perf_baselines/<fingerprint>.json when one exists for
+# this hardware (band CNMF_TPU_PERF_GATE_BAND, default +-60% to honor
+# the oversubscribed-container noise floor)
+if [ "$rc" -eq 0 ]; then
+  echo "[tier1] perf gate (benchdiff self-test + fingerprint baseline) ..."
+  if timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      python scripts/perf_gate.py; then
+    echo PERF_GATE=ok
+  else
+    echo PERF_GATE=fail
+    exit 1
+  fi
+fi
 exit $rc

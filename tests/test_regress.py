@@ -1,0 +1,208 @@
+"""Perf-regression observatory tests (ISSUE 19, obs/regress.py): the
+cnmf-bench snapshot schema (build/validate/save/load round-trip, pinned
+so bench.py --json-out output stays machine-readable across rounds),
+metric extraction + direction classification, and the noise-aware diff:
+green on identical results, red on a 2x lane slowdown, improvements
+counted separately, min-of-N sample estimators, and the fingerprint key
+exempting cross-hardware comparisons. Plus the benchdiff CLI exit
+semantics the perf gate scripts rely on."""
+
+import copy
+import json
+import subprocess
+import sys
+
+import pytest
+
+from cnmf_torch_tpu.obs import regress as rg
+
+RAW = {
+    "serve": {"qps": 500.0, "latency_ms": {"p50": 10.0, "p99": 20.0,
+                                           "count": 360,
+                                           "histogram": {"<=10": 160}},
+              "vs_baseline": 0.5, "requests": 360, "ok": True,
+              "latency_samples_kept": 390},
+    "kl": {"wall_seconds": 4.0, "mfu": 0.02, "error": None},
+}
+
+
+def _snap(raw=None, fingerprint="fp-a", created=1000.0, label=None):
+    return rg.build_snapshot(raw if raw is not None else RAW,
+                             fingerprint=fingerprint, created=created,
+                             label=label)
+
+
+# ---------------------------------------------------------------------------
+# schema: extraction, validation, round-trip
+# ---------------------------------------------------------------------------
+
+def test_extract_metrics_direction_classification():
+    m = rg.extract_metrics(RAW["serve"])
+    assert m["qps"]["direction"] == "higher"
+    assert m["latency_ms.p50"]["direction"] == "lower"
+    assert m["latency_ms.p99"]["direction"] == "lower"
+    # no gate metric from: vs_baseline ratios, bare counts/config ints,
+    # histogram bucket occupancy, reservoir honesty counters, booleans
+    for absent in ("vs_baseline", "requests", "ok", "latency_ms.count",
+                   "latency_ms.histogram.<=10", "latency_samples_kept"):
+        assert absent not in m
+    mk = rg.extract_metrics(RAW["kl"])
+    assert mk["wall_seconds"]["direction"] == "lower"
+    assert mk["mfu"]["direction"] == "higher"
+
+
+def test_snapshot_round_trip(tmp_path):
+    snap = _snap(label="round-trip")
+    path = rg.save_snapshot(snap, str(tmp_path / "deep" / "snap.json"))
+    loaded = rg.load_snapshot(path)
+    assert loaded == snap
+    assert loaded["schema"] == rg.BENCH_SCHEMA
+    assert loaded["schema_version"] == rg.BENCH_SCHEMA_VERSION
+    assert loaded["label"] == "round-trip"
+    # the raw ad-hoc payload survives verbatim next to the typed metrics
+    assert loaded["tiers"]["serve"]["raw"]["latency_ms"]["p50"] == 10.0
+
+
+def test_validate_rejects_malformed_docs():
+    good = _snap()
+    for breakage in (
+            {"schema": "something-else"},
+            {"schema_version": 99},
+            {"fingerprint": None},
+            {"tiers": {"kl": {"metrics": "fast"}}},
+            {"tiers": {"kl": {"metrics": {"wall_seconds": {
+                "value": "4", "direction": "lower"}}}}},
+            {"tiers": {"kl": {"metrics": {"wall_seconds": {
+                "value": 4.0, "direction": "sideways"}}}}},
+            {"tiers": {"kl": {"metrics": {"wall_seconds": {
+                "value": 4.0, "direction": "lower",
+                "samples": [1.0, "x"]}}}}},
+    ):
+        with pytest.raises(ValueError):
+            rg.validate_bench({**good, **breakage})
+    with pytest.raises(ValueError):
+        rg.validate_bench([good])
+
+
+def test_error_tier_is_perf_exempt():
+    snap = _snap({"kl": {"wall_seconds": 4.0, "error": "timeout"},
+                  "serve": {"qps": 10.0, "perf_exempt": True}})
+    assert snap["tiers"]["kl"]["perf_exempt"] is True
+    assert snap["tiers"]["serve"]["perf_exempt"] is True
+
+
+# ---------------------------------------------------------------------------
+# noise-aware diff
+# ---------------------------------------------------------------------------
+
+def test_diff_green_on_identical():
+    d = rg.diff_snapshots(_snap(), _snap(), band=0.1)
+    assert d["ok"] is True and d["regressions"] == 0
+    assert all(r["verdict"] in ("ok", "exempt") for r in d["rows"])
+    assert "=> OK" in rg.render_diff(d)
+
+
+def test_diff_red_on_2x_lane_slowdown():
+    new = copy.deepcopy(RAW)
+    new["kl"]["wall_seconds"] = 8.0  # the injected 2x
+    d = rg.diff_snapshots(_snap(), _snap(new), band=0.6)
+    red = [r for r in d["rows"] if r["verdict"] == "regressed"]
+    assert d["ok"] is False and d["regressions"] == 1
+    assert red[0]["tier"] == "kl" and red[0]["metric"] == "wall_seconds"
+    assert red[0]["rel"] == pytest.approx(1.0)
+    assert "=> RED" in rg.render_diff(d)
+
+
+def test_diff_direction_for_higher_better_metrics():
+    worse = copy.deepcopy(RAW)
+    worse["serve"]["qps"] = 100.0  # throughput collapse = regression
+    d = rg.diff_snapshots(_snap(), _snap(worse), band=0.6)
+    assert {(r["tier"], r["metric"]) for r in d["rows"]
+            if r["verdict"] == "regressed"} == {("serve", "qps")}
+    better = copy.deepcopy(RAW)
+    better["serve"]["qps"] = 2000.0
+    d2 = rg.diff_snapshots(_snap(), _snap(better), band=0.6)
+    assert d2["ok"] is True and d2["improvements"] == 1
+
+
+def test_diff_min_of_n_samples_absorb_noise():
+    base, new = _snap(), _snap()
+    m = new["tiers"]["kl"]["metrics"]["wall_seconds"]
+    # one quiet sample among noisy ones: min-of-N keeps the lane green
+    m["samples"] = [9.0, 4.1, 12.0]
+    m["value"] = 9.0
+    rg.validate_bench(new)
+    d = rg.diff_snapshots(base, new, band=0.2)
+    row = [r for r in d["rows"] if r["metric"] == "wall_seconds"][0]
+    assert row["new"] == 4.1 and row["verdict"] == "ok"
+    # higher-is-better uses max-of-N
+    assert rg._effective({"value": 1.0, "direction": "higher",
+                          "samples": [1.0, 3.0, 2.0]}) == 3.0
+
+
+def test_diff_fingerprint_mismatch_exempts_everything():
+    new = copy.deepcopy(RAW)
+    new["kl"]["wall_seconds"] = 400.0
+    d = rg.diff_snapshots(_snap(), _snap(new, fingerprint="fp-b"),
+                          band=0.1)
+    assert d["ok"] is True and d["fingerprint_match"] is False
+    assert all(r["verdict"] in ("exempt", "missing") for r in d["rows"])
+    assert "fingerprints differ" in rg.render_diff(d)
+
+
+def test_diff_missing_tier_and_metric_reported_not_gated():
+    base = _snap({"kl": {"wall_seconds": 4.0},
+                  "serve": {"qps": 500.0}})
+    new = _snap({"kl": {"wall_seconds": 4.0, "compile_seconds": 1.0}})
+    d = rg.diff_snapshots(base, new, band=0.1)
+    verdicts = {(r["tier"], r["metric"]): r["verdict"] for r in d["rows"]}
+    assert verdicts[("serve", "*")] == "missing"
+    assert verdicts[("kl", "compile_seconds")] == "missing"
+    assert d["ok"] is True
+
+
+def test_gate_band_and_n_knobs(monkeypatch):
+    assert rg.gate_band() == rg.DEFAULT_BAND
+    assert rg.gate_n() == rg.DEFAULT_N
+    monkeypatch.setenv(rg.GATE_BAND_ENV, "0.25")
+    monkeypatch.setenv(rg.GATE_N_ENV, "5")
+    assert rg.gate_band() == 0.25
+    assert rg.gate_n() == 5
+    d = rg.diff_snapshots(_snap(), _snap())
+    assert d["band"] == 0.25
+
+
+def test_zero_baseline_edge():
+    base = _snap({"kl": {"wall_seconds": 0.0}})
+    same = _snap({"kl": {"wall_seconds": 0.0}})
+    worse = _snap({"kl": {"wall_seconds": 1.0}})
+    assert rg.diff_snapshots(base, same, band=0.1)["ok"] is True
+    d = rg.diff_snapshots(base, worse, band=0.1)
+    assert d["ok"] is False
+    row = [r for r in d["rows"] if r["metric"] == "wall_seconds"][0]
+    assert row["rel"] is None  # inf is reported as unrepresentable
+
+
+# ---------------------------------------------------------------------------
+# benchdiff CLI exit semantics (what scripts/perf_gate.py relies on)
+# ---------------------------------------------------------------------------
+
+def test_benchdiff_cli_exit_codes(tmp_path):
+    a = rg.save_snapshot(_snap(), str(tmp_path / "a.json"))
+    worse = copy.deepcopy(RAW)
+    worse["kl"]["wall_seconds"] = 8.0
+    b = rg.save_snapshot(_snap(worse), str(tmp_path / "b.json"))
+
+    green = subprocess.run(
+        [sys.executable, "-m", "cnmf_torch_tpu", "benchdiff", a, a],
+        capture_output=True, text=True, timeout=120)
+    assert green.returncode == 0, green.stderr
+    assert "=> OK" in green.stdout
+
+    red = subprocess.run(
+        [sys.executable, "-m", "cnmf_torch_tpu", "benchdiff", a, b,
+         "--band", "0.6", "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert red.returncode == 1, red.stderr
+    doc = json.loads(red.stdout)
+    assert doc["ok"] is False and doc["regressions"] == 1
