@@ -69,11 +69,11 @@ _CHIP_PEAKS = {
 }
 
 TIERS = ["north_star", "anchor", "kl", "accel", "sketch", "plan", "mfu",
-         "rowshard", "grid2d", "ingest", "serve", "harmony"]
+         "rowshard", "grid2d", "ingest", "serve", "fleet", "harmony"]
 TIER_TIMEOUT_S = {"north_star": 2400, "anchor": 1200, "kl": 1800,
                   "accel": 1200, "sketch": 1200, "plan": 1200, "mfu": 900,
                   "rowshard": 1500, "grid2d": 1200, "ingest": 1200,
-                  "serve": 1200, "harmony": 1500}
+                  "serve": 1200, "fleet": 1800, "harmony": 1500}
 
 
 def synthetic_pbmc_like(n=2700, g=2000, k_true=12, seed=0, scale=400.0):
@@ -1748,6 +1748,123 @@ def bench_serve():
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_fleet():
+    """ISSUE 20 tier: the replicated serving fleet under sustained
+    concurrent load at 1, 2, and 4 replicas — the same multi-tenant
+    request stream routed through the consistent-hash router over REAL
+    serve daemon subprocesses, reporting sustained QPS and the
+    p50/p95/p99 client-side latency histogram per fleet size (shared
+    helper: utils/profiling.latency_summary). On a single host the
+    replicas share the device, so this measures routing + process
+    overhead and tail behavior, not linear scaling."""
+    from cnmf_torch_tpu import cNMF
+    from cnmf_torch_tpu.serving.fleet import FleetClient, FleetDaemon, \
+        FleetRouter
+    from cnmf_torch_tpu.utils import save_df_to_npz
+    from cnmf_torch_tpu.utils.profiling import latency_summary
+
+    # replica subprocesses inherit this env; keep their telemetry off
+    # (router-side accounting only) and the shared XLA compile cache on
+    # so fleet warmup measures process + reference staging, not
+    # recompiles
+    os.environ.setdefault("CNMF_TPU_TELEMETRY", "0")
+    n, g, k = 400, 200, 5
+    workdir = tempfile.mkdtemp(prefix="bench_fleet_")
+    try:
+        save_df_to_npz(synthetic_counts_df(n, g, k_true=k, seed=23),
+                       os.path.join(workdir, "counts.df.npz"))
+        obj = cNMF(output_dir=workdir, name="flt")
+        obj.prepare(os.path.join(workdir, "counts.df.npz"),
+                    components=[k], n_iter=20, seed=23,
+                    num_highvar_genes=150)
+        obj.factorize()
+        obj.combine()
+        obj.consensus(k=k, density_threshold=2.0, show_clustering=False)
+        run_dir = os.path.join(workdir, "flt")
+
+        n_clients, reqs_per_client = 6, 40
+        sizes = (16, 32, 64, 96, 128)
+        rng = np.random.default_rng(29)
+        n_genes = 150
+        queries = [rng.gamma(1.0, 1.0, size=(s, n_genes))
+                   .astype(np.float32) for s in sizes]
+
+        def run_client(sock, idx, n_reqs, record):
+            cli = FleetClient(socket_path=sock, timeout=180.0)
+            for j in range(n_reqs):
+                X = queries[(idx + j) % len(queries)]
+                t1 = time.perf_counter()
+                cli.project(X, tenant=f"tenant{idx}",
+                            request_id=f"b-{idx}-{j}")
+                if record is not None:
+                    record.append((time.perf_counter() - t1) * 1e3)
+
+        out = {"clients": n_clients,
+               "requests_per_fleet": n_clients * reqs_per_client,
+               "request_rows": list(sizes), "fleets": {}}
+        for replicas in (1, 2, 4):
+            router = FleetRouter(run_dir, replicas=replicas)
+            sock = os.path.join(workdir, f"fleet{replicas}.sock")
+            daemon = FleetDaemon(router, socket_path=sock)
+            t0 = time.perf_counter()
+            daemon.start()
+            warm_s = time.perf_counter() - t0
+            try:
+                # untimed warmup: every tenant's route + program warm
+                warm = [threading.Thread(target=run_client,
+                                         args=(sock, i, 4, None))
+                        for i in range(n_clients)]
+                for t in warm:
+                    t.start()
+                for t in warm:
+                    t.join()
+                lat_by_client = [[] for _ in range(n_clients)]
+                t0 = time.perf_counter()
+                threads = [threading.Thread(
+                    target=run_client,
+                    args=(sock, i, reqs_per_client, lat_by_client[i]))
+                    for i in range(n_clients)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                wall = time.perf_counter() - t0
+                lat_ms = [v for lats in lat_by_client for v in lats]
+                stats = router.stats()
+            finally:
+                daemon.close()
+            shares = sorted(r["requests"] for r in stats["replicas"])
+            out["fleets"][str(replicas)] = {
+                "replicas": replicas,
+                "fleet_warmup_seconds": round(warm_s, 3),
+                "qps": round(len(lat_ms) / wall, 1),
+                "latency_ms": {kk: (round(v, 3) if isinstance(v, float)
+                                    else v)
+                               for kk, v in
+                               latency_summary(lat_ms).items()},
+                "requests_ok": stats["ok"],
+                "router_retries": stats["retries"],
+                "requests_by_replica": shares,
+            }
+        one = out["fleets"]["1"]["qps"]
+        out["qps_1_replica"] = one
+        out["qps_2_replicas"] = out["fleets"]["2"]["qps"]
+        out["qps_4_replicas"] = out["fleets"]["4"]["qps"]
+        out["p99_ms_2_replicas"] = \
+            out["fleets"]["2"]["latency_ms"].get("p99")
+        out["telemetry"] = _tier_telemetry()
+        # acceptance gates as booleans the driver can read
+        out["all_requests_ok"] = bool(all(
+            f["requests_ok"] >= n_clients * reqs_per_client
+            for f in out["fleets"].values()))
+        out["load_spread_over_replicas"] = bool(
+            sum(1 for s in out["fleets"]["4"]["requests_by_replica"]
+                if s > 0) >= 2)
+        return out
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def bench_harmony():
     """Config 4 shape (Baron islets: ~8.5k cells, 4 donors): Preprocess
     (HVG -> PCA -> Harmony -> gene-space MOE ridge) -> cNMF e2e."""
@@ -1882,8 +1999,8 @@ def main():
               "kl": bench_kl, "accel": bench_accel, "mfu": bench_mfu,
               "rowshard": bench_rowshard, "grid2d": bench_grid2d,
               "ingest": bench_ingest, "harmony": bench_harmony,
-              "serve": bench_serve, "sketch": bench_sketch,
-              "plan": bench_plan}[args.tier]
+              "serve": bench_serve, "fleet": bench_fleet,
+              "sketch": bench_sketch, "plan": bench_plan}[args.tier]
         result = fn()
         with open(args.out, "w") as f:
             json.dump(result, f)

@@ -1,8 +1,10 @@
 """Command-line interface: the reference's five subcommands, plus
 ``run_parallel`` (the launcher), ``report`` (render a run's telemetry —
-see ``utils/telemetry.py``), ``lint`` (static analysis), and ``serve``
+see ``utils/telemetry.py``), ``lint`` (static analysis), ``serve``
 (the warm projection daemon over a run's consensus reference —
-``cnmf_torch_tpu/serving/``).
+``cnmf_torch_tpu/serving/``), and ``fleet`` (the replicated serving
+fleet: tenant routing, failover, and reference rollover over N serve
+replicas — ``cnmf_torch_tpu/serving/fleet.py``).
 
 Flag-compatible with the reference CLI (``/root/reference/src/cnmf/cnmf.py:
 1387-1470``): ``prepare | factorize | combine | consensus |
@@ -39,10 +41,10 @@ def build_parser() -> argparse.ArgumentParser:
         "command", type=str,
         choices=["prepare", "factorize", "combine", "consensus",
                  "k_selection_plot", "run_parallel", "report", "lint",
-                 "serve", "plan", "trace"])
+                 "serve", "fleet", "plan", "trace"])
     parser.add_argument(
         "run_dir", type=str, nargs="?", default=None,
-        help="[report|serve|plan|trace] Run directory "
+        help="[report|serve|fleet|plan|trace] Run directory "
              "([output-dir]/[name]) whose telemetry to render / whose "
              "consensus reference to serve / whose resolved execution "
              "plan to show / whose sampled trace waterfalls to render; "
@@ -204,17 +206,27 @@ def build_parser() -> argparse.ArgumentParser:
                         help="[consensus] Produce a clustergram figure "
                              "summarizing the spectra clustering")
     parser.add_argument("--socket", type=str, default=None,
-                        help="[serve] Unix-socket path for the projection "
-                             "daemon (default: "
-                             "<run_dir>/cnmf_tmp/<name>.serve.sock)")
+                        help="[serve|fleet] Unix-socket path for the "
+                             "projection daemon / fleet router (default: "
+                             "<run_dir>/cnmf_tmp/<name>.serve.sock or "
+                             "<name>.fleet.sock)")
     parser.add_argument("--port", type=int, default=None,
-                        help="[serve] Serve HTTP on 127.0.0.1:PORT instead "
-                             "of the unix socket")
+                        help="[serve|fleet] Serve HTTP on 127.0.0.1:PORT "
+                             "instead of the unix socket")
     parser.add_argument("--spectra", type=str, default=None,
-                        help="[serve] Explicit reference spectra: a "
+                        help="[serve|fleet] Explicit reference spectra: a "
                              "consensus .df.npz artifact or a ShardStore "
                              "directory (overrides -k/--local-density-"
                              "threshold selection)")
+    parser.add_argument("--replicas", type=int, default=None,
+                        help="[fleet] Number of serve replicas to spawn "
+                             "and route over (default: "
+                             "CNMF_TPU_FLEET_REPLICAS)")
+    parser.add_argument("--replica-index", type=int, default=0,
+                        help="[serve] Replica ordinal within a fleet "
+                             "(fleet-internal: keys the daemon's "
+                             "heartbeat stamp and events stream so N "
+                             "replicas of one run dir never collide)")
     # BooleanOptionalAction repairs the reference's dead flag (store_true
     # with default=True can never be disabled, cnmf.py:1437): here
     # --no-build-reference actually turns starCAT output off
@@ -295,15 +307,15 @@ def main(argv=None):
                      "[paths ...] [--format text|json] [--baseline FILE] "
                      "[--write-baseline] [--knob-table]")
 
-    if args.command not in ("report", "serve", "plan", "trace") \
+    if args.command not in ("report", "serve", "fleet", "plan", "trace") \
             and args.run_dir is not None:
-        # the optional positional exists for `report`/`serve`/`plan`/
-        # `trace` only; for every other subcommand a stray positional
-        # (e.g. `consensus 9` meaning `-k 9`) must fail fast, not be
-        # silently swallowed
+        # the optional positional exists for `report`/`serve`/`fleet`/
+        # `plan`/`trace` only; for every other subcommand a stray
+        # positional (e.g. `consensus 9` meaning `-k 9`) must fail fast,
+        # not be silently swallowed
         parser.error(f"unrecognized argument: {args.run_dir!r} "
                      f"(a positional run directory applies to 'report', "
-                     f"'serve', 'plan', and 'trace' only)")
+                     f"'serve', 'fleet', 'plan', and 'trace' only)")
 
     if args.command == "plan":
         # like `report`: pure host-side rendering of the run's recorded
@@ -435,11 +447,36 @@ def main(argv=None):
             raise SystemExit(serve_forever(
                 run_dir, k=k, density_threshold=dt,
                 spectra_path=args.spectra,
-                socket_path=args.socket, port=args.port))
+                socket_path=args.socket, port=args.port,
+                replica=args.replica_index))
         except ReferenceError as exc:
             # a missing/ambiguous reference is a usage problem, not a
             # daemon crash — fail with the one-line diagnosis
             parser.error(f"serve: {exc}")
+
+    if args.command == "fleet":
+        # the replicated serving fleet (ISSUE 20): spawn N `serve`
+        # replicas, front them with the consistent-hash tenant router,
+        # and keep them alive (failover + respawn + rollover) until
+        # SIGINT/SIGTERM. Reference selection matches `serve`.
+        from .serving import ReferenceError, fleet_forever
+
+        run_dir = args.run_dir or os.path.join(args.output_dir, args.name)
+        if not os.path.isdir(run_dir):
+            parser.error(f"fleet: run directory not found: {run_dir}")
+        if args.socket is not None and args.port is not None:
+            parser.error("fleet: pass --socket or --port, not both")
+        if args.replicas is not None and args.replicas < 1:
+            parser.error("fleet: --replicas must be >= 1")
+        dt = args.local_density_threshold
+        k = args.components[0] if args.components else None
+        try:
+            raise SystemExit(fleet_forever(
+                run_dir, replicas=args.replicas, k=k,
+                density_threshold=dt, spectra_path=args.spectra,
+                socket_path=args.socket, port=args.port))
+        except ReferenceError as exc:
+            parser.error(f"fleet: {exc}")
 
     if args.command == "run_parallel":
         from .launcher import run_pipeline

@@ -39,6 +39,13 @@ spec into injected faults at fixed hook points in the pipeline:
     changes) that must degrade to the local cache or raise
     ``RemoteStoreError``, and a torn response body (``nettorn``,
     ``limit`` 1) the digest check must catch.
+  * ``replicadeath`` / ``replicawedge`` — serving-fleet faults
+    (``serving/fleet.py``): a matching clause tells the fleet router's
+    supervisor to SIGKILL (``replicadeath``) or SIGSTOP
+    (``replicawedge``) one of its serve replicas at the supervision
+    tick, so dead-replica failover and wedge conviction + respawn are
+    testable on demand. Selectors ``context``/``worker`` (the replica
+    slot index); both default ``limit`` 1.
 
 Spec grammar (semicolon-separated clauses)::
 
@@ -79,13 +86,15 @@ __all__ = [
     "maybe_straggle",
     "maybe_shard_read",
     "maybe_netfault",
+    "maybe_replicadeath",
+    "maybe_replicawedge",
 ]
 
 FAULT_SPEC_ENV = "CNMF_TPU_FAULT_SPEC"
 
 _KINDS = ("nonfinite", "kill", "torn", "upload", "stall", "hostloss",
           "straggler", "shard_read", "netflake", "netslow", "netdown",
-          "nettorn")
+          "nettorn", "replicadeath", "replicawedge")
 _CONTROL_KEYS = ("after", "limit", "once")
 
 
@@ -504,6 +513,47 @@ def maybe_netfault(op=None, context=None) -> str | None:
             "cnmf-tpu injected fault: %s (%s) — remote store unreachable"
             % (clause.kind, ctx))
     return None
+
+
+def maybe_replicadeath(context=None, worker=None) -> bool:
+    """True when a ``replicadeath`` clause matches — the injectable form
+    of a serve replica dying (OOM kill, preemption, segfault). The fleet
+    router's supervisor (``serving/fleet.py``) calls this once per up
+    replica per supervision tick with ``worker`` = the replica's slot
+    index and SIGKILLs the subprocess when it fires, so the next poll
+    sees a real dead process and the failover + respawn machinery runs
+    against the genuine article. ``limit`` defaults to 1 (one death per
+    clause; the respawned replica runs clean so recovery is
+    observable)."""
+    spec = active_spec()
+    if spec is None:
+        return False
+    for clause in spec:
+        if clause.kind != "replicadeath":
+            continue
+        if _clause_fires(clause, context, worker, default_limit=1):
+            return True
+    return False
+
+
+def maybe_replicawedge(context=None, worker=None) -> bool:
+    """True when a ``replicawedge`` clause matches — the injectable form
+    of a replica that is alive but unresponsive (GIL-bound spin, stuck
+    device dispatch, paging storm). The fleet supervisor SIGSTOPs the
+    subprocess when this fires: the process keeps its socket backlog
+    (connects succeed, replies never come) and its heartbeat goes stale,
+    which is exactly the evidence profile the wedge-conviction path must
+    convict on before SIGKILLing + respawning. ``limit`` defaults to
+    1."""
+    spec = active_spec()
+    if spec is None:
+        return False
+    for clause in spec:
+        if clause.kind != "replicawedge":
+            continue
+        if _clause_fires(clause, context, worker, default_limit=1):
+            return True
+    return False
 
 
 def maybe_fail(kind: str, **ctx) -> None:

@@ -14,18 +14,28 @@ This package assembles the existing ingredients into that service:
     ``refit_usage`` dispatch, with per-lane health grading and tenant
     quarantine;
   * ``daemon.py`` — stdlib HTTP/JSON front end (unix socket or
-    127.0.0.1 TCP) + client, behind ``cnmf-tpu serve <run_dir>``.
+    127.0.0.1 TCP) + client, behind ``cnmf-tpu serve <run_dir>``;
+  * ``fleet.py`` (ISSUE 20) — replicated fleet behind ``cnmf-tpu
+    fleet``: consistent-hash tenant routing over N serve replicas,
+    per-tenant admission quotas, chaos-tested failover with idempotent
+    retries, and zero-downtime reference rollover.
 
 Knobs: ``CNMF_TPU_SERVE_BATCH`` / ``_LINGER_MS`` / ``_BUCKETS`` /
-``_TIMEOUT_S`` / ``_WARM_START`` (see the README knob table).
-Telemetry: ``serve_request`` / ``serve_batch`` events, rendered by
-``cnmf-tpu report``; sustained-load numbers via ``bench.py --tier
-serve``.
+``_TIMEOUT_S`` / ``_WARM_START`` / ``_DRAIN_S`` and the
+``CNMF_TPU_FLEET_*`` family (see the README knob table).
+Telemetry: ``serve_request`` / ``serve_batch`` / ``replica_death`` /
+``failover`` / ``rollover`` events, rendered by ``cnmf-tpu report``;
+sustained-load numbers via ``bench.py --tier serve`` and ``--tier
+fleet``.
 """
 
 from .batcher import (PoisonError, ProjectionService, QuarantinedError,
                       ServeError, ShedError)
-from .daemon import ServeClient, ServeDaemon, default_socket_path, serve_forever
+from .daemon import (REQUEST_ID_HEADER, ServeClient, ServeDaemon,
+                     default_socket_path, serve_forever)
+from .fleet import (FleetClient, FleetDaemon, FleetRouter, HashRing,
+                    SubprocessReplica, TokenBucket,
+                    default_fleet_socket_path, fleet_forever)
 from .reference import (ReferenceError, ResidentReference, find_references,
                         load_reference)
 
@@ -35,10 +45,19 @@ __all__ = [
     "PoisonError",
     "QuarantinedError",
     "ProjectionService",
+    "REQUEST_ID_HEADER",
     "ServeClient",
     "ServeDaemon",
     "default_socket_path",
     "serve_forever",
+    "FleetClient",
+    "FleetDaemon",
+    "FleetRouter",
+    "HashRing",
+    "SubprocessReplica",
+    "TokenBucket",
+    "default_fleet_socket_path",
+    "fleet_forever",
     "ReferenceError",
     "ResidentReference",
     "find_references",

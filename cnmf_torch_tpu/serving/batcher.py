@@ -72,6 +72,13 @@ _LATENCY_SAMPLES = 4096
 # matrix fingerprint) — bounds daemon host memory against tenant growth
 _WARM_CACHE_ENTRIES = 256
 
+# idempotency claims kept (FIFO eviction): request_id -> its _Request,
+# so a router retry of an id that already solved (or graded poison)
+# gets the ORIGINAL reply instead of a second solve — the fleet's
+# at-most-once contract (ISSUE 20). Shed outcomes are forgotten on
+# purpose: shed means "not done, retry", so the retry must re-enter
+_IDEM_CACHE_ENTRIES = 1024
+
 
 class ServeError(RuntimeError):
     """Base class for request-level serve failures (maps to a clear
@@ -215,10 +222,10 @@ _req_ids = itertools.count(1)
 class _Request:
     __slots__ = ("rid", "tenant", "X", "n", "h_init", "warm",
                  "t_enqueue", "t_dequeue", "event", "_rlock", "result",
-                 "error", "meta", "trace")
+                 "error", "meta", "trace", "request_id")
 
     def __init__(self, tenant: str, X: np.ndarray, h_init, warm: bool,
-                 trace=None):
+                 trace=None, request_id: str | None = None):
         self.rid = next(_req_ids)
         self.tenant = tenant
         self.X = X
@@ -237,6 +244,10 @@ class _Request:
         self.meta: dict = {}
         # sampled trace context (obs/tracing.py) or None
         self.trace = trace
+        # client-chosen idempotency key (or None): the service's dedup
+        # map points ids at their original request so a retry waits on
+        # the SAME event instead of enqueueing a second solve
+        self.request_id = request_id
 
     def reply(self, result=None, error=None, **meta):
         # first reply wins: the dispatcher and the shutdown drain can
@@ -313,10 +324,14 @@ class ProjectionService:
         # tenant poison strikes / quarantine
         self._strikes: dict = {}
         self._quarantined: set = set()
+        # idempotency: request_id -> the original _Request (FIFO-bounded
+        # at _IDEM_CACHE_ENTRIES); dedup hits wait on the original's
+        # event, so one id solves at most once
+        self._idem: dict = {}
         # counters
         self._stats = {
             "requests": 0, "ok": 0, "shed": 0, "poison": 0,
-            "quarantined": 0, "error": 0, "batches": 0,
+            "quarantined": 0, "error": 0, "deduped": 0, "batches": 0,
             "multi_request_batches": 0, "lanes_total": 0,
             "max_lanes": 0, "warm_started": 0,
             "cold_dispatches_after_warmup": 0,
@@ -378,6 +393,7 @@ class ProjectionService:
             except queue.Empty:
                 break
             if req is not _SENTINEL:
+                self._idem_forget(req)
                 req.reply(error=ShedError("daemon shutting down"))
 
     def __enter__(self):
@@ -442,15 +458,27 @@ class ProjectionService:
 
     # -- admission -----------------------------------------------------
 
-    def submit(self, X, tenant: str = "default", trace=None) -> _Request:
+    def submit(self, X, tenant: str = "default", trace=None,
+               request_id: str | None = None) -> _Request:
         """Validate + enqueue one projection request; returns the pending
         handle (``.wait()`` for the result). Raises ``ServeError``
         subclasses on admission failure. ``trace`` is an optional
         sampled trace context; the dispatcher emits queue/linger/solve
-        spans under it."""
+        spans under it. ``request_id`` is an optional client-chosen
+        idempotency key: resubmitting an id that already solved (or
+        graded poison) returns the ORIGINAL request handle — at most one
+        solve per id, so a router may retry after a replica death
+        without double-dispatching work that actually completed. Shed
+        outcomes release the id (shed means "not done, retry")."""
         tenant = str(tenant)
         if not self._running:
             raise ShedError("daemon not running")
+        if request_id is not None:
+            with self._lock:
+                cached = self._idem.get(request_id)
+            if cached is not None:
+                self._count("deduped")
+                return cached
         if tenant in self._quarantined:
             self._count("quarantined")
             self._emit_request(tenant, getattr(X, "shape", (0,))[0],
@@ -484,10 +512,23 @@ class ProjectionService:
                 f"blocks and project them separately (results are "
                 f"row-independent)"))
         h_init, warm = self._warm_init_for(tenant, X)
-        req = _Request(tenant, X, h_init, warm, trace=trace)
+        req = _Request(tenant, X, h_init, warm, trace=trace,
+                       request_id=request_id)
+        if request_id is not None:
+            with self._lock:
+                existing = self._idem.get(request_id)
+                if existing is not None:
+                    # a concurrent duplicate claimed the id first — wait
+                    # on its event instead of enqueueing a second solve
+                    self._stats["deduped"] += 1
+                    return existing
+                self._idem[request_id] = req
+                while len(self._idem) > _IDEM_CACHE_ENTRIES:
+                    self._idem.pop(next(iter(self._idem)))
         try:
             self._q.put_nowait(req)
         except queue.Full:
+            self._idem_forget(req)
             self._count("shed")
             self._slo_record(0.0, ok=False)
             self._emit_request(tenant, X.shape[0], "shed")
@@ -501,6 +542,7 @@ class ProjectionService:
             # First-reply-wins makes this a no-op if the dispatcher DID
             # handle the request before exiting; wait() then surfaces
             # whichever reply won.
+            self._idem_forget(req)
             req.reply(error=ShedError("daemon shutting down"))
         return req
 
@@ -513,13 +555,26 @@ class ProjectionService:
         return error
 
     def project(self, X, tenant: str = "default", timeout: float | None
-                = None, trace=None) -> tuple[np.ndarray, dict]:
+                = None, trace=None, request_id: str | None = None
+                ) -> tuple[np.ndarray, dict]:
         """Blocking projection: returns ``(usage (n, k), meta)``."""
-        req = self.submit(X, tenant=tenant, trace=trace)
+        req = self.submit(X, tenant=tenant, trace=trace,
+                          request_id=request_id)
         wait = timeout
         if wait is None:
             wait = (self.timeout_s + 120.0) if self.timeout_s else None
         return req.wait(wait)
+
+    def _idem_forget(self, req):
+        """Release a request's idempotency claim (shed paths only): shed
+        is a promise the work was NOT done, so the same id must be free
+        to re-enter and actually solve on retry."""
+        rid = getattr(req, "request_id", None)
+        if rid is None:
+            return
+        with self._lock:
+            if self._idem.get(rid) is req:
+                self._idem.pop(rid, None)
 
     # -- dispatcher ----------------------------------------------------
 
@@ -564,6 +619,7 @@ class ProjectionService:
             except Exception as exc:  # pragma: no cover - defensive
                 for r in batch:
                     if not r.event.is_set():
+                        self._idem_forget(r)
                         r.reply(error=ServeError(
                             f"batch dispatch failed: {exc}"))
             if carry is _SENTINEL:
@@ -579,6 +635,7 @@ class ProjectionService:
         self._slo_record(waited * 1e3, ok=False)
         self._emit_request(req.tenant, req.n, "shed",
                            wait_ms=round(waited * 1e3, 3))
+        self._idem_forget(req)
         req.reply(error=ShedError(
             f"request {req.rid}: shed after waiting "
             f"{waited:.2f} s (> CNMF_TPU_SERVE_TIMEOUT_S="
@@ -766,6 +823,11 @@ class ProjectionService:
                 req.reply(result=H, batch_lanes=len(lanes),
                           batch_requests=len(batch), warm_start=req.warm,
                           wait_ms=wait_ms, solve_ms=round(solve_ms, 3))
+                # the idempotency map may pin this request for its whole
+                # cache lifetime — keep the (small) usage result for
+                # retries, drop the (large) input + init now
+                req.X = None
+                req.h_init = None
             else:
                 strikes = self._strike(req.tenant)
                 self._count("poison")
@@ -788,6 +850,10 @@ class ProjectionService:
                     f"projection graded unhealthy (nonfinite input or "
                     f"usage); strike {strikes}/"
                     f"{POISON_QUARANTINE_STRIKES}"))
+                # poison stays claimed (a retry of the same id must NOT
+                # re-solve and take a second strike); free the input
+                req.X = None
+                req.h_init = None
 
     def _emit_req_spans(self, req, t0: float, t_solve: float,
                         solve_ms: float):

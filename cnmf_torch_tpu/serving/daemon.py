@@ -26,7 +26,19 @@ Protocol (all bodies JSON):
   * ``GET /metrics`` — text exposition of the live metrics registry
     (``obs/metrics.py``; a "disabled" banner unless
     ``CNMF_TPU_METRICS=1``).
-  * ``POST /shutdown`` — clean stop (the socket file is removed).
+  * ``POST /shutdown`` — clean stop WITH drain: the accept loop closes
+    first, then every already-accepted request finishes (in-flight
+    batches complete, queued requests flush) before the batcher stops
+    and the socket file is removed. The drain wait is bounded by
+    ``CNMF_TPU_SERVE_DRAIN_S`` (default 30 s) so a wedged client cannot
+    hold shutdown hostage. No accepted request is ever lost across a
+    shutdown — pinned by ``tests/test_fleet.py`` and relied on by the
+    fleet router's zero-downtime rollover (ISSUE 20).
+
+Idempotent retries (ISSUE 20): a client may stamp ``X-CNMF-Request-Id``
+(or payload key ``"request_id"``); resubmitting the same id returns the
+original solve's reply instead of dispatching again — the at-most-once
+contract the fleet router's failover retry rides.
 
 Tracing: a sampled client sends ``X-CNMF-Trace: <trace>:<span>`` and
 the daemon threads a child context through admission -> batcher queue ->
@@ -42,6 +54,7 @@ import os
 import socket
 import socketserver
 import threading
+import time
 from http.client import HTTPConnection
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -49,11 +62,16 @@ import numpy as np
 
 from ..obs import metrics as obs_metrics
 from ..obs import tracing as obs_tracing
+from ..utils.envknobs import env_float
 from .batcher import (PoisonError, ProjectionService, QuarantinedError,
                       ServeError, ShedError)
 
 __all__ = ["ServeDaemon", "ServeClient", "serve_forever",
-           "default_socket_path"]
+           "default_socket_path", "REQUEST_ID_HEADER"]
+
+# client-chosen idempotency key: same id -> at most one solve (the
+# payload key "request_id" is equivalent; the header wins when both set)
+REQUEST_ID_HEADER = "X-CNMF-Request-Id"
 
 _STATUS_HTTP = {"shed": 429, "poison": 422, "quarantined": 403,
                 "error": 400}
@@ -160,6 +178,10 @@ class _Handler(BaseHTTPRequestHandler):
                               "error": str(exc)})
             return
         tenant = str(payload.get("tenant", "default"))
+        request_id = (self.headers.get(REQUEST_ID_HEADER)
+                      or payload.get("request_id"))
+        if request_id is not None:
+            request_id = str(request_id)
         # sampled distributed tracing: the client's context arrives in
         # the X-CNMF-Trace header; everything the daemon does for this
         # request nests under one serve.http child span
@@ -170,7 +192,8 @@ class _Handler(BaseHTTPRequestHandler):
                               tenant=tenant, n_cells=int(X.shape[0])):
             try:
                 H, meta = self.service.project(X, tenant=tenant,
-                                               trace=hctx)
+                                               trace=hctx,
+                                               request_id=request_id)
             except (ShedError, PoisonError, QuarantinedError,
                     ServeError) as exc:
                 self._reply(_STATUS_HTTP.get(exc.status, 400),
@@ -181,7 +204,65 @@ class _Handler(BaseHTTPRequestHandler):
                                   **_encode_matrix(H, payload)))
 
 
-class _UnixHTTPServer(ThreadingHTTPServer):
+class _DrainMixin:
+    """Connection-accounted threading server.
+
+    ``daemon_threads = True`` means ``server_close()`` does NOT join
+    handler threads — a bare shutdown races whatever those threads are
+    doing, which is exactly how a queued request can be accepted and
+    then lost. The fix: count every accepted connection in
+    ``process_request`` (which runs IN the accept loop, synchronously
+    with ``shutdown()``, so no accepted connection can slip past the
+    count) and decrement when its handler thread finishes. After
+    ``shutdown()`` returns, :meth:`wait_drained` blocks until the count
+    hits zero — every in-flight request has its real reply — before the
+    service underneath is torn down.
+    """
+
+    def __init__(self, *args, **kwargs):
+        self.inflight = 0
+        self._inflight_cv = threading.Condition()
+        super().__init__(*args, **kwargs)
+
+    def process_request(self, request, client_address):
+        with self._inflight_cv:
+            self.inflight += 1
+        try:
+            super().process_request(request, client_address)
+        except Exception:
+            # the handler thread never spawned; give its count back
+            with self._inflight_cv:
+                self.inflight -= 1
+                self._inflight_cv.notify_all()
+            raise
+
+    def process_request_thread(self, request, client_address):
+        try:
+            super().process_request_thread(request, client_address)
+        finally:
+            with self._inflight_cv:
+                self.inflight -= 1
+                self._inflight_cv.notify_all()
+
+    def wait_drained(self, timeout: float) -> bool:
+        """Block until every accepted connection finished handling, or
+        ``timeout`` seconds elapsed. Returns whether the drain
+        completed."""
+        deadline = time.monotonic() + max(0.0, float(timeout))
+        with self._inflight_cv:
+            while self.inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._inflight_cv.wait(remaining)
+        return True
+
+
+class _TCPHTTPServer(_DrainMixin, ThreadingHTTPServer):
+    pass
+
+
+class _UnixHTTPServer(_DrainMixin, ThreadingHTTPServer):
     address_family = socket.AF_UNIX
 
     def server_bind(self):
@@ -208,8 +289,8 @@ class ServeDaemon:
         self.service = service
         self.socket_path = None
         if port is not None:
-            self.server = ThreadingHTTPServer(("127.0.0.1", int(port)),
-                                              _Handler)
+            self.server = _TCPHTTPServer(("127.0.0.1", int(port)),
+                                         _Handler)
         else:
             if socket_path is None:
                 raise ValueError("need socket_path or port")
@@ -252,9 +333,22 @@ class ServeDaemon:
         if self._closed:
             return
         self._closed = True
+        # ordering is the drain guarantee (ISSUE 20 satellite): stop
+        # ACCEPTING first, then let every already-accepted request run
+        # to its real reply (the service is still up, so handler threads
+        # blocked in project() complete normally), and only then stop
+        # the batcher and close the listener. A client that wedges its
+        # connection open cannot hold shutdown hostage — the wait is
+        # bounded by CNMF_TPU_SERVE_DRAIN_S, after which stragglers get
+        # the batcher's shutting-down shed like before.
         self.server.shutdown()
-        self.server.server_close()
+        drain_s = env_float("CNMF_TPU_SERVE_DRAIN_S", 30.0, lo=0.0)
+        if not self.server.wait_drained(drain_s):
+            print(f"cnmf-tpu serve: shutdown drain timed out after "
+                  f"{drain_s:g} s with {self.server.inflight} "
+                  f"connection(s) still open (CNMF_TPU_SERVE_DRAIN_S)")
         self.service.close()
+        self.server.server_close()
         if self.socket_path and os.path.exists(self.socket_path):
             try:
                 os.unlink(self.socket_path)
@@ -320,13 +414,14 @@ class ServeClient:
             conn.close()
 
     def project(self, X, tenant: str = "default",
-                encoding: str = "b64"):
+                encoding: str = "b64", request_id: str | None = None):
         """Project ``X`` (n x genes) onto the resident reference;
         returns ``(usage (n, k) np.ndarray, meta dict)``. Raises the
         matching :class:`ServeError` subclass on a daemon-side error.
         With ``CNMF_TPU_TRACE_SAMPLE`` > 0 a sampled call carries an
         ``X-CNMF-Trace`` header so the daemon's spans stitch to this
-        client's trace."""
+        client's trace. ``request_id`` stamps the idempotency header:
+        retrying the same id never solves twice."""
         X = np.ascontiguousarray(np.asarray(X), dtype=np.float32)
         payload: dict = {"tenant": tenant}
         if encoding == "b64":
@@ -337,6 +432,9 @@ class ServeClient:
         ctx = obs_tracing.new_trace()
         headers = ({obs_tracing.TRACE_HEADER: obs_tracing.header_value(ctx)}
                    if ctx is not None else None)
+        if request_id is not None:
+            headers = dict(headers or {})
+            headers[REQUEST_ID_HEADER] = str(request_id)
         with obs_tracing.span(self.events, ctx, "client.request",
                               tenant=tenant):
             status, data = self._request("POST", "/project", payload,
@@ -385,27 +483,35 @@ class ServeClient:
 
 def serve_forever(run_dir: str, k: int | None = None,
                   density_threshold=None, spectra_path: str | None = None,
-                  socket_path: str | None = None, port: int | None = None):
+                  socket_path: str | None = None, port: int | None = None,
+                  replica: int = 0):
     """The ``cnmf-tpu serve <run_dir>`` entry: load + stage the
     reference, warm the program buckets, bind, and serve until
-    SIGINT/SIGTERM (clean close: batcher drained, socket removed)."""
+    SIGINT/SIGTERM (clean close: batcher drained, socket removed).
+    ``replica`` is the fleet router's ordinal (ISSUE 20): it keys this
+    daemon's heartbeat file and events stream so N replicas of one run
+    directory never collide on either."""
     import signal
 
     from ..utils.telemetry import EventLog
     from .reference import load_reference
 
     name = os.path.basename(os.path.normpath(run_dir))
+    replica = int(replica)
+    leaf = (name + ".events.jsonl" if replica == 0
+            else f"{name}.r{replica}.events.jsonl")
     events = EventLog(
-        os.path.join(run_dir, "cnmf_tmp", name + ".events.jsonl"),
-        manifest_extra={"run_name": name, "role": "serve"})
+        os.path.join(run_dir, "cnmf_tmp", leaf),
+        manifest_extra={"run_name": name, "role": "serve",
+                        "replica": replica})
     ref = load_reference(run_dir, k=k, density_threshold=density_threshold,
                          spectra_path=spectra_path)
 
     liveness = None
     from ..runtime.elastic import Heartbeat
 
-    hb = Heartbeat(os.path.join(run_dir, "cnmf_tmp"), name + ".serve", 0,
-                   events=events)
+    hb = Heartbeat(os.path.join(run_dir, "cnmf_tmp"), name + ".serve",
+                   replica, events=events)
     if hb.enabled:
         liveness = hb.beat
 

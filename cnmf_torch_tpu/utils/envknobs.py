@@ -307,6 +307,69 @@ REGISTRY: dict[str, Knob] = _knobs(
          "instead of the constant init — repeat projections converge in "
          "a fraction of the inner iterations; `0` restores the "
          "stateless solo-identical init for every request"),
+    Knob("CNMF_TPU_SERVE_DRAIN_S", "float", "`30`",
+         "shutdown drain budget: on `POST /shutdown` (or any daemon "
+         "close) the accept loop stops first, then every "
+         "already-accepted request runs to its real reply for up to "
+         "this many seconds before the batcher is torn down — no "
+         "accepted request is lost across a clean shutdown, which is "
+         "what the fleet's zero-downtime rollover drains ride"),
+    # -- replicated serving fleet (serving/fleet.py, ISSUE 20) ------------
+    Knob("CNMF_TPU_FLEET_REPLICAS", "int", "`2`",
+         "`cnmf-tpu fleet`: serve replicas the router spawns and "
+         "fronts (also `--replicas`); each is a full `serve` daemon "
+         "subprocess with its own unix socket, heartbeat file, and "
+         "AOT-warmed program cache"),
+    Knob("CNMF_TPU_FLEET_HEALTH_S", "float", "`0.5`",
+         "fleet supervision cadence: every tick the router reaps dead "
+         "replica processes, polls `/healthz`, reads heartbeat stamps, "
+         "and runs the wedge-conviction bookkeeping"),
+    Knob("CNMF_TPU_FLEET_WEDGE_POLLS", "int", "`3`",
+         "wedge conviction threshold: a replica whose `/healthz` fails "
+         "this many CONSECUTIVE ticks while its heartbeat is stale or "
+         "absent is convicted as wedged (alive-but-unresponsive), "
+         "SIGKILLed, and respawned — one failed poll on a busy replica "
+         "never convicts"),
+    Knob("CNMF_TPU_FLEET_RESPAWNS", "int", "`3`",
+         "respawn budget per replica slot: each death schedules a "
+         "respawn after the launcher's deterministic exponential "
+         "backoff (`CNMF_TPU_WORKER_BACKOFF_S` base) until the budget "
+         "is exhausted, after which the slot stays down and its "
+         "tenants remain failed over to the survivors"),
+    Knob("CNMF_TPU_FLEET_WARM_TIMEOUT_S", "float", "`300`",
+         "rollover warm budget: `POST /rollover` spawns a fresh "
+         "replica set against the new reference and waits up to this "
+         "long for every one to answer `/healthz` before any traffic "
+         "moves; on timeout (or a fresh replica dying) the new set is "
+         "killed and the old generation keeps serving untouched"),
+    Knob("CNMF_TPU_FLEET_TENANT_QPS", "float", "`0` (off)",
+         "per-tenant token-bucket admission rate at the router "
+         "(requests/s): a tenant exceeding its bucket sheds with HTTP "
+         "429 BEFORE consuming replica queue space, so one hot tenant "
+         "cannot starve the fleet; `0` disables quota admission"),
+    Knob("CNMF_TPU_FLEET_TENANT_BURST", "float", "`0` (auto)",
+         "token-bucket burst capacity per tenant; `0` defaults to "
+         "`2x` the rate (one second of headroom on top of sustained "
+         "`CNMF_TPU_FLEET_TENANT_QPS`)"),
+    Knob("CNMF_TPU_FLEET_RETRIES", "int", "`2`",
+         "router failover retries per request on TRANSPORT errors "
+         "(replica died mid-request, connect refused): the retry "
+         "carries the same idempotency id to the next consistent-hash "
+         "candidate, so a request that actually solved is never solved "
+         "twice; replica-side verdicts (shed/poison/quarantine) pass "
+         "through without retry"),
+    Knob("CNMF_TPU_FLEET_HEDGE_MS", "float", "`0` (off)",
+         "tail hedging: when the primary replica has not replied after "
+         "this many milliseconds the router launches ONE duplicate "
+         "attempt (same idempotency id) on the next candidate and "
+         "takes whichever answers first — bounds the p99 paid for a "
+         "momentarily slow replica; `0` disables hedging"),
+    Knob("CNMF_TPU_FLEET_REPLICA_TELEMETRY", "flag", "`0`",
+         "`1` leaves telemetry ON inside fleet replica subprocesses "
+         "(their per-replica events land in `<name>.r<ordinal>.events."
+         "jsonl`). Default off: the router's own event stream already "
+         "carries per-request outcomes, and N replicas would otherwise "
+         "multi-count `serve_request` in `cnmf-tpu report`"),
     # -- observability ----------------------------------------------------
     Knob("CNMF_TPU_TELEMETRY", "flag", "`0`",
          "`1` enables the structured run-telemetry event log "

@@ -97,6 +97,19 @@ EVENT_TYPES = {
     # `bench.py --tier serve` batching-engagement assertions
     "serve_request": {"tenant", "n_cells", "status"},
     "serve_batch": {"lanes", "requests", "bucket"},
+    # replicated serving fleet (serving/fleet.py, ISSUE 20): the router's
+    # audit trail behind the report's Fleet section. `replica_death` —
+    # one per dead/wedged/exhausted replica (reason in {exit, wedge,
+    # spawn_failed, respawns_exhausted} plus pid/uptime/requests-served
+    # evidence); `failover` — the tenants remapped off a removed replica
+    # onto the survivors (count in `tenants`, capped sample in context);
+    # `rollover` — one per completed zero-downtime reference rollover
+    # (new generation + end-to-end wall incl. warm + drain + swap).
+    # Router-side `serve_request` events additionally carry `replica`,
+    # which is where the per-replica request share comes from
+    "replica_death": {"replica", "reason"},
+    "failover": {"replica", "tenants"},
+    "rollover": {"generation", "wall_s"},
     # 2-D grid statistics collectives (parallel/grid2d.py, ISSUE 13):
     # one event per grid solve (context: mesh shape, overlap blocks,
     # pass count; wall_s = solve wall, nbytes = logical per-pass psum
@@ -854,6 +867,53 @@ def summarize_events(events: list[dict]) -> dict:
                     sum(bool(h) for h in hits) / len(hits), 3)
         summary["serving"] = serving
 
+    # replicated serving fleet (ISSUE 20): replica lifecycle + routing
+    # outcomes from the router's event stream — deaths (with lifetimes),
+    # tenant failovers, reference rollovers, and the per-replica request
+    # share computed from router-side serve_request events (which carry
+    # the replica slot each request was served by)
+    deaths = [e for e in events if e["t"] == "replica_death"]
+    failovers = [e for e in events if e["t"] == "failover"]
+    rollovers = [e for e in events if e["t"] == "rollover"]
+    share: dict = {}
+    for e in reqs:
+        if e.get("replica") is not None:
+            rep = str(e["replica"])
+            share[rep] = share.get(rep, 0) + 1
+    if deaths or failovers or rollovers or share:
+        fleet: dict = {"replica_deaths": len(deaths),
+                       "failovers": len(failovers),
+                       "rollovers": len(rollovers)}
+        reasons: dict = {}
+        lifetimes = []
+        for e in deaths:
+            reasons[str(e.get("reason"))] = \
+                reasons.get(str(e.get("reason")), 0) + 1
+            up = e.get("uptime_s")
+            if isinstance(up, (int, float)) and math.isfinite(up):
+                lifetimes.append(round(float(up), 3))
+        if reasons:
+            fleet["deaths_by_reason"] = dict(sorted(reasons.items()))
+        if lifetimes:
+            fleet["replica_lifetimes_s"] = sorted(lifetimes)
+        t_failed = sum(int(e.get("tenants", 0)) for e in failovers)
+        if failovers:
+            fleet["tenants_failed_over"] = t_failed
+        if rollovers:
+            fleet["rollover_wall_s"] = [
+                round(float(e.get("wall_s", 0.0)), 3) for e in rollovers]
+            gens = [int(e["generation"]) for e in rollovers
+                    if isinstance(e.get("generation"), int)]
+            if gens:
+                fleet["generation"] = max(gens)
+        if share:
+            total_share = sum(share.values())
+            fleet["requests_by_replica"] = dict(sorted(share.items()))
+            fleet["request_share"] = {
+                rep: round(n / total_share, 3)
+                for rep, n in sorted(share.items())}
+        summary["fleet"] = fleet
+
     # live observability plane (ISSUE 18): sampled trace spans rolled up
     # by name (the waterfall itself is `cnmf-tpu trace`), and the LAST
     # SLO verdict carried by a metrics_snapshot — what /healthz was
@@ -1210,6 +1270,38 @@ def render_report(run_dir: str) -> str:
                 for label, cnt in hist.items():
                     bar = "#" * max(1, int(round(cnt / total * 32)))
                     lines.append(f"    {label:>8s} ms {cnt:>7d}  {bar}")
+
+    fleet = summary.get("fleet")
+    if fleet:
+        lines.append("")
+        lines.append("Fleet (replicated serving)")
+        lines.append("-" * 26)
+        reasons = fleet.get("deaths_by_reason")
+        lines.append(
+            f"  replica deaths {fleet.get('replica_deaths', 0)}"
+            + (f" ({', '.join(f'{r}={n}' for r, n in reasons.items())})"
+               if reasons else "")
+            + f"  failovers {fleet.get('failovers', 0)}"
+            + (f" ({fleet['tenants_failed_over']} tenant(s) remapped)"
+               if fleet.get("tenants_failed_over") is not None else ""))
+        lives = fleet.get("replica_lifetimes_s")
+        if lives:
+            lines.append(
+                f"  dead-replica lifetimes {min(lives):.1f}"
+                f"-{max(lives):.1f} s over {len(lives)} death(s)")
+        walls = fleet.get("rollover_wall_s")
+        lines.append(
+            f"  rollovers {fleet.get('rollovers', 0)}"
+            + (f" (walls {', '.join(f'{w:.1f}s' for w in walls)};"
+               f" now serving generation {fleet.get('generation')})"
+               if walls else ""))
+        share = fleet.get("request_share")
+        if share:
+            counts = fleet.get("requests_by_replica", {})
+            for rep, frac in share.items():
+                lines.append(f"    replica {rep:<8s} "
+                             f"{counts.get(rep, 0):>7d} request(s)  "
+                             f"{frac:.1%}")
 
     slo = summary.get("slo")
     if slo:

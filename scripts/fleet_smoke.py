@@ -1,0 +1,333 @@
+"""Tier-1 fleet chaos smoke gate (scripts/verify_tier1.sh, ISSUE 20).
+
+Builds a consensus-complete mini run, starts the REAL replicated fleet
+through the CLI surface (``cnmf-tpu fleet <run_dir> --socket ...
+--replicas 2`` in a subprocess — which itself spawns two real ``serve``
+daemon subprocesses), then drives the three chaos events the fleet
+exists to survive, all under sustained multi-tenant load:
+
+  * **replica SIGKILL mid-load** (``replicadeath`` fault clause): the
+    router must fail the dead replica's tenants over to the survivor
+    and respawn it — zero accepted requests lost;
+  * **reference rollover with a store outage** (``netdown`` clause,
+    ``once=`` sentinel): a v2 reference published through the remote
+    ShardStore (``CNMF_TPU_STORE_URI``) replaces v1 with zero downtime
+    — no request errors, and every reply is bit-identical to solo
+    ``refit_usage`` against EITHER v1 or v2, never a mix — while the
+    warming replicas heal one injected store failure via the transport
+    retry ladder;
+  * **a poison tenant**: three NaN strikes convict at the ROUTER
+    (fleet-scoped quarantine), isolated from every other tenant.
+
+Afterwards: SLO not burning (``CNMF_TPU_SLO_P99_MS``), schema-valid
+fleet events (``replica_death`` / ``failover`` / ``rollover`` +
+per-request routing), clean shutdown with no orphans.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    import numpy as np
+    import pandas as pd
+
+    from cnmf_torch_tpu import cNMF
+    from cnmf_torch_tpu.serving import (PoisonError, QuarantinedError,
+                                        load_reference)
+    from cnmf_torch_tpu.serving.fleet import FleetClient
+    from cnmf_torch_tpu.utils import save_df_to_npz
+    from cnmf_torch_tpu.utils.netstore import ObjectStoreServer
+    from cnmf_torch_tpu.utils.shardstore import write_shard_store
+    from cnmf_torch_tpu.utils.storebackend import resolve_backend
+    from cnmf_torch_tpu.utils.telemetry import (read_events,
+                                                validate_events_file)
+
+    workdir = tempfile.mkdtemp(prefix="fleet_smoke_")
+    proc = None
+    store_srv = None
+    try:
+        # -- fixture run ---------------------------------------------------
+        rng = np.random.default_rng(8)
+        usage = rng.dirichlet(np.ones(4) * 0.3, size=160)
+        spectra = rng.gamma(0.3, 1.0, size=(4, 90)) * 40.0 / 90
+        counts = rng.poisson(usage @ spectra * 260.0).astype(np.float64)
+        counts[counts.sum(axis=1) == 0, 0] = 1.0
+        df = pd.DataFrame(counts, index=[f"c{i}" for i in range(160)],
+                          columns=[f"g{j}" for j in range(90)])
+        counts_fn = os.path.join(workdir, "counts.df.npz")
+        save_df_to_npz(df, counts_fn)
+
+        obj = cNMF(output_dir=workdir, name="smoke")
+        obj.prepare(counts_fn, components=[3], n_iter=6, seed=4,
+                    num_highvar_genes=70)
+        obj.factorize()
+        obj.combine()
+        obj.consensus(k=3, density_threshold=2.0, show_clustering=False)
+        run_dir = os.path.join(workdir, "smoke")
+
+        # -- v2 reference published through the REMOTE shard store ---------
+        ref = load_reference(run_dir)
+        store_srv = ObjectStoreServer()
+        store_srv.start()
+        store_uri = store_srv.url + "/fleet"
+        v2_dir = os.path.join(workdir, "ref_v2.store")
+        os.makedirs(v2_dir, exist_ok=True)  # isdir gate; objects remote
+        W2 = (np.asarray(ref.W, np.float32) * 1.25).astype(np.float32)
+        write_shard_store(v2_dir, W2, var_names=list(ref.genes),
+                          backend=resolve_backend(v2_dir, uri=store_uri))
+
+        # expected usages, per tenant, for BOTH references: a reply that
+        # matches neither is a lost/corrupt/mixed-reference answer
+        tenants = [f"tenant{i}" for i in range(4)]
+        queries = {t: rng.gamma(
+            1.0, 1.0, size=(12 + 9 * i, ref.n_genes)).astype(np.float32)
+            for i, t in enumerate(tenants)}
+        df1 = pd.DataFrame(np.asarray(ref.W, np.float32),
+                           columns=ref.genes)
+        df2 = pd.DataFrame(W2, columns=ref.genes)
+        exp1 = {t: np.asarray(obj.refit_usage(X, df1))
+                for t, X in queries.items()}
+        exp2 = {t: np.asarray(obj.refit_usage(X, df2))
+                for t, X in queries.items()}
+
+        # -- the fleet through the CLI surface -----------------------------
+        sock = os.path.join(workdir, "fleet.sock")
+        sentinel = os.path.join(workdir, "netdown.once")
+        env = dict(
+            os.environ,
+            CNMF_TPU_TELEMETRY="1",
+            CNMF_TPU_SERVE_LINGER_MS="40",
+            CNMF_TPU_SERVE_WARM_START="0",
+            CNMF_TPU_STORE_URI=store_uri,
+            CNMF_TPU_SLO_P99_MS="8000",
+            CNMF_TPU_FLEET_HEALTH_S="0.25",
+            CNMF_TPU_WORKER_BACKOFF_S="0.2",
+            # slot 1 is SIGKILLed on its 5th supervision poll (~1.5 s in,
+            # squarely mid-load); one slab GET during the rollover warm
+            # raises ConnectionError (healed by the store retry ladder)
+            CNMF_TPU_FAULT_SPEC=(
+                "replicadeath:context=fleet,worker=1,after=4;"
+                f"netdown:context=get:slab,once={sentinel}"))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "cnmf_torch_tpu", "fleet", run_dir,
+             "--socket", sock, "--replicas", "2"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        cli = FleetClient(socket_path=sock, timeout=300.0)
+        deadline = time.time() + 240
+        while True:
+            if proc.poll() is not None:
+                print("fleet smoke: fleet exited early:\n"
+                      + (proc.stdout.read() or ""), file=sys.stderr)
+                return 1
+            try:
+                if cli.healthz().get("ok"):
+                    break
+            except Exception:
+                pass
+            if time.time() > deadline:
+                print("fleet smoke: fleet never came up", file=sys.stderr)
+                return 1
+            time.sleep(0.25)
+
+        # -- sustained multi-tenant load across all chaos events -----------
+        stop = threading.Event()
+        lock = threading.Lock()
+        replies: dict = {t: [] for t in tenants}  # (issued_at, H | exc)
+
+        def load(tenant, X):
+            i = 0
+            c = FleetClient(socket_path=sock, timeout=120.0)
+            while not stop.is_set():
+                i += 1
+                issued = time.monotonic()
+                try:
+                    H, _meta = c.project(X, tenant=tenant,
+                                         request_id=f"{tenant}-{i}")
+                    out = H
+                except Exception as exc:
+                    out = exc
+                with lock:
+                    replies[tenant].append((issued, out))
+                time.sleep(0.1)
+
+        threads = [threading.Thread(target=load, args=(t, queries[t]))
+                   for t in tenants]
+        for t in threads:
+            t.start()
+
+        def wait_stats(pred, what, timeout):
+            end = time.time() + timeout
+            while time.time() < end:
+                st = cli.stats()
+                if pred(st):
+                    return st
+                time.sleep(0.25)
+            print(f"fleet smoke: timed out waiting for {what}: "
+                  f"{cli.stats()}", file=sys.stderr)
+            return None
+
+        # chaos 1: the injected SIGKILL lands, tenants fail over, and the
+        # replica respawns back into the ring
+        if wait_stats(lambda s: s["replica_deaths"] >= 1,
+                      "injected replica death", 60) is None:
+            return 1
+        if wait_stats(lambda s: s["replicas_up"] == 2,
+                      "replica respawn", 120) is None:
+            return 1
+
+        # chaos 2: zero-downtime rollover to v2 (remote store, one
+        # injected GET failure during the warm)
+        out = cli.rollover(v2_dir)
+        t_roll_done = time.monotonic()
+        if out.get("generation") != 1:
+            print(f"fleet smoke: bad rollover reply {out}",
+                  file=sys.stderr)
+            return 1
+        if not os.path.exists(sentinel):
+            print("fleet smoke: the netdown clause never fired — the "
+                  "rollover did not exercise the store outage path",
+                  file=sys.stderr)
+            return 1
+
+        time.sleep(2.0)  # a few more requests against generation 1
+        stop.set()
+        for t in threads:
+            t.join(timeout=180)
+
+        # chaos 3: poison tenant — three strikes convict at the router
+        poison = queries["tenant0"].copy()
+        poison[1, 1] = np.nan
+        for strike in range(3):
+            try:
+                cli.project(poison, tenant="toxic")
+                print("fleet smoke: poison request did not fail",
+                      file=sys.stderr)
+                return 1
+            except PoisonError:
+                pass
+        try:
+            cli.project(poison, tenant="toxic")
+            print("fleet smoke: 4th poison request was not quarantined",
+                  file=sys.stderr)
+            return 1
+        except QuarantinedError:
+            pass
+        # ...and the quarantine is tenant-scoped, not fleet-wide
+        H, _ = cli.project(queries["tenant1"], tenant="tenant1")
+        if not (np.array_equal(H, exp2["tenant1"])):
+            print("fleet smoke: post-quarantine request not bit-"
+                  "identical to v2 solo refit_usage", file=sys.stderr)
+            return 1
+
+        # -- zero lost accepted requests; never a mixed reference ----------
+        total = 0
+        for tenant in tenants:
+            for issued, out in replies[tenant]:
+                total += 1
+                if isinstance(out, Exception):
+                    print(f"fleet smoke: {tenant} request FAILED under "
+                          f"chaos: {out!r}", file=sys.stderr)
+                    return 1
+                is_v1 = np.array_equal(out, exp1[tenant])
+                is_v2 = np.array_equal(out, exp2[tenant])
+                if not (is_v1 or is_v2):
+                    print(f"fleet smoke: {tenant} reply matches NEITHER "
+                          f"reference exactly (lost/mixed)",
+                          file=sys.stderr)
+                    return 1
+                if issued > t_roll_done and not is_v2:
+                    print(f"fleet smoke: {tenant} request issued after "
+                          f"rollover still answered with v1",
+                          file=sys.stderr)
+                    return 1
+        if total < 20:
+            print(f"fleet smoke: only {total} requests completed — not "
+                  f"a sustained load", file=sys.stderr)
+            return 1
+
+        # -- SLO + final accounting ----------------------------------------
+        stats = cli.stats()
+        slo = stats.get("slo") or {}
+        if slo.get("burning"):
+            print(f"fleet smoke: SLO burning through chaos: {slo}",
+                  file=sys.stderr)
+            return 1
+        if stats["ok"] < total or stats["poison"] != 3 \
+                or stats["quarantined"] != 1 or stats["error"] != 0:
+            print(f"fleet smoke: bad outcome counts: {stats}",
+                  file=sys.stderr)
+            return 1
+
+        # -- clean shutdown ------------------------------------------------
+        cli.shutdown()
+        rc = proc.wait(timeout=120)
+        out_text = proc.stdout.read() or ""
+        proc = None
+        if rc != 0:
+            print(f"fleet smoke: fleet exit code {rc}:\n{out_text}",
+                  file=sys.stderr)
+            return 1
+        tmp = os.path.join(run_dir, "cnmf_tmp")
+        orphans = [fn for fn in os.listdir(tmp)
+                   if fn.endswith((".sock", ".tmp"))
+                   or fn.startswith(".tmp")]
+        if orphans or os.path.exists(sock):
+            print(f"fleet smoke: orphans after shutdown: {orphans}",
+                  file=sys.stderr)
+            return 1
+
+        # -- fleet telemetry: schema-valid, the full audit trail -----------
+        ev_path = os.path.join(tmp, "smoke.fleet.events.jsonl")
+        n = validate_events_file(ev_path)
+        evs = read_events(ev_path)
+        deaths = [e for e in evs if e["t"] == "replica_death"]
+        fos = [e for e in evs if e["t"] == "failover"]
+        rolls = [e for e in evs if e["t"] == "rollover"]
+        reqs = [e for e in evs if e["t"] == "serve_request"]
+        if not deaths or deaths[0]["reason"] != "exit":
+            print(f"fleet smoke: missing/wrong replica_death events: "
+                  f"{deaths}", file=sys.stderr)
+            return 1
+        if not fos or not rolls or rolls[0]["generation"] != 1:
+            print(f"fleet smoke: missing failover/rollover events "
+                  f"({len(fos)}/{len(rolls)})", file=sys.stderr)
+            return 1
+        routed = {e.get("replica") for e in reqs
+                  if e["status"] == "ok"}
+        if len(routed) < 2:
+            print(f"fleet smoke: requests never spread over >1 replica "
+                  f"({routed})", file=sys.stderr)
+            return 1
+
+        print(f"fleet smoke: {total} requests across {len(tenants)} "
+              f"tenants all bit-identical to solo refit_usage (v1 or v2, "
+              f"never mixed) through a SIGKILLed replica + respawn, a "
+              f"zero-downtime rollover with an injected store outage "
+              f"(healed), and a router-quarantined poison tenant; SLO "
+              f"intact, {n} schema-valid fleet events, clean shutdown")
+        return 0
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        if store_srv is not None:
+            store_srv.stop()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -196,6 +196,27 @@ if [ "$rc" -eq 0 ]; then
   fi
 fi
 
+# fleet smoke: the replicated serving fleet under chaos
+# (scripts/fleet_smoke.py, ISSUE 20) — the REAL fleet (CLI subprocess
+# fronting two serve daemon subprocesses) under sustained multi-tenant
+# load while a replica is SIGKILLed mid-load (failover + respawn, zero
+# lost accepted requests), the reference rolls over to a v2 published
+# through the remote ShardStore with one injected store outage (zero
+# downtime: every reply bit-identical to solo refit_usage against v1 or
+# v2, never mixed), and one tenant turns poisonous (quarantined AT THE
+# ROUTER after 3 strikes, isolated from its neighbors) — then SLO not
+# burning, schema-valid fleet events, clean shutdown with no orphans
+if [ "$rc" -eq 0 ]; then
+  echo "[tier1] fleet smoke (replica kill + rollover + poison quarantine under load) ..."
+  if timeout -k 10 900 env JAX_PLATFORMS=cpu \
+      python scripts/fleet_smoke.py; then
+    echo FLEET_SMOKE=ok
+  else
+    echo FLEET_SMOKE=fail
+    exit 1
+  fi
+fi
+
 # obs smoke: the live observability plane end-to-end against real
 # processes (scripts/obs_smoke.py) — concurrent tenants with a mid-load
 # /metrics scrape that parses back, /stats reservoir-honesty fields, one
